@@ -1,0 +1,55 @@
+"""hypergraphdb_tpu — a TPU-native hypergraph database framework.
+
+A from-scratch rebuild of the capabilities of HyperGraphDB (the reference
+Java implementation: an embedded, transactional, extensible hypergraph
+database — see /root/reference, ``core/src/java/org/hypergraphdb/HyperGraph.java:64-75``)
+re-designed TPU-first:
+
+- **Host plane** (Python + C++ extension): columnar atom store, type system,
+  MVCC transactions, ingest, indexing, p2p services.
+- **Device plane** (JAX/XLA/Pallas): immutable CSR snapshots of the
+  incidence structure; query and traversal hot loops run as batched
+  gather/scatter + sorted-set-intersection kernels on TPU, sharded over a
+  ``jax.sharding.Mesh`` for multi-chip scale.
+
+Public entry points mirror the reference's API surface:
+
+    >>> import hypergraphdb_tpu as hg
+    >>> graph = hg.HyperGraph()          # HGEnvironment.get() equivalent
+    >>> h = graph.add("hello")
+    >>> link = graph.add_link((h, graph.add("world")))
+    >>> snap = graph.snapshot()          # device CSR snapshot
+"""
+
+from hypergraphdb_tpu.core.handles import (
+    HGHandle,
+    NULL_HANDLE,
+    HandleFactory,
+    SequentialHandleFactory,
+    UUIDHandleFactory,
+)
+from hypergraphdb_tpu.core.config import HGConfiguration
+from hypergraphdb_tpu.core.errors import (
+    HGException,
+    TransactionConflict,
+    NotFoundError,
+)
+from hypergraphdb_tpu.core.graph import HyperGraph, HGLink
+from hypergraphdb_tpu.core.environment import HGEnvironment
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "HGHandle",
+    "NULL_HANDLE",
+    "HandleFactory",
+    "SequentialHandleFactory",
+    "UUIDHandleFactory",
+    "HGConfiguration",
+    "HGException",
+    "TransactionConflict",
+    "NotFoundError",
+    "HyperGraph",
+    "HGLink",
+    "HGEnvironment",
+]

@@ -1,0 +1,90 @@
+"""HGEnvironment — registry of open databases.
+
+Re-expression of ``core/src/java/org/hypergraphdb/HGEnvironment.java:37,93``:
+a process-wide map from location → open ``HyperGraph``, with idempotent
+``get`` and an atexit hook standing in for the reference's JVM shutdown hook
+(``HGEnvironment.java:256-283``).
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+from typing import Optional
+
+from hypergraphdb_tpu.core.config import HGConfiguration
+from hypergraphdb_tpu.core.graph import HyperGraph
+
+_lock = threading.Lock()
+_open: dict[str, HyperGraph] = {}
+
+
+def _native_available() -> bool:
+    try:
+        from hypergraphdb_tpu.storage import native  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def get(location: str, config: Optional[HGConfiguration] = None) -> HyperGraph:
+    """Open (or return the already-open) database at ``location``.
+
+    A real filesystem location selects the persistent native backend when the
+    C++ extension is built; otherwise it falls back to the in-memory backend
+    with a warning (never mutating the caller's config object).
+    """
+    with _lock:
+        g = _open.get(location)
+        if g is not None:
+            return g
+        import copy
+
+        cfg = copy.deepcopy(config) if config is not None else HGConfiguration()
+        cfg.location = location
+        if cfg.store_backend == "memory" and location not in ("", ":memory:"):
+            if _native_available():
+                cfg.store_backend = "native"
+            else:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "native storage backend unavailable; opening %s in-memory "
+                    "(non-durable)", location,
+                )
+        g = HyperGraph(cfg)
+        _open[location] = g
+        return g
+
+
+def is_open(location: str) -> bool:
+    with _lock:
+        return location in _open
+
+
+def close(location: str) -> None:
+    with _lock:
+        g = _open.pop(location, None)
+    if g is not None:
+        g.close()
+
+
+def close_all() -> None:
+    with _lock:
+        graphs = list(_open.items())
+        _open.clear()
+    for _, g in graphs:
+        g.close()
+
+
+atexit.register(close_all)
+
+
+class HGEnvironment:
+    """Namespace-style façade matching the reference's static API."""
+
+    get = staticmethod(get)
+    is_open = staticmethod(is_open)
+    close = staticmethod(close)
+    close_all = staticmethod(close_all)

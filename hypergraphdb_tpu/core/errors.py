@@ -1,0 +1,38 @@
+"""Exception hierarchy.
+
+Mirrors the reference's ``HGException`` / ``TransactionConflictException``
+surface (``core/src/java/org/hypergraphdb/HGException.java``,
+``transaction/TransactionConflictException.java``) with Python idioms.
+"""
+
+
+class HGException(Exception):
+    """Base class for all hypergraphdb_tpu errors."""
+
+
+class NotFoundError(HGException, KeyError):
+    """An atom, link or datum was not found for the given handle."""
+
+
+class TransactionConflict(HGException):
+    """Commit-time validation failed; the transaction should be retried.
+
+    Equivalent of the reference's ``TransactionConflictException`` raised in
+    ``HGTransaction.validateCommit`` (``transaction/HGTransaction.java:96-108``).
+    """
+
+
+class TransactionAborted(HGException):
+    """The transaction was explicitly aborted."""
+
+
+class StorageError(HGException):
+    """Low-level storage failure."""
+
+
+class TypeError_(HGException):
+    """Type-system violation (bad value for type, unknown type...)."""
+
+
+class QueryError(HGException):
+    """Malformed or uncompilable query condition."""

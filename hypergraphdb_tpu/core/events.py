@@ -1,0 +1,115 @@
+"""Event system: synchronous listener dispatch per event class.
+
+Re-expression of the reference's ``event/`` package
+(``event/HGDefaultEventManager.java`` — dispatch walks the event class and
+its superclasses; events for atom added/removed/replaced/loaded, veto
+"propose" events, tx boundaries, open/close — SURVEY §2.1 Events).
+A listener returning ``HGListener.CANCEL`` vetoes the operation (the
+reference's propose/refuse protocol).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from hypergraphdb_tpu.core.handles import HGHandle
+
+
+class HGEvent:
+    pass
+
+
+@dataclass
+class HGAtomEvent(HGEvent):
+    handle: HGHandle
+    atom: Any = None
+
+
+class HGAtomProposeEvent(HGAtomEvent):
+    """Fired before an add; a CANCEL veto aborts the add."""
+
+
+class HGAtomAddedEvent(HGAtomEvent):
+    pass
+
+
+class HGAtomRemoveRequestEvent(HGAtomEvent):
+    """Fired before a remove; a CANCEL veto aborts it."""
+
+
+class HGAtomRemovedEvent(HGAtomEvent):
+    pass
+
+
+class HGAtomReplaceRequestEvent(HGAtomEvent):
+    pass
+
+
+class HGAtomReplacedEvent(HGAtomEvent):
+    pass
+
+
+class HGAtomLoadedEvent(HGAtomEvent):
+    pass
+
+
+class HGAtomAccessedEvent(HGAtomEvent):
+    pass
+
+
+@dataclass
+class HGOpenedEvent(HGEvent):
+    graph: Any = None
+
+
+@dataclass
+class HGClosingEvent(HGEvent):
+    graph: Any = None
+
+
+@dataclass
+class HGTransactionStartedEvent(HGEvent):
+    tx: Any = None
+
+
+@dataclass
+class HGTransactionEndedEvent(HGEvent):
+    tx: Any = None
+    success: bool = True
+
+
+class HGListener:
+    CONTINUE = 0
+    CANCEL = 1
+
+
+Listener = Callable[[Any, HGEvent], int]
+
+
+class HGEventManager:
+    """Synchronous dispatch; listeners keyed by event class, superclass
+    listeners also fire (``HGDefaultEventManager`` semantics)."""
+
+    def __init__(self) -> None:
+        self._listeners: dict[type, list[Listener]] = {}
+
+    def add_listener(self, event_class: type, listener: Listener) -> None:
+        self._listeners.setdefault(event_class, []).append(listener)
+
+    def remove_listener(self, event_class: type, listener: Listener) -> None:
+        ls = self._listeners.get(event_class)
+        if ls and listener in ls:
+            ls.remove(listener)
+
+    def clear(self) -> None:
+        self._listeners.clear()
+
+    def dispatch(self, graph: Any, event: HGEvent) -> int:
+        for cls in type(event).__mro__:
+            if not (isinstance(cls, type) and issubclass(cls, HGEvent)):
+                continue
+            for l in list(self._listeners.get(cls, ())):
+                if l(graph, event) == HGListener.CANCEL:
+                    return HGListener.CANCEL
+        return HGListener.CONTINUE

@@ -1,0 +1,595 @@
+"""HyperGraph — the graph kernel: atom CRUD + incidence maintenance.
+
+Re-expression of the reference kernel (``core/src/java/org/hypergraphdb/
+HyperGraph.java:92`` — ``add`` :641, ``get`` :784, ``addNode`` :1563,
+``addLink`` :1589, incidence maintenance :1882) on the TPU-native columnar
+design:
+
+- Every datum is an **atom** identified by a dense int handle. A **link** is
+  an atom that additionally holds an ordered tuple of target atoms (arity
+  ≥ 0, links may target links — the hypergraph property,
+  ``HyperGraph.java:64-75``).
+- The stored atom record is ``(type_handle, value_handle, flags, *targets)``
+  — the direct analogue of the reference layout ``[type, value, targets...]``
+  (``HyperGraph.java:1571-1607``) plus a flags word to distinguish 0-arity
+  links from nodes without an instanceof check.
+- Two system indices are maintained on every add/replace/remove: by-type and
+  by-value (``HyperGraph.java:110-114`` HGATOMTYPE/HGATOMVALUE), feeding the
+  query planner and the device CSR type index.
+- Reads are cached in a bounded LRU (the reference's ``WeakRefAtomCache``
+  role); incidence sets are cached at the storage layer as sorted numpy
+  snapshots that double as CSR pack input.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
+
+import numpy as np
+
+from hypergraphdb_tpu.core import events as ev
+from hypergraphdb_tpu.core.config import HGConfiguration
+from hypergraphdb_tpu.core.errors import HGException, NotFoundError
+from hypergraphdb_tpu.core.handles import (
+    NULL_HANDLE,
+    HandleFactory,
+    HGHandle,
+    SequentialHandleFactory,
+    UUIDHandleFactory,
+)
+from hypergraphdb_tpu.core.store import HGStore
+from hypergraphdb_tpu.storage.api import HGSortedResultSet, StorageBackend
+from hypergraphdb_tpu.tx.manager import HGTransactionManager
+from hypergraphdb_tpu.types.system import HGTypeSystem
+from hypergraphdb_tpu.utils.cache import LRUCache
+
+_FLAG_LINK = 1
+
+#: index names for the two system indices
+IDX_BY_TYPE = "hg.bytype"
+IDX_BY_VALUE = "hg.byvalue"
+
+
+@dataclass(frozen=True)
+class HGLink:
+    """A loaded link atom: its value + ordered targets.
+
+    The reference models links as Java objects implementing ``HGLink``;
+    here a link is plain data (functional style — nothing mutates in place).
+    """
+
+    targets: tuple[HGHandle, ...]
+    value: Any = None
+
+    @property
+    def arity(self) -> int:
+        return len(self.targets)
+
+    def target_at(self, i: int) -> HGHandle:
+        return self.targets[i]
+
+
+class HyperGraph:
+    """An open hypergraph database instance."""
+
+    def __init__(
+        self,
+        config: Optional[HGConfiguration] = None,
+        backend: Optional[StorageBackend] = None,
+    ):
+        self.config = config or HGConfiguration()
+        if backend is None:
+            backend = self._make_backend(self.config)
+        self.backend = backend
+        backend.startup()
+        self.txman = HGTransactionManager(backend, enabled=self.config.transactional)
+        self.store = HGStore(backend, self.txman)
+        if self.config.handle_factory == "uuid":
+            self.handles: HandleFactory = UUIDHandleFactory()
+        else:
+            self.handles = SequentialHandleFactory()
+        self.handles.reset(backend.max_handle())
+        self.events = ev.HGEventManager()
+        self._atom_cache: LRUCache = LRUCache(self.config.cache.atom_cache_size)
+        self.typesystem = HGTypeSystem(self)
+        self.typesystem.bootstrap()
+        self.stats = HGStats()
+        self._snapshot_cache = None
+        self._mutations = 0  # bumped on every committed structural change
+        self.events.dispatch(self, ev.HGOpenedEvent(graph=self))
+        self._open = True
+
+    @staticmethod
+    def _make_backend(config: HGConfiguration) -> StorageBackend:
+        if config.store_backend == "native":
+            try:
+                from hypergraphdb_tpu.storage.native import NativeStorage
+            except ImportError as e:
+                raise HGException(
+                    "the native (persistent) storage backend is not available "
+                    "in this build; use store_backend='memory'"
+                ) from e
+            return NativeStorage(config.location or ".hgdb")
+        from hypergraphdb_tpu.storage.memstore import MemStorage
+
+        return MemStorage()
+
+    # ------------------------------------------------------------------ lifecycle
+    def close(self) -> None:
+        if not getattr(self, "_open", False):
+            return
+        self.events.dispatch(self, ev.HGClosingEvent(graph=self))
+        self.backend.shutdown()
+        self._open = False
+
+    def __enter__(self) -> "HyperGraph":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------------ add
+    def add(
+        self,
+        value: Any = None,
+        type: Optional[Any] = None,  # noqa: A002 - mirrors reference naming
+        targets: Sequence[HGHandle] = (),
+    ) -> HGHandle:
+        """Add an atom (``HyperGraph.add`` :641). ``targets`` non-empty (or an
+        ``HGLink`` value) makes it a link."""
+        if isinstance(value, HGLink):
+            targets = value.targets
+            value = value.value
+            return self.add_link(targets, value, type)
+        if targets:
+            return self.add_link(targets, value, type)
+        return self.add_node(value, type)
+
+    def add_node(self, value: Any, type: Optional[Any] = None) -> HGHandle:  # noqa: A002
+        return self._add_atom(value, type, None)
+
+    def add_link(
+        self,
+        targets: Sequence[HGHandle],
+        value: Any = None,
+        type: Optional[Any] = None,  # noqa: A002
+    ) -> HGHandle:
+        return self._add_atom(value, type, tuple(int(t) for t in targets))
+
+    def _resolve_type_handle(self, value: Any, type_: Optional[Any]) -> HGHandle:
+        if type_ is None:
+            if value is None:
+                return self.typesystem.handle_of("null")
+            return self.typesystem.get_type_handle(value)
+        if isinstance(type_, str):
+            return self.typesystem.handle_of(type_)
+        return int(type_)
+
+    def _check_open(self) -> None:
+        if not getattr(self, "_open", True):
+            raise HGException("database is closed")
+
+    def _add_atom(
+        self, value: Any, type_: Optional[Any], targets: Optional[tuple[int, ...]]
+    ) -> HGHandle:
+        self._check_open()
+        if (
+            self.events.dispatch(self, ev.HGAtomProposeEvent(NULL_HANDLE, value))
+            == ev.HGListener.CANCEL
+        ):
+            raise HGException("atom add vetoed by listener")
+        type_handle = self._resolve_type_handle(value, type_)
+
+        def run() -> HGHandle:
+            h = self.handles.make()
+            self._write_atom(h, type_handle, value, targets)
+            return h
+
+        h = self.txman.ensure_transaction(run)
+        self._after_commit(lambda: self._committed_mutation(
+            ev.HGAtomAddedEvent(h, value)))
+        return h
+
+    def _after_commit(self, fn: Callable[[], None]) -> None:
+        """Run ``fn`` now, or defer it to the enclosing transaction's commit
+        (so listeners never observe atoms that never commit)."""
+        tx = self.txman.current()
+        if tx is None:
+            fn()
+        else:
+            tx.on_commit.append(fn)
+
+    def _committed_mutation(self, event: ev.HGEvent, n: int = 1) -> None:
+        self._mutations += n
+        self.events.dispatch(self, event)
+
+    def _write_atom(
+        self,
+        h: HGHandle,
+        type_handle: HGHandle,
+        value: Any,
+        targets: Optional[tuple[int, ...]],
+    ) -> None:
+        """The write path of ``addNode``/``addLink`` (:1563/:1589): store the
+        value payload, the atom record, the system index entries and the
+        per-target incidence entries."""
+        atype = self.typesystem.get_type(type_handle)
+        if value is None and atype.name == "null":
+            value_handle = NULL_HANDLE
+        else:
+            value_handle = self.handles.make()
+            self.store.store_data(value_handle, atype.store(value))
+        flags = _FLAG_LINK if targets is not None else 0
+        record = (int(type_handle), int(value_handle), flags) + (targets or ())
+        self.store.store_link(h, record)
+        by_type = self.store.get_index(IDX_BY_TYPE)
+        by_type.add_entry(_type_key(type_handle), h)
+        by_value = self.store.get_index(IDX_BY_VALUE)
+        by_value.add_entry(atype.to_key(value), h)
+        if targets:
+            for t in targets:
+                self.store.add_incidence_link(t, h)
+        from hypergraphdb_tpu.indexing.manager import maybe_index
+
+        maybe_index(self, h, type_handle, value, targets)
+
+    def _add_type_atom(self, name: str) -> HGHandle:
+        """Bootstrap-time creation of a type atom; the top type atom is its
+        own type (the reference's Top, ``type/Top.java:25``)."""
+
+        def run() -> HGHandle:
+            h = self.handles.make()
+            if name == "top":
+                type_handle = h  # self-typed root
+            else:
+                type_handle = self.typesystem.handle_of("top")
+            top = self.typesystem.top
+            value_handle = self.handles.make()
+            self.store.store_data(value_handle, top.store(name))
+            record = (int(type_handle), int(value_handle), 0)
+            self.store.store_link(h, record)
+            self.store.get_index(IDX_BY_TYPE).add_entry(_type_key(type_handle), h)
+            self.store.get_index(IDX_BY_VALUE).add_entry(top.to_key(name), h)
+            return h
+
+        return self.txman.ensure_transaction(run)
+
+    # ------------------------------------------------------------------ get
+    def get(self, handle: HGHandle) -> Any:
+        """Load an atom's runtime value (``HyperGraph.get`` :784): links load
+        as ``HGLink``, nodes load as their bare value."""
+        h = int(handle)
+        # the shared cache holds committed state only: inside a transaction
+        # reads bypass it entirely (the tx overlay may shadow the committed
+        # value, and tx-local values must never leak into the cache)
+        in_tx = self.txman.current() is not None
+        if not in_tx and h in self._atom_cache:
+            self.stats.atom_accesses += 1
+            return self._atom_cache.get(h)
+        rec = self.store.get_link(h)
+        if rec is None:
+            raise NotFoundError(h)
+        type_handle, value_handle, flags = rec[0], rec[1], rec[2]
+        atype = self.typesystem.get_type(type_handle)
+        if value_handle == NULL_HANDLE:
+            value = None
+        else:
+            data = self.store.get_data(value_handle)
+            value = None if data is None else atype.make(data)
+        if flags & _FLAG_LINK:
+            value = HGLink(targets=tuple(rec[3:]), value=value)
+        if not in_tx:
+            self._atom_cache.put(h, value)
+        self.stats.atom_loads += 1
+        self.events.dispatch(self, ev.HGAtomLoadedEvent(h, value))
+        return value
+
+    def get_one(self, condition) -> Any:
+        h = self.find_one(condition)
+        return None if h is None else self.get(h)
+
+    def get_type_handle_of(self, handle: HGHandle) -> HGHandle:
+        rec = self.store.get_link(int(handle))
+        if rec is None:
+            raise NotFoundError(handle)
+        return rec[0]
+
+    def get_targets(self, handle: HGHandle) -> tuple[HGHandle, ...]:
+        rec = self.store.get_link(int(handle))
+        if rec is None:
+            raise NotFoundError(handle)
+        return tuple(rec[3:])
+
+    def arity(self, handle: HGHandle) -> int:
+        return len(self.get_targets(handle))
+
+    def is_link(self, handle: HGHandle) -> bool:
+        rec = self.store.get_link(int(handle))
+        if rec is None:
+            raise NotFoundError(handle)
+        return bool(rec[2] & _FLAG_LINK)
+
+    def contains(self, handle: HGHandle) -> bool:
+        return self.store.contains_link(int(handle))
+
+    # ------------------------------------------------------------------ replace
+    def replace(
+        self, handle: HGHandle, value: Any, type: Optional[Any] = None  # noqa: A002
+    ) -> None:
+        """Replace an atom's value in place, keeping identity and incidence
+        (``HyperGraph.replace`` semantics). Targets of links are immutable —
+        like the reference, changing structure means remove + add."""
+        h = int(handle)
+        if (
+            self.events.dispatch(self, ev.HGAtomReplaceRequestEvent(h, value))
+            == ev.HGListener.CANCEL
+        ):
+            raise HGException("atom replace vetoed by listener")
+
+        def run() -> None:
+            rec = self.store.get_link(h)
+            if rec is None:
+                raise NotFoundError(h)
+            old_type_handle, old_value_handle, flags = rec[0], rec[1], rec[2]
+            targets = tuple(rec[3:])
+            old_type = self.typesystem.get_type(old_type_handle)
+            if old_value_handle != NULL_HANDLE:
+                data = self.store.get_data(old_value_handle)
+                old_value = None if data is None else old_type.make(data)
+            else:
+                old_value = None
+            inner = value.value if isinstance(value, HGLink) else value
+            new_type_handle = self._resolve_type_handle(inner, type)
+            new_type = self.typesystem.get_type(new_type_handle)
+            # remove old value + index entries
+            by_value = self.store.get_index(IDX_BY_VALUE)
+            by_value.remove_entry(old_type.to_key(old_value), h)
+            if old_value_handle != NULL_HANDLE:
+                self.store.remove_data(old_value_handle)
+            if new_type_handle != old_type_handle:
+                by_type = self.store.get_index(IDX_BY_TYPE)
+                by_type.remove_entry(_type_key(old_type_handle), h)
+                by_type.add_entry(_type_key(new_type_handle), h)
+            # store new value
+            if inner is None and new_type.name == "null":
+                new_value_handle = NULL_HANDLE
+            else:
+                new_value_handle = self.handles.make()
+                self.store.store_data(new_value_handle, new_type.store(inner))
+            by_value.add_entry(new_type.to_key(inner), h)
+            record = (int(new_type_handle), int(new_value_handle), flags) + targets
+            self.store.store_link(h, record)
+            from hypergraphdb_tpu.indexing.manager import maybe_unindex, maybe_index
+
+            maybe_unindex(self, h, old_type_handle, old_value, targets or None)
+            maybe_index(self, h, new_type_handle, inner, targets or None)
+
+        self.txman.ensure_transaction(run)
+        self._atom_cache.invalidate(h)
+        self._after_commit(lambda: self._committed_mutation(
+            ev.HGAtomReplacedEvent(h, value)))
+
+    # ------------------------------------------------------------------ remove
+    def remove(self, handle: HGHandle, keep_incident_links: Optional[bool] = None) -> bool:
+        """Remove an atom (``HyperGraph.remove``). By default incident links
+        are removed recursively (reference default,
+        ``HGConfiguration.keepIncidentLinksOnRemoval=false``); with
+        ``keep_incident_links`` the atom is replaced by NULL in each
+        incident link's target list? — No: like the reference, the incident
+        links simply keep a dangling target cleared to null. We instead drop
+        the atom from incident links' target tuples."""
+        h = int(handle)
+        self._check_open()
+        if not self.store.contains_link(h):
+            return False
+        if self.typesystem.is_type_handle(h):
+            # the reference likewise refuses to remove a type atom in use
+            # (HGTypeSystem.remove checks instance/subtype indices)
+            raise HGException(
+                f"handle {h} is a registered type atom; types in use cannot "
+                "be removed"
+            )
+        if (
+            self.events.dispatch(self, ev.HGAtomRemoveRequestEvent(h))
+            == ev.HGListener.CANCEL
+        ):
+            return False
+        keep = (
+            self.config.keep_incident_links_on_removal
+            if keep_incident_links is None
+            else keep_incident_links
+        )
+
+        def run() -> None:
+            self._remove_rec(h, keep, set())
+
+        self.txman.ensure_transaction(run)
+        self._after_commit(lambda: self._committed_mutation(
+            ev.HGAtomRemovedEvent(h)))
+        return True
+
+    def _remove_rec(self, h: int, keep: bool, seen: set[int]) -> None:
+        if h in seen:
+            return
+        seen.add(h)
+        rec = self.store.get_link(h)
+        if rec is None:
+            return
+        type_handle, value_handle, flags = rec[0], rec[1], rec[2]
+        targets = tuple(rec[3:])
+        # incident links: either cascade-remove or rewrite their target lists
+        incident = self.store.get_incidence_set(h).array().tolist()
+        for link in incident:
+            if not keep:
+                self._remove_rec(int(link), keep, seen)
+            else:
+                link = int(link)
+                lrec = self.store.get_link(link)
+                if lrec is None:
+                    continue
+                old_targets = tuple(lrec[3:])
+                newt = tuple(t for t in old_targets if t != h)
+                # re-run user indexers: target positions shift
+                lvalue = self._load_value(lrec)
+                from hypergraphdb_tpu.indexing.manager import (
+                    maybe_index,
+                    maybe_unindex,
+                )
+
+                maybe_unindex(self, link, lrec[0], lvalue, old_targets)
+                self.store.store_link(link, lrec[:3] + newt)
+                maybe_index(self, link, lrec[0], lvalue, newt)
+                self._atom_cache.invalidate(link)
+        # de-index
+        atype = self.typesystem.get_type(type_handle)
+        if value_handle != NULL_HANDLE:
+            data = self.store.get_data(value_handle)
+            value = None if data is None else atype.make(data)
+            self.store.remove_data(value_handle)
+        else:
+            value = None
+        self.store.get_index(IDX_BY_TYPE).remove_entry(_type_key(type_handle), h)
+        self.store.get_index(IDX_BY_VALUE).remove_entry(atype.to_key(value), h)
+        from hypergraphdb_tpu.indexing.manager import maybe_unindex
+
+        maybe_unindex(self, h, type_handle, value, targets or None)
+        # un-link from target incidence sets
+        for t in targets:
+            self.store.remove_incidence_link(t, h)
+        self.store.remove_incidence_set(h)
+        self.store.remove_link(h)
+        self._atom_cache.invalidate(h)
+
+    def _load_value(self, rec: tuple) -> Any:
+        """Deserialize the bare value of a stored atom record."""
+        type_handle, value_handle = rec[0], rec[1]
+        atype = self.typesystem.get_type(type_handle)
+        if value_handle == NULL_HANDLE:
+            return None
+        data = self.store.get_data(value_handle)
+        return None if data is None else atype.make(data)
+
+    # ------------------------------------------------------------------ incidence
+    def get_incidence_set(self, handle: HGHandle) -> HGSortedResultSet:
+        """All links pointing at ``handle`` (``HyperGraph.getIncidenceSet``
+        :1415), sorted — the primitive BFS and joins build on."""
+        return self.store.get_incidence_set(int(handle))
+
+    # ------------------------------------------------------------------ queries
+    @staticmethod
+    def _compiler():
+        try:
+            from hypergraphdb_tpu.query.compiler import compile_query
+        except ImportError as e:  # pragma: no cover - build gating
+            raise HGException("query engine not available in this build") from e
+        return compile_query
+
+    def find_all(self, condition) -> list[HGHandle]:
+        return list(self._compiler()(self, condition).execute())
+
+    def find_one(self, condition) -> Optional[HGHandle]:
+        for h in self._compiler()(self, condition).execute():
+            return h
+        return None
+
+    def count(self, condition) -> int:
+        return self._compiler()(self, condition).count()
+
+    # ------------------------------------------------------------------ scans
+    def atoms(self) -> Iterator[HGHandle]:
+        """All atom handles, ascending (committed state)."""
+        ids, _, _ = self.backend.bulk_links()
+        tx = self.txman.current()
+        if tx is None:
+            yield from ids.tolist()
+            return
+        from hypergraphdb_tpu.tx.manager import _TOMBSTONE
+
+        # merge the whole tx chain, outermost first (inner shadows outer)
+        overlay: dict[int, Any] = {}
+        chain = []
+        t = tx
+        while t is not None:
+            chain.append(t)
+            t = t.parent
+        for t in reversed(chain):
+            overlay.update(t.links)
+        extra = {h for h, v in overlay.items() if v is not _TOMBSTONE}
+        dead = {h for h, v in overlay.items() if v is _TOMBSTONE}
+        merged = sorted((set(ids.tolist()) - dead) | extra)
+        yield from merged
+
+    def atom_count(self) -> int:
+        return sum(1 for _ in self.atoms())
+
+    # ------------------------------------------------------------------ bulk ingest
+    def add_nodes_bulk(self, values: Sequence[Any], type: Optional[Any] = None) -> range:  # noqa: A002
+        """Contiguous-id bulk node ingest (TPU fast path, no reference
+        analogue — dense ids make contiguous ranges valuable for CSR)."""
+
+        def run() -> range:
+            r = self.handles.make_many(len(values))
+            for h, v in zip(r, values):
+                th = self._resolve_type_handle(v, type)
+                self._write_atom(h, th, v, None)
+            return r
+
+        r = self.txman.ensure_transaction(run)
+        self._mutations += len(values)
+        return r
+
+    def add_links_bulk(
+        self,
+        target_lists: Sequence[Sequence[HGHandle]],
+        values: Optional[Sequence[Any]] = None,
+        type: Optional[Any] = None,  # noqa: A002
+    ) -> range:
+        def run() -> range:
+            r = self.handles.make_many(len(target_lists))
+            for i, (h, ts) in enumerate(zip(r, target_lists)):
+                v = values[i] if values is not None else None
+                th = self._resolve_type_handle(v, type)
+                self._write_atom(h, th, v, tuple(int(t) for t in ts))
+            return r
+
+        r = self.txman.ensure_transaction(run)
+        self._mutations += len(target_lists)
+        return r
+
+    # ------------------------------------------------------------------ device snapshot
+    def snapshot(self, refresh: bool = False):
+        """Pack (or return the cached) immutable device CSR snapshot — a
+        long-lived read transaction living in HBM (SURVEY §7)."""
+        try:
+            from hypergraphdb_tpu.ops.snapshot import CSRSnapshot
+        except ImportError as e:  # pragma: no cover - build gating
+            raise HGException("device snapshots not available in this build") from e
+
+        snap = self._snapshot_cache
+        if snap is not None and not refresh and snap.version == self._mutations:
+            return snap
+        snap = CSRSnapshot.pack(self, version=self._mutations)
+        self._snapshot_cache = snap
+        return snap
+
+    # ------------------------------------------------------------------ misc
+    def type_handle(self, name_or_class) -> HGHandle:
+        if isinstance(name_or_class, type):
+            t = self.typesystem.infer(name_or_class())  # pragma: no cover
+            return self.typesystem.handle_of(t.name)
+        return self.typesystem.handle_of(name_or_class)
+
+
+@dataclass
+class HGStats:
+    """Access counters (reference: ``atom/HGStats.java:20``)."""
+
+    atom_accesses: int = 0
+    atom_loads: int = 0
+
+
+def _type_key(type_handle: HGHandle) -> bytes:
+    from hypergraphdb_tpu.utils.ordered_bytes import encode_int
+
+    return encode_int(int(type_handle))

@@ -1,0 +1,308 @@
+"""HGStore — the transaction-aware store façade.
+
+Re-expression of the reference's ``HGStore`` (``core/src/java/org/hypergraphdb/
+HGStore.java:42-416``): the single object through which the graph kernel talks
+to storage — link records, value payloads, incidence sets and named indices —
+with every read/write routed through the current transaction's overlay
+(read-your-writes + commit-time validation, see ``tx/manager.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from hypergraphdb_tpu.core.handles import HGHandle
+from hypergraphdb_tpu.storage.api import (
+    HGBidirectionalIndex,
+    HGIndex,
+    HGSortedResultSet,
+    StorageBackend,
+)
+from hypergraphdb_tpu.tx.manager import (
+    _TOMBSTONE,
+    _IdxDelta,
+    _IncDelta,
+    HGTransactionManager,
+)
+
+
+class HGStore:
+    def __init__(self, backend: StorageBackend, txman: HGTransactionManager):
+        self.backend = backend
+        self.tx = txman
+
+    # ---- links --------------------------------------------------------------
+    def store_link(self, h: HGHandle, targets: Sequence[HGHandle]) -> None:
+        tx = self.tx.current()
+        if tx is None:
+            self.backend.store_link(h, targets)
+        else:
+            tx.links[int(h)] = tuple(int(t) for t in targets)
+
+    def get_link(self, h: HGHandle) -> Optional[tuple[HGHandle, ...]]:
+        h = int(h)
+        tx = self.tx.current()
+        while tx is not None:
+            if h in tx.links:
+                v = tx.links[h]
+                return None if v is _TOMBSTONE else v
+            tx = tx.parent
+        cur = self.tx.current()
+        if cur is not None:
+            cur.note_read(("link", h))
+        return self.backend.get_link(h)
+
+    def remove_link(self, h: HGHandle) -> None:
+        tx = self.tx.current()
+        if tx is None:
+            self.backend.remove_link(int(h))
+        else:
+            tx.links[int(h)] = _TOMBSTONE
+
+    def contains_link(self, h: HGHandle) -> bool:
+        return self.get_link(h) is not None
+
+    # ---- data ---------------------------------------------------------------
+    def store_data(self, h: HGHandle, data: bytes) -> None:
+        tx = self.tx.current()
+        if tx is None:
+            self.backend.store_data(int(h), data)
+        else:
+            tx.data[int(h)] = bytes(data)
+
+    def get_data(self, h: HGHandle) -> Optional[bytes]:
+        h = int(h)
+        tx = self.tx.current()
+        while tx is not None:
+            if h in tx.data:
+                v = tx.data[h]
+                return None if v is _TOMBSTONE else v
+            tx = tx.parent
+        cur = self.tx.current()
+        if cur is not None:
+            cur.note_read(("data", h))
+        return self.backend.get_data(h)
+
+    def remove_data(self, h: HGHandle) -> None:
+        tx = self.tx.current()
+        if tx is None:
+            self.backend.remove_data(int(h))
+        else:
+            tx.data[int(h)] = _TOMBSTONE
+
+    # ---- incidence ----------------------------------------------------------
+    def add_incidence_link(self, atom: HGHandle, link: HGHandle) -> None:
+        tx = self.tx.current()
+        if tx is None:
+            self.backend.add_incidence_link(int(atom), int(link))
+        else:
+            tx.inc.setdefault(int(atom), _IncDelta()).add(int(link))
+
+    def remove_incidence_link(self, atom: HGHandle, link: HGHandle) -> None:
+        tx = self.tx.current()
+        if tx is None:
+            self.backend.remove_incidence_link(int(atom), int(link))
+        else:
+            tx.inc.setdefault(int(atom), _IncDelta()).remove(int(link))
+
+    def remove_incidence_set(self, atom: HGHandle) -> None:
+        tx = self.tx.current()
+        if tx is None:
+            self.backend.remove_incidence_set(int(atom))
+        else:
+            tx.inc.setdefault(int(atom), _IncDelta()).clear()
+
+    def get_incidence_set(self, atom: HGHandle) -> HGSortedResultSet:
+        atom = int(atom)
+        tx = self.tx.current()
+        if tx is not None:
+            tx.note_read(("inc", atom))
+        base = self.backend.get_incidence_set(atom).array()
+        # merge overlay deltas, innermost-last
+        deltas: list[_IncDelta] = []
+        t = tx
+        while t is not None:
+            d = t.inc.get(atom)
+            if d is not None:
+                deltas.append(d)
+            t = t.parent
+        if not deltas:
+            return HGSortedResultSet(base)
+        added: set[int] = set()
+        removed: set[int] = set()
+        cleared = False
+        for d in reversed(deltas):  # outermost first
+            if d.cleared:
+                cleared, added, removed = True, set(), set()
+            added |= d.added
+            added -= d.removed
+            removed |= d.removed
+            removed -= d.added
+        vals = set() if cleared else set(base.tolist())
+        vals -= removed
+        vals |= added
+        return HGSortedResultSet(np.asarray(sorted(vals), dtype=np.int64))
+
+    def incidence_count(self, atom: HGHandle) -> int:
+        return len(self.get_incidence_set(atom))
+
+    # ---- indices ------------------------------------------------------------
+    def get_index(self, name: str, create: bool = True) -> Optional["TxIndexView"]:
+        idx = self.backend.get_index(name, create=create)
+        if idx is None:
+            return None
+        return TxIndexView(self, name, idx)
+
+    def remove_index(self, name: str) -> None:
+        self.backend.remove_index(name)
+
+    def index_names(self) -> list[str]:
+        return self.backend.index_names()
+
+
+class TxIndexView(HGBidirectionalIndex):
+    """Transaction-aware view over a backend index."""
+
+    def __init__(self, store: HGStore, name: str, backing: HGBidirectionalIndex):
+        self.name = name
+        self._store = store
+        self._backing = backing
+
+    def _tx(self):
+        return self._store.tx.current()
+
+    def add_entry(self, key: bytes, value: HGHandle) -> None:
+        tx = self._tx()
+        if tx is None:
+            self._backing.add_entry(key, int(value))
+        else:
+            tx.idx.setdefault((self.name, bytes(key)), _IdxDelta()).add(int(value))
+
+    def remove_entry(self, key: bytes, value: HGHandle) -> None:
+        tx = self._tx()
+        if tx is None:
+            self._backing.remove_entry(key, int(value))
+        else:
+            tx.idx.setdefault((self.name, bytes(key)), _IdxDelta()).remove(int(value))
+
+    def remove_all_entries(self, key: bytes) -> None:
+        tx = self._tx()
+        if tx is None:
+            self._backing.remove_all_entries(key)
+        else:
+            d = tx.idx.setdefault((self.name, bytes(key)), _IdxDelta())
+            d.added.clear()
+            d.removed.clear()
+            d.removed_all = True
+
+    def _deltas_for(self, key: bytes) -> list[_IdxDelta]:
+        out = []
+        t = self._tx()
+        while t is not None:
+            d = t.idx.get((self.name, key))
+            if d is not None:
+                out.append(d)
+            t = t.parent
+        return out
+
+    def find(self, key: bytes) -> HGSortedResultSet:
+        key = bytes(key)
+        tx = self._tx()
+        if tx is not None:
+            tx.note_read(("idx", self.name, key))
+        base = self._backing.find(key).array()
+        deltas = self._deltas_for(key)
+        if not deltas:
+            return HGSortedResultSet(base)
+        vals: set[int] = set()
+        wiped = False
+        added: set[int] = set()
+        removed: set[int] = set()
+        for d in reversed(deltas):
+            if d.removed_all:
+                wiped, added, removed = True, set(), set()
+            added |= d.added
+            added -= d.removed
+            removed |= d.removed
+            removed -= d.added
+        vals = set() if wiped else set(base.tolist())
+        vals -= removed
+        vals |= added
+        return HGSortedResultSet(np.asarray(sorted(vals), dtype=np.int64))
+
+    def key_count(self) -> int:
+        return self._backing.key_count()
+
+    def scan_keys(self):
+        # any key the tx chain touched (adds OR removes) must be re-checked
+        # against the merged view; untouched keys pass through unchanged
+        touched = set()
+        t = self._tx()
+        while t is not None:
+            for (nm, k), d in t.idx.items():
+                if nm == self.name and (d.added or d.removed or d.removed_all):
+                    touched.add(k)
+            t = t.parent
+        if not touched:
+            yield from self._backing.scan_keys()
+            return
+        seen = set()
+        for k in self._backing.scan_keys():
+            seen.add(k)
+            if k not in touched or len(self.find(k)):
+                yield k
+        for k in sorted(touched - seen):
+            if len(self.find(k)):
+                yield k
+
+    def find_range(
+        self,
+        lo: Optional[bytes] = None,
+        hi: Optional[bytes] = None,
+        lo_inclusive: bool = True,
+        hi_inclusive: bool = False,
+    ) -> HGSortedResultSet:
+        base = self._backing.find_range(lo, hi, lo_inclusive, hi_inclusive).array()
+        tx = self._tx()
+        if tx is None:
+            return HGSortedResultSet(base)
+
+        def in_range(k: bytes) -> bool:
+            if lo is not None and (k < lo or (k == lo and not lo_inclusive)):
+                return False
+            if hi is not None and (k > hi or (k == hi and not hi_inclusive)):
+                return False
+            return True
+
+        touched: set[bytes] = set()
+        t = tx
+        while t is not None:
+            for (nm, k) in t.idx:
+                if nm == self.name and in_range(k):
+                    touched.add(k)
+            t = t.parent
+        if not touched:
+            return HGSortedResultSet(base)
+        vals = set(base.tolist())
+        for k in touched:
+            committed = set(self._backing.find(k).array().tolist())
+            merged = set(self.find(k).array().tolist())
+            vals -= committed - merged
+            vals |= merged
+        return HGSortedResultSet(np.asarray(sorted(vals), dtype=np.int64))
+
+    def find_by_value(self, value: HGHandle) -> list[bytes]:
+        keys = set(self._backing.find_by_value(int(value)))
+        t = self._tx()
+        while t is not None:
+            for (nm, k), d in t.idx.items():
+                if nm != self.name:
+                    continue
+                if int(value) in d.added:
+                    keys.add(k)
+                elif int(value) in d.removed or d.removed_all:
+                    keys.discard(k)
+            t = t.parent
+        return sorted(keys)

@@ -1,0 +1,240 @@
+"""Index manager: user-registered indexers maintained on every mutation.
+
+Re-expression of the reference's ``HGIndexManager``
+(``core/.../indexing/HGIndexManager.java:62-215`` — register/unregister +
+``maybeIndex`` called from the add path at ``HyperGraph.java:1618``) and the
+``HGIndexer`` family (``ByPartIndexer``, ``ByTargetIndexer``,
+``DirectValueIndexer``, ``CompositeIndexer``, ``LinkIndexer``,
+``TargetToTargetIndexer`` — SURVEY §2.1 Indexing framework).
+
+An indexer projects an (atom, type, value, targets) tuple to zero or more
+(key, value) entries in a named storage index. Registration is per type
+handle; ``maybe_index`` fires only for atoms of that type (or its subtypes).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+from hypergraphdb_tpu.core.handles import HGHandle
+from hypergraphdb_tpu.utils.ordered_bytes import encode_int
+
+
+class HGIndexer:
+    """SPI: project an atom into index entries (``HGKeyIndexer`` analogue)."""
+
+    #: storage index name; must be unique
+    name: str
+    #: type handle this indexer applies to
+    type_handle: HGHandle
+
+    def keys(
+        self, graph, h: HGHandle, value: Any, targets: Optional[Sequence[HGHandle]]
+    ) -> list[bytes]:
+        raise NotImplementedError
+
+    def values(
+        self, graph, h: HGHandle, value: Any, targets: Optional[Sequence[HGHandle]]
+    ) -> list[HGHandle]:
+        """Indexed values; default: the atom handle itself."""
+        return [h]
+
+
+class ByPartIndexer(HGIndexer):
+    """Index atoms of a record type by a projection path
+    (``indexing/ByPartIndexer.java``)."""
+
+    def __init__(self, name: str, type_handle: HGHandle, dimension: str):
+        self.name = name
+        self.type_handle = int(type_handle)
+        self.dimension = dimension
+
+    def keys(self, graph, h, value, targets):
+        atype = graph.typesystem.get_type(self.type_handle)
+        part = atype.project(value, self.dimension)
+        if part is None:
+            return []
+        pt = graph.typesystem.infer(part)
+        if pt is None:
+            return []
+        return [pt.to_key(part)]
+
+
+class ByTargetIndexer(HGIndexer):
+    """Index links by the target at a fixed position
+    (``indexing/ByTargetIndexer.java``)."""
+
+    def __init__(self, name: str, type_handle: HGHandle, position: int):
+        self.name = name
+        self.type_handle = int(type_handle)
+        self.position = position
+
+    def keys(self, graph, h, value, targets):
+        if targets is None or self.position >= len(targets):
+            return []
+        return [encode_int(int(targets[self.position]))]
+
+
+class DirectValueIndexer(HGIndexer):
+    """Index atoms by their full value key (``DirectValueIndexer.java``)."""
+
+    def __init__(self, name: str, type_handle: HGHandle):
+        self.name = name
+        self.type_handle = int(type_handle)
+
+    def keys(self, graph, h, value, targets):
+        atype = graph.typesystem.get_type(self.type_handle)
+        return [atype.to_key(value)]
+
+
+class CompositeIndexer(HGIndexer):
+    """Concatenation of several indexers' keys (``CompositeIndexer.java``)."""
+
+    def __init__(self, name: str, type_handle: HGHandle, parts: Sequence[HGIndexer]):
+        self.name = name
+        self.type_handle = int(type_handle)
+        self.parts = list(parts)
+
+    def keys(self, graph, h, value, targets):
+        parts = []
+        for p in self.parts:
+            ks = p.keys(graph, h, value, targets)
+            if not ks:
+                return []
+            parts.append(ks[0])
+        return [b"\x00".join(parts)]
+
+
+class TargetToTargetIndexer(HGIndexer):
+    """Bidirectional target→target index over links of a type
+    (``TargetToTargetIndexer.java``): key = target at ``key_pos``, value =
+    target at ``value_pos``."""
+
+    def __init__(self, name: str, type_handle: HGHandle, key_pos: int, value_pos: int):
+        self.name = name
+        self.type_handle = int(type_handle)
+        self.key_pos = key_pos
+        self.value_pos = value_pos
+
+    def keys(self, graph, h, value, targets):
+        if targets is None or max(self.key_pos, self.value_pos) >= len(targets):
+            return []
+        return [encode_int(int(targets[self.key_pos]))]
+
+    def values(self, graph, h, value, targets):
+        if targets is None or max(self.key_pos, self.value_pos) >= len(targets):
+            return []
+        return [int(targets[self.value_pos])]
+
+
+# -- registration + hooks ------------------------------------------------------
+
+def register(graph, indexer: HGIndexer, populate: bool = True) -> None:
+    """Register and (optionally) build the index over existing atoms — the
+    online equivalent of the reference's offline ``ApplyNewIndexer``
+    maintenance op (``maintenance/ApplyNewIndexer.java:36``)."""
+    reg = _registry(graph)
+    reg.setdefault(int(indexer.type_handle), []).append(indexer)
+    if populate:
+        rebuild(graph, indexer)
+
+
+def unregister(graph, indexer_name: str) -> None:
+    reg = _registry(graph)
+    for th, idxs in list(reg.items()):
+        reg[th] = [ix for ix in idxs if ix.name != indexer_name]
+        if not reg[th]:
+            del reg[th]
+    graph.store.remove_index(_storage_name(indexer_name))
+
+
+def indexers_of(graph, type_handle: HGHandle) -> list[HGIndexer]:
+    """All indexers applying to a type, including via supertype registration."""
+    reg = _registry(graph)
+    out = list(reg.get(int(type_handle), ()))
+    try:
+        name = graph.typesystem.name_of(type_handle)
+    except KeyError:
+        return out
+    for sup in graph.typesystem.supertypes_of(name):
+        try:
+            sh = graph.typesystem.handle_of(sup)
+        except Exception:
+            continue
+        out.extend(reg.get(int(sh), ()))
+    return out
+
+
+def get_index(graph, indexer_name: str):
+    """The queryable storage index for a registered indexer."""
+    return graph.store.get_index(_storage_name(indexer_name), create=True)
+
+
+def rebuild(graph, indexer: HGIndexer, batch: int = 1024) -> int:
+    """(Re)build an index from scratch in batches (resumable maintenance —
+    ``ApplyNewIndexer`` used batch=100 with a lastProcessed cursor)."""
+    idx = get_index(graph, indexer.name)
+    n = 0
+    applicable = {int(indexer.type_handle)}
+    try:
+        tname = graph.typesystem.name_of(indexer.type_handle)
+        for sub in graph.typesystem.subtypes_closure(tname):
+            applicable.add(int(graph.typesystem.handle_of(sub)))
+    except KeyError:
+        pass
+    for h in graph.atoms():
+        rec = graph.store.get_link(h)
+        if rec is None or int(rec[0]) not in applicable:
+            continue
+        value = graph.get(h)
+        targets = None
+        from hypergraphdb_tpu.core.graph import HGLink
+
+        if isinstance(value, HGLink):
+            targets = value.targets
+            value = value.value
+        for key in indexer.keys(graph, h, value, targets):
+            for v in indexer.values(graph, h, value, targets):
+                idx.add_entry(key, v)
+        n += 1
+    return n
+
+
+def maybe_index(
+    graph,
+    h: HGHandle,
+    type_handle: HGHandle,
+    value: Any,
+    targets: Optional[Sequence[HGHandle]],
+) -> None:
+    """Called from the kernel's add path (``HyperGraph.java:1618``)."""
+    for indexer in indexers_of(graph, type_handle):
+        idx = get_index(graph, indexer.name)
+        for key in indexer.keys(graph, h, value, targets):
+            for v in indexer.values(graph, h, value, targets):
+                idx.add_entry(key, v)
+
+
+def maybe_unindex(
+    graph,
+    h: HGHandle,
+    type_handle: HGHandle,
+    value: Any,
+    targets: Optional[Sequence[HGHandle]],
+) -> None:
+    for indexer in indexers_of(graph, type_handle):
+        idx = get_index(graph, indexer.name)
+        for key in indexer.keys(graph, h, value, targets):
+            for v in indexer.values(graph, h, value, targets):
+                idx.remove_entry(key, v)
+
+
+def _registry(graph) -> dict[int, list[HGIndexer]]:
+    reg = getattr(graph, "_indexer_registry", None)
+    if reg is None:
+        reg = graph._indexer_registry = {}
+    return reg
+
+
+def _storage_name(indexer_name: str) -> str:
+    return f"hg.user.{indexer_name}"

@@ -1,0 +1,222 @@
+"""Storage SPI — the contract every backend implements.
+
+Re-expression of the reference's ``HGStoreImplementation``
+(``core/src/java/org/hypergraphdb/storage/HGStoreImplementation.java:27-188``)
+and its index family ``HGIndex``/``HGBidirectionalIndex``/``HGSortIndex``
+(``storage/HGIndex.java:26``), redesigned for the TPU build:
+
+- handles are dense ``int`` ids (see ``core/handles.py``),
+- index keys are **order-preserving bytes** (see ``utils/ordered_bytes.py``)
+  so memcmp is the single comparator,
+- backends hold *committed state only* — transaction buffering, validation
+  and commit application live above in ``tx/`` (the reference instead
+  delegates transactions to each backend, ``HGStoreImplementation.java:40``;
+  lifting them out keeps native backends dumb and fast),
+- every read that feeds the device plane can be produced in bulk as numpy
+  arrays (``bulk_*`` methods) — that is the CSR-pack fast path.
+
+A ``StorageBackend`` is single-writer: the transaction manager serializes
+commit application. Readers may run concurrently with a writer only through
+the façade's versioning (see ``tx/manager.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, Optional, Sequence
+
+import numpy as np
+
+from hypergraphdb_tpu.core.handles import HGHandle
+
+
+class HGSortedResultSet:
+    """A sorted, random-access result over int64 handles.
+
+    Host analogue of ``HGRandomAccessResult`` (``storage/HGRandomAccessResult.java:22``):
+    ``go_to`` is the primitive the zig-zag/leapfrog join relies on. Backed by
+    a sorted numpy array; device kernels consume ``array()`` directly.
+    """
+
+    __slots__ = ("_a",)
+
+    def __init__(self, sorted_array: np.ndarray):
+        self._a = np.asarray(sorted_array, dtype=np.int64)
+
+    def array(self) -> np.ndarray:
+        return self._a
+
+    def __len__(self) -> int:
+        return len(self._a)
+
+    def __iter__(self) -> Iterator[int]:
+        return iter(self._a.tolist())
+
+    def __contains__(self, h: int) -> bool:
+        i = np.searchsorted(self._a, h)
+        return i < len(self._a) and self._a[i] == h
+
+    def go_to(self, h: int, exact: bool = True) -> int:
+        """Position at first element >= h; returns index or -1 (exact miss)."""
+        i = int(np.searchsorted(self._a, h))
+        if exact:
+            if i < len(self._a) and self._a[i] == h:
+                return i
+            return -1
+        return i if i < len(self._a) else -1
+
+    EMPTY: "HGSortedResultSet"
+
+
+HGSortedResultSet.EMPTY = HGSortedResultSet(np.empty(0, dtype=np.int64))
+
+
+class HGIndex:
+    """Named sorted index: bytes key → sorted set of int64 values.
+
+    Contract of ``HGIndex.java:26`` (addEntry/removeEntry/findFirst/find/
+    count/scanKeys/scanValues) plus ``HGSortIndex`` range operations
+    (findLT/findGT/findLTE/findGTE) — ranges work because keys are
+    order-preserving bytes.
+    """
+
+    name: str
+
+    def add_entry(self, key: bytes, value: HGHandle) -> None:
+        raise NotImplementedError
+
+    def remove_entry(self, key: bytes, value: HGHandle) -> None:
+        raise NotImplementedError
+
+    def remove_all_entries(self, key: bytes) -> None:
+        raise NotImplementedError
+
+    def find(self, key: bytes) -> HGSortedResultSet:
+        raise NotImplementedError
+
+    def find_first(self, key: bytes) -> Optional[HGHandle]:
+        rs = self.find(key)
+        return int(rs.array()[0]) if len(rs) else None
+
+    def count(self, key: bytes) -> int:
+        return len(self.find(key))
+
+    def key_count(self) -> int:
+        raise NotImplementedError
+
+    def scan_keys(self) -> Iterator[bytes]:
+        raise NotImplementedError
+
+    def scan_values(self) -> Iterator[HGHandle]:
+        for k in self.scan_keys():
+            yield from self.find(k)
+
+    # range queries (HGSortIndex semantics)
+    def find_range(
+        self,
+        lo: Optional[bytes] = None,
+        hi: Optional[bytes] = None,
+        lo_inclusive: bool = True,
+        hi_inclusive: bool = False,
+    ) -> HGSortedResultSet:
+        raise NotImplementedError
+
+    def find_lt(self, key: bytes) -> HGSortedResultSet:
+        return self.find_range(hi=key, hi_inclusive=False)
+
+    def find_lte(self, key: bytes) -> HGSortedResultSet:
+        return self.find_range(hi=key, hi_inclusive=True)
+
+    def find_gt(self, key: bytes) -> HGSortedResultSet:
+        return self.find_range(lo=key, lo_inclusive=False)
+
+    def find_gte(self, key: bytes) -> HGSortedResultSet:
+        return self.find_range(lo=key, lo_inclusive=True)
+
+
+class HGBidirectionalIndex(HGIndex):
+    """Adds value → keys lookup (``storage/HGBidirectionalIndex.java``)."""
+
+    def find_by_value(self, value: HGHandle) -> list[bytes]:
+        raise NotImplementedError
+
+    def count_keys(self, value: HGHandle) -> int:
+        return len(self.find_by_value(value))
+
+
+class StorageBackend:
+    """Committed-state store: links, data payloads, incidence, named indices.
+
+    Mirrors ``HGStoreImplementation.java:27-188`` minus transaction factory
+    (lifted into ``tx/``). All mutation methods are called only by the
+    transaction manager during commit application.
+    """
+
+    # -- lifecycle ----------------------------------------------------------
+    def startup(self) -> None: ...
+    def shutdown(self) -> None: ...
+
+    def checkpoint(self) -> None:
+        """Flush to durable media (no-op for memory)."""
+
+    # -- link store: handle → ordered tuple of target handles ---------------
+    def store_link(self, h: HGHandle, targets: Sequence[HGHandle]) -> None:
+        raise NotImplementedError
+
+    def get_link(self, h: HGHandle) -> Optional[tuple[HGHandle, ...]]:
+        raise NotImplementedError
+
+    def remove_link(self, h: HGHandle) -> None:
+        raise NotImplementedError
+
+    def contains_link(self, h: HGHandle) -> bool:
+        return self.get_link(h) is not None
+
+    # -- data store: handle → bytes -----------------------------------------
+    def store_data(self, h: HGHandle, data: bytes) -> None:
+        raise NotImplementedError
+
+    def get_data(self, h: HGHandle) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def remove_data(self, h: HGHandle) -> None:
+        raise NotImplementedError
+
+    def contains_data(self, h: HGHandle) -> bool:
+        return self.get_data(h) is not None
+
+    # -- incidence: atom → sorted set of link handles -------------------------
+    def add_incidence_link(self, atom: HGHandle, link: HGHandle) -> None:
+        raise NotImplementedError
+
+    def remove_incidence_link(self, atom: HGHandle, link: HGHandle) -> None:
+        raise NotImplementedError
+
+    def remove_incidence_set(self, atom: HGHandle) -> None:
+        raise NotImplementedError
+
+    def get_incidence_set(self, atom: HGHandle) -> HGSortedResultSet:
+        raise NotImplementedError
+
+    def incidence_count(self, atom: HGHandle) -> int:
+        return len(self.get_incidence_set(atom))
+
+    # -- named indices --------------------------------------------------------
+    def get_index(self, name: str, create: bool = True) -> Optional[HGBidirectionalIndex]:
+        raise NotImplementedError
+
+    def remove_index(self, name: str) -> None:
+        raise NotImplementedError
+
+    def index_names(self) -> list[str]:
+        raise NotImplementedError
+
+    # -- bulk access for CSR packing (TPU fast path; no reference analogue) --
+    def bulk_links(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Return (link_ids, target_offsets, flat_targets) over ALL links,
+        link_ids ascending. ``flat_targets[target_offsets[i]:target_offsets[i+1]]``
+        are the ordered targets of ``link_ids[i]``."""
+        raise NotImplementedError
+
+    def max_handle(self) -> int:
+        """Upper bound (exclusive) on any handle present in the store."""
+        raise NotImplementedError

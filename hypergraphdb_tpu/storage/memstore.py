@@ -1,0 +1,221 @@
+"""In-memory columnar storage backend — the default.
+
+The host-plane analogue of the reference's default bdb-je backend
+(``storage/bdb-je/.../BJEStorageImplementation.java:46-48`` with its three
+B-tree DBs: datadb / primitivedb / incidencedb). Here the same three stores
+are plain dicts + sorted containers, because (a) the hot read paths are
+served from immutable CSR device snapshots, not from this store, and (b)
+durability comes from the write-ahead log in the native backend
+(``storage/native.py``), not from this one.
+
+Incidence sets and index value-sets keep a *sorted numpy snapshot* cache so
+repeated reads (CSR packing, zig-zag joins) are O(1) after first touch.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+from sortedcontainers import SortedDict, SortedList
+
+from hypergraphdb_tpu.core.handles import HGHandle
+from hypergraphdb_tpu.storage.api import (
+    HGBidirectionalIndex,
+    HGSortedResultSet,
+    StorageBackend,
+)
+
+
+class _SortedHandleSet:
+    """Mutable sorted set of int64 handles with a cached numpy snapshot."""
+
+    __slots__ = ("_sl", "_snap")
+
+    def __init__(self) -> None:
+        self._sl = SortedList()
+        self._snap: Optional[np.ndarray] = None
+
+    def add(self, h: int) -> None:
+        if h not in self._sl:
+            self._sl.add(h)
+            self._snap = None
+
+    def discard(self, h: int) -> None:
+        try:
+            self._sl.remove(h)
+            self._snap = None
+        except ValueError:
+            pass
+
+    def snapshot(self) -> np.ndarray:
+        if self._snap is None:
+            self._snap = np.fromiter(self._sl, dtype=np.int64, count=len(self._sl))
+        return self._snap
+
+    def __len__(self) -> int:
+        return len(self._sl)
+
+    def __contains__(self, h: int) -> bool:
+        return h in self._sl
+
+
+class MemIndex(HGBidirectionalIndex):
+    """Sorted-dict index: bytes key → sorted handle set, plus inverse map."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._kv: SortedDict = SortedDict()          # bytes -> _SortedHandleSet
+        self._vk: dict[int, set[bytes]] = {}         # handle -> keys
+
+    def add_entry(self, key: bytes, value: HGHandle) -> None:
+        s = self._kv.get(key)
+        if s is None:
+            s = self._kv[key] = _SortedHandleSet()
+        s.add(value)
+        self._vk.setdefault(value, set()).add(key)
+
+    def remove_entry(self, key: bytes, value: HGHandle) -> None:
+        s = self._kv.get(key)
+        if s is not None:
+            s.discard(value)
+            if not len(s):
+                del self._kv[key]
+        ks = self._vk.get(value)
+        if ks is not None:
+            ks.discard(key)
+            if not ks:
+                del self._vk[value]
+
+    def remove_all_entries(self, key: bytes) -> None:
+        s = self._kv.pop(key, None)
+        if s is not None:
+            for v in s.snapshot().tolist():
+                ks = self._vk.get(v)
+                if ks is not None:
+                    ks.discard(key)
+                    if not ks:
+                        del self._vk[v]
+
+    def find(self, key: bytes) -> HGSortedResultSet:
+        s = self._kv.get(key)
+        if s is None:
+            return HGSortedResultSet.EMPTY
+        return HGSortedResultSet(s.snapshot())
+
+    def key_count(self) -> int:
+        return len(self._kv)
+
+    def scan_keys(self) -> Iterator[bytes]:
+        return iter(self._kv.keys())
+
+    def find_range(
+        self,
+        lo: Optional[bytes] = None,
+        hi: Optional[bytes] = None,
+        lo_inclusive: bool = True,
+        hi_inclusive: bool = False,
+    ) -> HGSortedResultSet:
+        keys = self._kv.irange(lo, hi, (lo_inclusive, hi_inclusive))
+        parts = [self._kv[k].snapshot() for k in keys]
+        if not parts:
+            return HGSortedResultSet.EMPTY
+        merged = np.unique(np.concatenate(parts))
+        return HGSortedResultSet(merged)
+
+    def find_by_value(self, value: HGHandle) -> list[bytes]:
+        return sorted(self._vk.get(value, ()))
+
+
+class MemStorage(StorageBackend):
+    def __init__(self) -> None:
+        self._links: dict[int, tuple[int, ...]] = {}
+        self._data: dict[int, bytes] = {}
+        self._incidence: dict[int, _SortedHandleSet] = {}
+        self._indices: dict[str, MemIndex] = {}
+
+    # -- lifecycle ----------------------------------------------------------
+    def startup(self) -> None:
+        pass
+
+    def shutdown(self) -> None:
+        pass
+
+    # -- links ---------------------------------------------------------------
+    def store_link(self, h: HGHandle, targets: Sequence[HGHandle]) -> None:
+        self._links[h] = tuple(int(t) for t in targets)
+
+    def get_link(self, h: HGHandle) -> Optional[tuple[HGHandle, ...]]:
+        return self._links.get(h)
+
+    def remove_link(self, h: HGHandle) -> None:
+        self._links.pop(h, None)
+
+    # -- data ------------------------------------------------------------------
+    def store_data(self, h: HGHandle, data: bytes) -> None:
+        self._data[h] = bytes(data)
+
+    def get_data(self, h: HGHandle) -> Optional[bytes]:
+        return self._data.get(h)
+
+    def remove_data(self, h: HGHandle) -> None:
+        self._data.pop(h, None)
+
+    # -- incidence -------------------------------------------------------------
+    def add_incidence_link(self, atom: HGHandle, link: HGHandle) -> None:
+        s = self._incidence.get(atom)
+        if s is None:
+            s = self._incidence[atom] = _SortedHandleSet()
+        s.add(link)
+
+    def remove_incidence_link(self, atom: HGHandle, link: HGHandle) -> None:
+        s = self._incidence.get(atom)
+        if s is not None:
+            s.discard(link)
+            if not len(s):
+                del self._incidence[atom]
+
+    def remove_incidence_set(self, atom: HGHandle) -> None:
+        self._incidence.pop(atom, None)
+
+    def get_incidence_set(self, atom: HGHandle) -> HGSortedResultSet:
+        s = self._incidence.get(atom)
+        if s is None:
+            return HGSortedResultSet.EMPTY
+        return HGSortedResultSet(s.snapshot())
+
+    # -- indices -----------------------------------------------------------------
+    def get_index(self, name: str, create: bool = True) -> Optional[MemIndex]:
+        idx = self._indices.get(name)
+        if idx is None and create:
+            idx = self._indices[name] = MemIndex(name)
+        return idx
+
+    def remove_index(self, name: str) -> None:
+        self._indices.pop(name, None)
+
+    def index_names(self) -> list[str]:
+        return sorted(self._indices)
+
+    # -- bulk --------------------------------------------------------------------
+    def bulk_links(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        ids = np.fromiter(sorted(self._links), dtype=np.int64, count=len(self._links))
+        lengths = np.fromiter(
+            (len(self._links[int(i)]) for i in ids), dtype=np.int64, count=len(ids)
+        )
+        offsets = np.zeros(len(ids) + 1, dtype=np.int64)
+        np.cumsum(lengths, out=offsets[1:])
+        flat = np.empty(int(offsets[-1]), dtype=np.int64)
+        for j, i in enumerate(ids.tolist()):
+            flat[offsets[j] : offsets[j + 1]] = self._links[i]
+        return ids, offsets, flat
+
+    def max_handle(self) -> int:
+        m = -1
+        if self._links:
+            m = max(m, max(self._links))
+        if self._data:
+            m = max(m, max(self._data))
+        if self._incidence:
+            m = max(m, max(self._incidence))
+        return m + 1

@@ -1,0 +1,278 @@
+"""MVCC/optimistic transactions over a storage backend.
+
+Re-expression of the reference's JVSTM-derived STM layer
+(``core/src/java/org/hypergraphdb/transaction/``): versioned cells with
+commit-time read-set validation under a global commit lock
+(``HGTransaction.java:96-108`` validation, commit ``:202``,
+``HGTransactionManager.java:35-38`` COMMIT_LOCK) and the retry-on-conflict
+``transact()`` loop (``HGTransactionManager.java:356-418``).
+
+Design differences, deliberate:
+
+- The reference pushes transactions *down* into each backend (BDB txns +
+  ``VBox`` STM cells above them). Here the backend holds committed state
+  only and ALL buffering/validation happens in this layer, so backends —
+  including the C++ native store — stay dumb data structures.
+- Cells are logical, coarse: ``("link", h)``, ``("data", h)``,
+  ``("inc", atom)``, ``("idx", name, key)``. A transaction records the
+  version of every cell it reads; commit validates those versions under the
+  lock (optimistic concurrency = the reference's conflict semantics), then
+  applies buffered writes and bumps written cells.
+- Long-lived *consistent* reads are served by the device plane: an immutable
+  CSR snapshot IS a long-lived read transaction (SURVEY §7 design stance).
+  Host-side reads inside a transaction see committed-state + own writes.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional, Sequence, TypeVar
+
+import numpy as np
+
+from hypergraphdb_tpu.core.errors import TransactionAborted, TransactionConflict
+from hypergraphdb_tpu.core.handles import HGHandle
+from hypergraphdb_tpu.storage.api import HGSortedResultSet, StorageBackend
+
+T = TypeVar("T")
+
+_TOMBSTONE = object()
+
+
+class _IncDelta:
+    __slots__ = ("added", "removed", "cleared")
+
+    def __init__(self) -> None:
+        self.added: set[int] = set()
+        self.removed: set[int] = set()
+        self.cleared = False
+
+    def add(self, link: int) -> None:
+        self.removed.discard(link)
+        self.added.add(link)
+
+    def remove(self, link: int) -> None:
+        self.added.discard(link)
+        self.removed.add(link)
+
+    def clear(self) -> None:
+        self.added.clear()
+        self.removed.clear()
+        self.cleared = True
+
+
+class _IdxDelta:
+    __slots__ = ("added", "removed", "removed_all")
+
+    def __init__(self) -> None:
+        self.added: set[int] = set()
+        self.removed: set[int] = set()
+        self.removed_all = False
+
+    def add(self, v: int) -> None:
+        self.removed.discard(v)
+        self.added.add(v)
+
+    def remove(self, v: int) -> None:
+        self.added.discard(v)
+        self.removed.add(v)
+
+
+class HGTransaction:
+    """A single (possibly nested) transaction's buffered state."""
+
+    def __init__(self, mgr: "HGTransactionManager", parent: Optional["HGTransaction"],
+                 readonly: bool = False):
+        self.mgr = mgr
+        self.parent = parent
+        self.readonly = readonly
+        self.active = True
+        # cell -> observed version
+        self.read_set: dict[tuple, int] = {}
+        # write buffers
+        self.links: dict[int, Any] = {}            # h -> tuple | _TOMBSTONE
+        self.data: dict[int, Any] = {}             # h -> bytes | _TOMBSTONE
+        self.inc: dict[int, _IncDelta] = {}        # atom -> delta
+        self.idx: dict[tuple[str, bytes], _IdxDelta] = {}
+        # actions deferred until (and discarded unless) top-level commit —
+        # post-commit event dispatch, mutation counters (the reference fires
+        # events synchronously inside the tx; deferring keeps listeners from
+        # observing atoms that never commit)
+        self.on_commit: list[Callable[[], None]] = []
+
+    # -- read-set tracking ---------------------------------------------------
+    def note_read(self, cell: tuple) -> None:
+        if not self.readonly:
+            self.read_set.setdefault(cell, self.mgr.cell_version(cell))
+
+    def is_empty(self) -> bool:
+        return not (self.links or self.data or self.inc or self.idx)
+
+    # -- merge into parent (nested commit) ------------------------------------
+    def merge_into(self, p: "HGTransaction") -> None:
+        for c, v in self.read_set.items():
+            p.read_set.setdefault(c, v)
+        p.links.update(self.links)
+        p.data.update(self.data)
+        for atom, d in self.inc.items():
+            pd = p.inc.setdefault(atom, _IncDelta())
+            if d.cleared:
+                pd.clear()
+            for l in d.added:
+                pd.add(l)
+            for l in d.removed:
+                pd.remove(l)
+        for key, d in self.idx.items():
+            pd = p.idx.setdefault(key, _IdxDelta())
+            if d.removed_all:
+                pd.added.clear()
+                pd.removed.clear()
+                pd.removed_all = True
+            for v in d.added:
+                pd.add(v)
+            for v in d.removed:
+                pd.remove(v)
+        p.on_commit.extend(self.on_commit)
+
+
+class HGTransactionManager:
+    """Owns the commit lock, version clock and per-thread transaction stacks."""
+
+    def __init__(self, backend: StorageBackend, enabled: bool = True):
+        self.backend = backend
+        self.enabled = enabled
+        self._commit_lock = threading.Lock()
+        self._versions: dict[tuple, int] = {}
+        self._clock = 0
+        self._tls = threading.local()
+        # stats (reference: TxMonitor.java:14 + conflicted/successful counters
+        # at HGTransactionManager.java:40-41)
+        self.committed = 0
+        self.conflicted = 0
+        self.aborted = 0
+
+    # -- context ---------------------------------------------------------------
+    def _stack(self) -> list[HGTransaction]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def current(self) -> Optional[HGTransaction]:
+        st = self._stack()
+        return st[-1] if st else None
+
+    # -- lifecycle --------------------------------------------------------------
+    def begin(self, readonly: bool = False) -> HGTransaction:
+        tx = HGTransaction(self, self.current(), readonly=readonly)
+        self._stack().append(tx)
+        return tx
+
+    def abort(self, tx: HGTransaction) -> None:
+        st = self._stack()
+        if not st or st[-1] is not tx:
+            raise TransactionAborted("abort of non-innermost transaction")
+        st.pop()
+        tx.active = False
+        self.aborted += 1
+
+    def commit(self, tx: HGTransaction) -> None:
+        st = self._stack()
+        if not st or st[-1] is not tx:
+            raise TransactionAborted("commit of non-innermost transaction")
+        st.pop()
+        tx.active = False
+        if tx.parent is not None:
+            tx.merge_into(tx.parent)
+            return
+        if tx.readonly or tx.is_empty():
+            self.committed += 1
+            self._run_commit_hooks(tx)
+            return
+        with self._commit_lock:
+            for cell, observed in tx.read_set.items():
+                if self._versions.get(cell, 0) != observed:
+                    self.conflicted += 1
+                    raise TransactionConflict(f"cell {cell!r} changed")
+            self._apply(tx)
+            self._clock += 1
+            v = self._clock
+            for h in tx.links:
+                self._versions[("link", h)] = v
+            for h in tx.data:
+                self._versions[("data", h)] = v
+            for atom in tx.inc:
+                self._versions[("inc", atom)] = v
+            for key in tx.idx:
+                self._versions[("idx",) + key] = v
+            self.committed += 1
+        self._run_commit_hooks(tx)
+
+    @staticmethod
+    def _run_commit_hooks(tx: HGTransaction) -> None:
+        for hook in tx.on_commit:
+            hook()
+
+    def cell_version(self, cell: tuple) -> int:
+        return self._versions.get(cell, 0)
+
+    @property
+    def version(self) -> int:
+        return self._clock
+
+    def _apply(self, tx: HGTransaction) -> None:
+        b = self.backend
+        for h, v in tx.links.items():
+            if v is _TOMBSTONE:
+                b.remove_link(h)
+            else:
+                b.store_link(h, v)
+        for h, v in tx.data.items():
+            if v is _TOMBSTONE:
+                b.remove_data(h)
+            else:
+                b.store_data(h, v)
+        for atom, d in tx.inc.items():
+            if d.cleared:
+                b.remove_incidence_set(atom)
+            for l in sorted(d.removed):
+                b.remove_incidence_link(atom, l)
+            for l in sorted(d.added):
+                b.add_incidence_link(atom, l)
+        for (name, key), d in tx.idx.items():
+            index = b.get_index(name, create=True)
+            if d.removed_all:
+                index.remove_all_entries(key)
+            for v in sorted(d.removed):
+                index.remove_entry(key, v)
+            for v in sorted(d.added):
+                index.add_entry(key, v)
+
+    # -- the retry loop (HGTransactionManager.transact :356) --------------------
+    def transact(self, fn: Callable[[], T], retries: int = 16,
+                 readonly: bool = False) -> T:
+        if not self.enabled:
+            return fn()
+        last: Optional[Exception] = None
+        for _ in range(retries):
+            tx = self.begin(readonly=readonly)
+            try:
+                result = fn()
+            except BaseException:
+                if tx.active:
+                    self.abort(tx)
+                raise
+            try:
+                self.commit(tx)
+                return result
+            except TransactionConflict as e:
+                last = e
+                continue
+        raise TransactionConflict(f"giving up after {retries} retries") from last
+
+    def ensure_transaction(self, fn: Callable[[], T], readonly: bool = False) -> T:
+        """Run fn inside the current transaction if one exists, else a new one
+        (``HGTransactionManager.ensureTransaction`` ``:296``)."""
+        if not self.enabled or self.current() is not None:
+            return fn()
+        return self.transact(fn, readonly=readonly)

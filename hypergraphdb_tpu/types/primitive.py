@@ -1,0 +1,207 @@
+"""Primitive types.
+
+The analogue of the reference's ``type/javaprimitive/`` package (26 files:
+String, numerics, boolean, date/timestamp, enums, primitive arrays — SURVEY
+§2.1). Each primitive is serialization + an order-preserving key, which is
+the exact contract indices depend on (``type/HGPrimitiveType.java:28``).
+
+Kind prefixes keep different primitives in disjoint, deterministic key
+ranges: b(ool) < f(loat) < i(nt) < l(ist) < s(tr) < t(imestamp) < y(bytes).
+Ints and floats get *distinct* kinds — unlike a unified numeric tower, an
+index range scan over ints never has to skip float keys.
+"""
+
+from __future__ import annotations
+
+import datetime
+import struct
+from typing import Any
+
+import msgpack
+
+from hypergraphdb_tpu.types.system import HGAtomType
+from hypergraphdb_tpu.utils import ordered_bytes as ob
+
+
+class IntType(HGAtomType):
+    name = "int"
+    kind = b"i"
+
+    def store(self, value: Any) -> bytes:
+        return ob.encode_int(int(value))
+
+    def make(self, data: bytes) -> Any:
+        return ob.decode_int(data)
+
+    def to_key(self, value: Any) -> bytes:
+        return self.kind + ob.encode_int(int(value))
+
+    def handles_value(self, value: Any) -> bool:
+        return isinstance(value, int) and not isinstance(value, bool)
+
+    def subsumes(self, general: Any, specific: Any) -> bool:
+        return int(general) == int(specific)
+
+
+class FloatType(HGAtomType):
+    name = "float"
+    kind = b"f"
+
+    def store(self, value: Any) -> bytes:
+        return struct.pack(">d", float(value))
+
+    def make(self, data: bytes) -> Any:
+        return struct.unpack(">d", data)[0]
+
+    def to_key(self, value: Any) -> bytes:
+        return self.kind + ob.encode_float(float(value))
+
+    def handles_value(self, value: Any) -> bool:
+        return isinstance(value, float)
+
+
+class StringType(HGAtomType):
+    name = "string"
+    kind = b"s"
+
+    def store(self, value: Any) -> bytes:
+        return str(value).encode("utf-8")
+
+    def make(self, data: bytes) -> Any:
+        return data.decode("utf-8")
+
+    def to_key(self, value: Any) -> bytes:
+        return self.kind + str(value).encode("utf-8")
+
+    def handles_value(self, value: Any) -> bool:
+        return isinstance(value, str)
+
+
+class BoolType(HGAtomType):
+    name = "bool"
+    kind = b"b"
+
+    def store(self, value: Any) -> bytes:
+        return ob.encode_bool(bool(value))
+
+    def make(self, data: bytes) -> Any:
+        return ob.decode_bool(data)
+
+    def to_key(self, value: Any) -> bytes:
+        return self.kind + ob.encode_bool(bool(value))
+
+    def handles_value(self, value: Any) -> bool:
+        return isinstance(value, bool)
+
+
+class BytesType(HGAtomType):
+    name = "bytes"
+    kind = b"y"
+
+    def store(self, value: Any) -> bytes:
+        return bytes(value)
+
+    def make(self, data: bytes) -> Any:
+        return data
+
+    def to_key(self, value: Any) -> bytes:
+        return self.kind + bytes(value)
+
+    def handles_value(self, value: Any) -> bool:
+        return isinstance(value, (bytes, bytearray))
+
+
+class TimestampType(HGAtomType):
+    """Dates/timestamps (reference: ``DateType``/``TimestampType``/
+    ``CalendarType`` in ``type/javaprimitive/``). Stored as epoch micros."""
+
+    name = "timestamp"
+    kind = b"t"
+
+    def store(self, value: Any) -> bytes:
+        return ob.encode_int(self._micros(value))
+
+    def make(self, data: bytes) -> Any:
+        us = ob.decode_int(data)
+        return datetime.datetime.fromtimestamp(us / 1e6, tz=datetime.timezone.utc)
+
+    def to_key(self, value: Any) -> bytes:
+        return self.kind + ob.encode_int(self._micros(value))
+
+    def handles_value(self, value: Any) -> bool:
+        return isinstance(value, (datetime.datetime, datetime.date))
+
+    @staticmethod
+    def _micros(value: Any) -> int:
+        if isinstance(value, datetime.datetime):
+            if value.tzinfo is None:
+                value = value.replace(tzinfo=datetime.timezone.utc)
+            return int(value.timestamp() * 1e6)
+        if isinstance(value, datetime.date):
+            dt = datetime.datetime(value.year, value.month, value.day,
+                                   tzinfo=datetime.timezone.utc)
+            return int(dt.timestamp() * 1e6)
+        raise TypeError(f"not a date: {value!r}")
+
+
+class ListType(HGAtomType):
+    """Heterogeneous lists/tuples of primitives (reference: ``CollectionType``/
+    ``ArrayType``). Serialized with msgpack; key = msgpack bytes (msgpack
+    int/str encodings are not order-preserving across the whole domain, so
+    list keys support equality lookups only — same restriction the reference
+    has for collection values)."""
+
+    name = "list"
+    kind = b"l"
+
+    def store(self, value: Any) -> bytes:
+        return msgpack.packb(list(value), use_bin_type=True)
+
+    def make(self, data: bytes) -> Any:
+        return msgpack.unpackb(data, raw=False)
+
+    def to_key(self, value: Any) -> bytes:
+        return self.kind + msgpack.packb(list(value), use_bin_type=True)
+
+    def handles_value(self, value: Any) -> bool:
+        return isinstance(value, (list, tuple))
+
+
+class DictType(HGAtomType):
+    """Free-form string-keyed maps (reference: ``MapType``)."""
+
+    name = "dict"
+    kind = b"m"
+
+    def store(self, value: Any) -> bytes:
+        return msgpack.packb(dict(value), use_bin_type=True)
+
+    def make(self, data: bytes) -> Any:
+        return msgpack.unpackb(data, raw=False)
+
+    def to_key(self, value: Any) -> bytes:
+        items = sorted(dict(value).items())
+        return self.kind + msgpack.packb(items, use_bin_type=True)
+
+    def handles_value(self, value: Any) -> bool:
+        return isinstance(value, dict)
+
+    def dimensions(self) -> list[str]:
+        return []  # dynamic; use project() directly
+
+    def project(self, value: Any, dimension: str) -> Any:
+        return value.get(dimension)
+
+
+#: (type instance, bound runtime classes) — the predefined-type manifest,
+#: analogue of the ``core/src/config/org/hypergraphdb/types`` resource.
+PREDEFINED: list[tuple[HGAtomType, tuple]] = [
+    (BoolType(), (bool,)),          # bool BEFORE int: bool is an int subclass
+    (IntType(), (int,)),
+    (FloatType(), (float,)),
+    (StringType(), (str,)),
+    (BytesType(), (bytes, bytearray)),
+    (TimestampType(), (datetime.datetime, datetime.date)),
+    (ListType(), (list, tuple)),
+    (DictType(), (dict,)),
+]

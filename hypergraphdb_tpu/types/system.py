@@ -1,0 +1,244 @@
+"""Type system: types are atoms; values are typed, serialized, and indexable.
+
+Re-expression of the reference's ``HGTypeSystem`` (``core/.../type/
+HGTypeSystem.java:93``) and ``HGAtomType`` contract (``type/HGAtomType.java:40``
+— make/store/release/subsumes), redesigned for the TPU build:
+
+- Every type provides ``store(value) -> bytes`` / ``make(bytes) -> value``
+  (serialization into the data store) and ``to_key(value) -> bytes`` — an
+  **order-preserving index key** (the sort-order contract the reference
+  expresses as ``HGPrimitiveType`` = ``ByteArrayConverter`` + comparator,
+  ``type/HGPrimitiveType.java:28``). Keys carry a 1-byte kind prefix so keys
+  of different primitive kinds never collide and sort deterministically.
+- Types are themselves atoms: each registered type gets a type-atom in the
+  graph (value = its symbolic name, type = the top type), so queries over
+  types work exactly like queries over data (``HGTypeSystem.java:194``
+  bootstrap equivalence).
+- Python classes bind to types automatically: dataclasses become record
+  types with projections (the ``JavaTypeFactory.java:37`` / bean
+  introspection analogue lives in ``types/record.py``).
+- Value payloads stay host-side; the device plane only ever sees the
+  order-preserving key (or its 64-bit rank) — SURVEY §7 hard part 3.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from hypergraphdb_tpu.core.errors import TypeError_
+from hypergraphdb_tpu.core.handles import HGHandle
+
+
+class HGAtomType:
+    """A type: serialization + index-key + subsumption for its values."""
+
+    #: symbolic name, unique in a type system
+    name: str = ""
+    #: 1-byte kind prefix for index keys
+    kind: bytes = b"?"
+
+    def store(self, value: Any) -> bytes:
+        raise NotImplementedError
+
+    def make(self, data: bytes) -> Any:
+        raise NotImplementedError
+
+    def to_key(self, value: Any) -> bytes:
+        """Order-preserving index key, including the kind prefix."""
+        raise NotImplementedError
+
+    def handles_value(self, value: Any) -> bool:
+        """Can this type store the given runtime value?"""
+        return False
+
+    def subsumes(self, general: Any, specific: Any) -> bool:
+        """Value-level subsumption (``HGAtomType.subsumes``); default: equality."""
+        return general == specific
+
+    def dimensions(self) -> list[str]:
+        """Projection dimensions (``HGCompositeType`` analogue); empty for scalars."""
+        return []
+
+    def project(self, value: Any, dimension: str) -> Any:
+        raise TypeError_(f"type {self.name} has no dimension {dimension!r}")
+
+
+class TopType(HGAtomType):
+    """The top type — the type of type atoms (``type/Top.java:25``).
+
+    Its values are type names (strings)."""
+
+    name = "top"
+    kind = b"T"
+
+    def store(self, value: Any) -> bytes:
+        return str(value).encode("utf-8")
+
+    def make(self, data: bytes) -> Any:
+        return data.decode("utf-8")
+
+    def to_key(self, value: Any) -> bytes:
+        return self.kind + str(value).encode("utf-8")
+
+    def handles_value(self, value: Any) -> bool:
+        return False  # never inferred
+
+
+class NullType(HGAtomType):
+    """Type of ``None`` — used for valueless links (the reference stores a
+    null value handle in that case, ``HyperGraph.java:1589``)."""
+
+    name = "null"
+    kind = b"0"
+
+    def store(self, value: Any) -> bytes:
+        return b""
+
+    def make(self, data: bytes) -> Any:
+        return None
+
+    def to_key(self, value: Any) -> bytes:
+        return self.kind
+
+    def handles_value(self, value: Any) -> bool:
+        return value is None
+
+
+class HGTypeSystem:
+    """Registry binding runtime classes ↔ types ↔ type atoms.
+
+    The graph kernel calls ``get_type_handle(value)`` on every ``add`` —
+    the analogue of ``HGTypeSystem.getTypeHandle`` at ``HyperGraph.java:651``.
+    """
+
+    def __init__(self, graph: "HyperGraph"):  # noqa: F821
+        self.graph = graph
+        self._by_name: dict[str, HGAtomType] = {}
+        self._handle_by_name: dict[str, HGHandle] = {}
+        self._name_by_handle: dict[HGHandle, str] = {}
+        self._by_class: dict[type, str] = {}
+        self._inference: list[Callable[[Any], Optional[HGAtomType]]] = []
+        #: direct supertype edges: type name -> parent type names
+        self._supertypes: dict[str, set[str]] = {}
+        self.top = TopType()
+        self.null = NullType()
+
+    # -- bootstrap ------------------------------------------------------------
+    def bootstrap(self) -> None:
+        """Create the predefined type atoms (``HGTypeSystem.java:194``)."""
+        from hypergraphdb_tpu.types import primitive as prim
+
+        self.register(self.top, classes=())
+        self.register(self.null, classes=(type(None),))
+        for t, classes in prim.PREDEFINED:
+            self.register(t, classes=classes)
+
+    # -- registration -----------------------------------------------------------
+    def register(
+        self,
+        atype: HGAtomType,
+        classes: tuple = (),
+        supertypes: tuple[str, ...] = (),
+    ) -> HGHandle:
+        if atype.name in self._by_name:
+            return self._handle_by_name[atype.name]
+        self._by_name[atype.name] = atype
+        # the type atom: value = type name, type = top
+        h = self.graph._add_type_atom(atype.name)
+        self._handle_by_name[atype.name] = h
+        self._name_by_handle[h] = atype.name
+        for c in classes:
+            self._by_class[c] = atype.name
+        if supertypes:
+            self._supertypes[atype.name] = set(supertypes)
+        return h
+
+    def add_inference(self, fn: Callable[[Any], Optional[HGAtomType]]) -> None:
+        """Register a fallback value→type inference hook."""
+        self._inference.append(fn)
+
+    # -- lookup -------------------------------------------------------------------
+    def get_type(self, name_or_handle) -> HGAtomType:
+        if isinstance(name_or_handle, str):
+            t = self._by_name.get(name_or_handle)
+            if t is None:
+                raise TypeError_(f"unknown type {name_or_handle!r}")
+            return t
+        name = self._name_by_handle.get(int(name_or_handle))
+        if name is None:
+            raise TypeError_(f"handle {name_or_handle} is not a type atom")
+        return self._by_name[name]
+
+    def handle_of(self, name: str) -> HGHandle:
+        h = self._handle_by_name.get(name)
+        if h is None:
+            raise TypeError_(f"unknown type {name!r}")
+        return h
+
+    def name_of(self, handle: HGHandle) -> str:
+        return self._name_by_handle[int(handle)]
+
+    def is_type_handle(self, handle: HGHandle) -> bool:
+        return int(handle) in self._name_by_handle
+
+    def get_type_handle(self, value: Any) -> HGHandle:
+        """Infer the type of a runtime value (``HyperGraph.add`` step 1).
+
+        Unlike the reference this never creates types implicitly except for
+        dataclasses, which auto-register as record types (the
+        ``JavaTypeFactory`` behavior)."""
+        t = self.infer(value)
+        if t is None:
+            raise TypeError_(f"no type for value of class {type(value).__name__}")
+        return self._handle_by_name[t.name]
+
+    def infer(self, value: Any) -> Optional[HGAtomType]:
+        name = self._by_class.get(type(value))
+        if name is not None:
+            return self._by_name[name]
+        for fn in self._inference:
+            t = fn(value)
+            if t is not None:
+                if t.name not in self._by_name:
+                    self.register(t, classes=(type(value),))
+                return t
+        # dataclass auto-binding
+        import dataclasses
+
+        if dataclasses.is_dataclass(value) and not isinstance(value, type):
+            from hypergraphdb_tpu.types.record import RecordType
+
+            t = RecordType.for_dataclass(type(value), self)
+            if t.name not in self._by_name:
+                self.register(t, classes=(type(value),),
+                              supertypes=t.supertype_names)
+            return self._by_name[t.name]
+        return None
+
+    # -- subsumption (type-level) ---------------------------------------------
+    def declare_subtype(self, sub: str, sup: str) -> None:
+        self._supertypes.setdefault(sub, set()).add(sup)
+
+    def subtypes_closure(self, name: str) -> set[str]:
+        """All type names subsumed by `name` (including itself) — powers
+        ``TypePlusCondition`` expansion (``cond2qry/ExpressionBasedQuery.java:603``)."""
+        out = {name}
+        changed = True
+        while changed:
+            changed = False
+            for sub, sups in self._supertypes.items():
+                if sub not in out and (sups & out):
+                    out.add(sub)
+                    changed = True
+        return out
+
+    def supertypes_of(self, name: str) -> set[str]:
+        out: set[str] = set()
+        frontier = set(self._supertypes.get(name, ()))
+        while frontier:
+            out |= frontier
+            nxt: set[str] = set()
+            for n in frontier:
+                nxt |= self._supertypes.get(n, set()) - out
+            frontier = nxt
+        return out

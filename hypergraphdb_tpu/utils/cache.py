@@ -1,0 +1,55 @@
+"""Small host-side caches.
+
+The reference's caching layer (``cache/WeakRefAtomCache.java:58``,
+``cache/LRUCache.java:34``) manages JVM weak/phantom references and
+GC-pressure eviction. CPython's refcounting removes most of that machinery;
+what remains useful is a bounded LRU for deserialized atoms and incidence
+snapshots.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Generic, Hashable, Optional, TypeVar
+
+K = TypeVar("K", bound=Hashable)
+V = TypeVar("V")
+
+_MISSING = object()
+
+
+class LRUCache(Generic[K, V]):
+    __slots__ = ("_d", "capacity", "hits", "misses")
+
+    def __init__(self, capacity: int = 1 << 16):
+        self._d: OrderedDict[K, V] = OrderedDict()
+        self.capacity = capacity
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: K, default: Any = None) -> Optional[V]:
+        v = self._d.get(key, _MISSING)
+        if v is _MISSING:
+            self.misses += 1
+            return default
+        self._d.move_to_end(key)
+        self.hits += 1
+        return v
+
+    def put(self, key: K, value: V) -> None:
+        self._d[key] = value
+        self._d.move_to_end(key)
+        while len(self._d) > self.capacity:
+            self._d.popitem(last=False)
+
+    def invalidate(self, key: K) -> None:
+        self._d.pop(key, None)
+
+    def clear(self) -> None:
+        self._d.clear()
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def __contains__(self, key: K) -> bool:
+        return key in self._d

@@ -1,0 +1,83 @@
+"""Order-preserving byte encodings for index keys.
+
+The reference's index sort order comes from each primitive type being a
+``ByteArrayConverter`` + comparator (``type/HGPrimitiveType.java:28``); every
+index compares raw bytes with a type-supplied comparator. The TPU-native
+design strengthens that contract: every primitive type encodes to bytes whose
+**plain lexicographic (memcmp) order equals the value order**. That one
+invariant buys three things:
+
+- host indices need no per-type comparators (memcmp everywhere),
+- the C++ native store can sort/search without calling back into Python,
+- device-side sort keys are derivable (the first 8 bytes of a key form an
+  order-preserving ``uint64`` rank usable in jnp sorts).
+"""
+
+from __future__ import annotations
+
+import struct
+
+# --- int64: flip sign bit, big-endian --------------------------------------
+
+
+def encode_int(v: int) -> bytes:
+    return struct.pack(">Q", (v + (1 << 63)) & ((1 << 64) - 1))
+
+
+def decode_int(b: bytes) -> int:
+    return struct.unpack(">Q", b)[0] - (1 << 63)
+
+
+# --- float64: IEEE 754 total-order trick ------------------------------------
+# For non-negative floats, flipping the sign bit gives ascending order; for
+# negative floats, flipping all bits does. Standard order-preserving encoding.
+
+
+def encode_float(v: float) -> bytes:
+    bits = struct.unpack(">Q", struct.pack(">d", v))[0]
+    if bits & (1 << 63):
+        bits = ~bits & ((1 << 64) - 1)
+    else:
+        bits |= 1 << 63
+    return struct.pack(">Q", bits)
+
+
+def decode_float(b: bytes) -> float:
+    bits = struct.unpack(">Q", b)[0]
+    if bits & (1 << 63):
+        bits &= ~(1 << 63) & ((1 << 64) - 1)
+    else:
+        bits = ~bits & ((1 << 64) - 1)
+    return struct.unpack(">d", struct.pack(">Q", bits))[0]
+
+
+# --- strings: UTF-8 (lexicographic byte order == codepoint order) -----------
+
+
+def encode_str(v: str) -> bytes:
+    return v.encode("utf-8")
+
+
+def decode_str(b: bytes) -> str:
+    return b.decode("utf-8")
+
+
+# --- bool -------------------------------------------------------------------
+
+
+def encode_bool(v: bool) -> bytes:
+    return b"\x01" if v else b"\x00"
+
+
+def decode_bool(b: bytes) -> bool:
+    return b != b"\x00"
+
+
+def rank64(key: bytes) -> int:
+    """First 8 bytes of a key as a big-endian unsigned rank.
+
+    Order-preserving coarse rank for device-side sort keys: if
+    ``rank64(a) < rank64(b)`` then ``a < b``; ties need host fallback.
+    """
+    b = key[:8].ljust(8, b"\x00")
+    return struct.unpack(">Q", b)[0]

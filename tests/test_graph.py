@@ -1,0 +1,213 @@
+"""Graph kernel tests: atom CRUD, links, incidence, types.
+
+Covers the intent of the reference's ``testcore`` CRUD/link/type suites
+(``hgtest.TestCreateDB``, ``hgtest/links/``, ``hgtest/types/`` — SURVEY §4).
+"""
+
+import dataclasses
+
+import pytest
+
+from hypergraphdb_tpu import HGLink, HyperGraph, NotFoundError
+
+
+def test_add_get_node(graph: HyperGraph):
+    h = graph.add("hello")
+    assert graph.get(h) == "hello"
+    assert graph.contains(h)
+    assert not graph.is_link(h)
+    assert graph.arity(h) == 0
+
+
+def test_add_primitives(graph: HyperGraph):
+    vals = [42, -7, 3.14, True, False, "s", b"raw", [1, "two"], {"k": 1}, None]
+    hs = [graph.add(v) for v in vals]
+    for h, v in zip(hs, vals):
+        assert graph.get(h) == v
+
+
+def test_add_link(graph: HyperGraph):
+    a, b = graph.add("a"), graph.add("b")
+    l = graph.add_link((a, b), value="edge")
+    got = graph.get(l)
+    assert isinstance(got, HGLink)
+    assert got.targets == (a, b)
+    assert got.value == "edge"
+    assert graph.is_link(l)
+    assert graph.arity(l) == 2
+    assert graph.get_targets(l) == (a, b)
+
+
+def test_links_to_links(graph: HyperGraph):
+    """The hypergraph property: links can target links
+    (reference doc ``HyperGraph.java:64-75``)."""
+    a, b = graph.add("a"), graph.add("b")
+    l1 = graph.add_link((a, b))
+    l2 = graph.add_link((l1, a), value="meta")
+    assert graph.get(l2).targets == (l1, a)
+    assert l2 in graph.get_incidence_set(l1)
+
+
+def test_zero_arity_link(graph: HyperGraph):
+    l = graph.add_link((), value="unit")
+    assert graph.is_link(l)
+    assert graph.arity(l) == 0
+
+
+def test_incidence_maintained(graph: HyperGraph):
+    a, b, c = (graph.add(x) for x in "abc")
+    l1 = graph.add_link((a, b))
+    l2 = graph.add_link((a, c))
+    assert graph.get_incidence_set(a).array().tolist() == sorted([l1, l2])
+    assert graph.get_incidence_set(b).array().tolist() == [l1]
+    assert graph.get_incidence_set(c).array().tolist() == [l2]
+
+
+def test_duplicate_target_incidence(graph: HyperGraph):
+    a = graph.add("a")
+    l = graph.add_link((a, a))
+    assert graph.get_incidence_set(a).array().tolist() == [l]
+    assert graph.get(l).targets == (a, a)
+
+
+def test_get_missing_raises(graph: HyperGraph):
+    with pytest.raises(NotFoundError):
+        graph.get(99999)
+
+
+def test_replace_value(graph: HyperGraph):
+    h = graph.add("old")
+    graph.replace(h, "new")
+    assert graph.get(h) == "new"
+
+
+def test_replace_changes_type(graph: HyperGraph):
+    h = graph.add("str")
+    graph.replace(h, 42)
+    assert graph.get(h) == 42
+    th = graph.get_type_handle_of(h)
+    assert graph.typesystem.name_of(th) == "int"
+
+
+def test_replace_keeps_incidence(graph: HyperGraph):
+    a, b = graph.add("a"), graph.add("b")
+    l = graph.add_link((a, b), value=1)
+    graph.replace(l, 2)
+    got = graph.get(l)
+    assert got.value == 2
+    assert got.targets == (a, b)
+    assert l in graph.get_incidence_set(a)
+
+
+def test_remove_node(graph: HyperGraph):
+    h = graph.add("x")
+    assert graph.remove(h)
+    assert not graph.contains(h)
+    assert not graph.remove(h)  # idempotent
+
+
+def test_remove_cascades_to_incident_links(graph: HyperGraph):
+    a, b = graph.add("a"), graph.add("b")
+    l = graph.add_link((a, b))
+    meta = graph.add_link((l,))
+    graph.remove(a)
+    assert not graph.contains(l)
+    assert not graph.contains(meta)  # cascade through link-to-link
+    assert graph.contains(b)
+    assert len(graph.get_incidence_set(b)) == 0
+
+
+def test_remove_keep_incident_links(graph: HyperGraph):
+    a, b = graph.add("a"), graph.add("b")
+    l = graph.add_link((a, b))
+    graph.remove(a, keep_incident_links=True)
+    assert graph.contains(l)
+    assert graph.get(l).targets == (b,)
+
+
+def test_remove_link_cleans_target_incidence(graph: HyperGraph):
+    a, b = graph.add("a"), graph.add("b")
+    l = graph.add_link((a, b))
+    graph.remove(l)
+    assert len(graph.get_incidence_set(a)) == 0
+    assert graph.contains(a)
+
+
+def test_atoms_scan_and_count(graph: HyperGraph):
+    base = graph.atom_count()  # type atoms exist already
+    hs = [graph.add(i) for i in range(5)]
+    assert graph.atom_count() == base + 5
+    assert set(hs) <= set(graph.atoms())
+
+
+def test_bulk_nodes(graph: HyperGraph):
+    r = graph.add_nodes_bulk(["a", "b", "c"])
+    assert len(r) == 3
+    assert [graph.get(h) for h in r] == ["a", "b", "c"]
+
+
+def test_bulk_links(graph: HyperGraph):
+    ns = list(graph.add_nodes_bulk([1, 2, 3]))
+    r = graph.add_links_bulk([(ns[0], ns[1]), (ns[1], ns[2])], values=["x", "y"])
+    got = graph.get(r[0])
+    assert got.targets == (ns[0], ns[1])
+    assert got.value == "x"
+    assert r[1] in graph.get_incidence_set(ns[1])
+
+
+# ---------------------------------------------------------------- types
+
+
+@dataclasses.dataclass
+class Person:
+    name: str
+    age: int
+
+
+@dataclasses.dataclass
+class Employee(Person):
+    company: str = ""
+
+
+def test_dataclass_roundtrip(graph: HyperGraph):
+    p = Person("ada", 36)
+    h = graph.add(p)
+    assert graph.get(h) == p
+
+
+def test_dataclass_type_registered(graph: HyperGraph):
+    h = graph.add(Person("bob", 1))
+    th = graph.get_type_handle_of(h)
+    assert "Person" in graph.typesystem.name_of(th)
+
+
+def test_record_projection(graph: HyperGraph):
+    p = Person("ada", 36)
+    t = graph.typesystem.infer(p)
+    assert t.dimensions() == ["name", "age"]
+    assert t.project(p, "name") == "ada"
+
+
+def test_subtype_closure(graph: HyperGraph):
+    graph.add(Person("a", 1))
+    graph.add(Employee("b", 2, "acme"))
+    ts = graph.typesystem
+    pname = next(n for n in ts._by_name if n.endswith("Person"))
+    closure = ts.subtypes_closure(pname)
+    assert any(n.endswith("Employee") for n in closure)
+
+
+def test_type_atoms_are_atoms(graph: HyperGraph):
+    th = graph.typesystem.handle_of("int")
+    assert graph.get(th) == "int"  # value of a type atom is its name
+    assert graph.typesystem.is_type_handle(th)
+
+
+def test_value_key_ordering(graph: HyperGraph):
+    """Order-preserving key contract (HGPrimitiveType comparator analogue)."""
+    it = graph.typesystem.get_type("int")
+    assert it.to_key(-5) < it.to_key(0) < it.to_key(5) < it.to_key(1000)
+    ft = graph.typesystem.get_type("float")
+    assert ft.to_key(-2.5) < ft.to_key(-1.0) < ft.to_key(0.0) < ft.to_key(3.7)
+    st = graph.typesystem.get_type("string")
+    assert st.to_key("abc") < st.to_key("abd") < st.to_key("b")

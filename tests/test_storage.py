@@ -1,0 +1,202 @@
+"""Storage SPI conformance suite.
+
+The backend-agnostic contract suite, modeled on the reference's
+``storage/teststorage/`` module (``StoreImplementationTestBase.java:16-24``,
+``TopLevelStorageTests``, ``IndexInterfaceTests``, ``SortIndexTests``,
+``BiIndexTests`` — SURVEY §4): any backend (memory, native C++) must pass
+every test here. Parametrized over available backends.
+"""
+
+import numpy as np
+import pytest
+
+from hypergraphdb_tpu.storage.api import StorageBackend
+from hypergraphdb_tpu.storage.memstore import MemStorage
+
+
+def _backends():
+    yield "memory"
+    try:
+        from hypergraphdb_tpu.storage.native import NativeStorage  # noqa: F401
+
+        yield "native"
+    except Exception:
+        pass
+
+
+@pytest.fixture(params=list(_backends()))
+def store(request, tmp_path):
+    if request.param == "memory":
+        b = MemStorage()
+    else:
+        from hypergraphdb_tpu.storage.native import NativeStorage
+
+        b = NativeStorage(str(tmp_path / "db"))
+    b.startup()
+    yield b
+    b.shutdown()
+
+
+# ---------------------------------------------------------------- links
+
+
+def test_link_roundtrip(store: StorageBackend):
+    store.store_link(1, (10, 20, 30))
+    assert store.get_link(1) == (10, 20, 30)
+    assert store.contains_link(1)
+    assert store.get_link(2) is None
+    assert not store.contains_link(2)
+
+
+def test_link_empty_targets(store: StorageBackend):
+    store.store_link(5, ())
+    assert store.get_link(5) == ()
+    assert store.contains_link(5)
+
+
+def test_link_overwrite_and_remove(store: StorageBackend):
+    store.store_link(1, (1, 2))
+    store.store_link(1, (3,))
+    assert store.get_link(1) == (3,)
+    store.remove_link(1)
+    assert store.get_link(1) is None
+    store.remove_link(1)  # idempotent
+
+
+# ---------------------------------------------------------------- data
+
+
+def test_data_roundtrip(store: StorageBackend):
+    store.store_data(7, b"hello")
+    assert store.get_data(7) == b"hello"
+    store.store_data(7, b"")
+    assert store.get_data(7) == b""
+    store.remove_data(7)
+    assert store.get_data(7) is None
+
+
+def test_data_large(store: StorageBackend):
+    blob = bytes(range(256)) * 1000
+    store.store_data(8, blob)
+    assert store.get_data(8) == blob
+
+
+# ---------------------------------------------------------------- incidence
+
+
+def test_incidence_sorted_and_deduped(store: StorageBackend):
+    for link in (5, 3, 9, 3, 7):
+        store.add_incidence_link(100, link)
+    rs = store.get_incidence_set(100)
+    assert rs.array().tolist() == [3, 5, 7, 9]
+    assert store.incidence_count(100) == 4
+    assert 5 in rs
+    assert 4 not in rs
+
+
+def test_incidence_remove(store: StorageBackend):
+    for link in (1, 2, 3):
+        store.add_incidence_link(100, link)
+    store.remove_incidence_link(100, 2)
+    assert store.get_incidence_set(100).array().tolist() == [1, 3]
+    store.remove_incidence_set(100)
+    assert len(store.get_incidence_set(100)) == 0
+
+
+def test_incidence_goto(store: StorageBackend):
+    for link in (10, 20, 30):
+        store.add_incidence_link(1, link)
+    rs = store.get_incidence_set(1)
+    assert rs.go_to(20) == 1
+    assert rs.go_to(15) == -1
+    assert rs.go_to(15, exact=False) == 1
+    assert rs.go_to(31, exact=False) == -1
+
+
+# ---------------------------------------------------------------- indices
+
+
+def test_index_basic(store: StorageBackend):
+    idx = store.get_index("test")
+    idx.add_entry(b"a", 1)
+    idx.add_entry(b"a", 2)
+    idx.add_entry(b"b", 3)
+    assert idx.find(b"a").array().tolist() == [1, 2]
+    assert idx.find_first(b"a") == 1
+    assert idx.count(b"a") == 2
+    assert idx.key_count() == 2
+    assert list(idx.scan_keys()) == [b"a", b"b"]
+    assert sorted(idx.scan_values()) == [1, 2, 3]
+
+
+def test_index_remove(store: StorageBackend):
+    idx = store.get_index("test")
+    idx.add_entry(b"k", 1)
+    idx.add_entry(b"k", 2)
+    idx.remove_entry(b"k", 1)
+    assert idx.find(b"k").array().tolist() == [2]
+    idx.remove_all_entries(b"k")
+    assert len(idx.find(b"k")) == 0
+    assert idx.key_count() == 0
+
+
+def test_index_range(store: StorageBackend):
+    idx = store.get_index("rng")
+    for i, k in enumerate([b"a", b"c", b"e", b"g"]):
+        idx.add_entry(k, i)
+    assert idx.find_lt(b"e").array().tolist() == [0, 1]
+    assert idx.find_lte(b"e").array().tolist() == [0, 1, 2]
+    assert idx.find_gt(b"c").array().tolist() == [2, 3]
+    assert idx.find_gte(b"c").array().tolist() == [1, 2, 3]
+    assert idx.find_range(lo=b"c", hi=b"g").array().tolist() == [1, 2]
+
+
+def test_index_bidirectional(store: StorageBackend):
+    idx = store.get_index("bi")
+    idx.add_entry(b"x", 1)
+    idx.add_entry(b"y", 1)
+    idx.add_entry(b"x", 2)
+    assert idx.find_by_value(1) == [b"x", b"y"]
+    assert idx.count_keys(1) == 2
+    idx.remove_entry(b"x", 1)
+    assert idx.find_by_value(1) == [b"y"]
+
+
+def test_index_namespace(store: StorageBackend):
+    a = store.get_index("a")
+    b = store.get_index("b")
+    a.add_entry(b"k", 1)
+    assert len(b.find(b"k")) == 0
+    assert set(store.index_names()) >= {"a", "b"}
+    store.remove_index("a")
+    assert "a" not in store.index_names()
+
+
+def test_index_empty(store: StorageBackend):
+    """EmtpyIndexTest [sic] analogue."""
+    idx = store.get_index("empty")
+    assert len(idx.find(b"nope")) == 0
+    assert idx.find_first(b"nope") is None
+    assert idx.count(b"nope") == 0
+    assert idx.key_count() == 0
+    assert list(idx.scan_keys()) == []
+
+
+# ---------------------------------------------------------------- bulk
+
+
+def test_bulk_links(store: StorageBackend):
+    store.store_link(0, (1, 2))
+    store.store_link(2, (3,))
+    store.store_link(1, ())
+    ids, offsets, flat = store.bulk_links()
+    assert ids.tolist() == [0, 1, 2]
+    assert offsets.tolist() == [0, 2, 2, 3]
+    assert flat.tolist() == [1, 2, 3]
+
+
+def test_max_handle(store: StorageBackend):
+    assert store.max_handle() == 0
+    store.store_link(41, ())
+    store.store_data(7, b"x")
+    assert store.max_handle() == 42

@@ -1,0 +1,93 @@
+"""Regression tests for transaction/cache interaction bugs found in review."""
+
+import pytest
+
+from hypergraphdb_tpu import HyperGraph, NotFoundError
+from hypergraphdb_tpu.core import events as ev
+
+
+def test_aborted_tx_does_not_pollute_atom_cache(graph: HyperGraph):
+    holder = {}
+
+    def work():
+        h = holder["h"] = graph.add("hello")
+        assert graph.get(h) == "hello"  # must not land in shared cache
+        raise RuntimeError("abort")
+
+    with pytest.raises(RuntimeError):
+        graph.txman.transact(work)
+    h = holder["h"]
+    assert not graph.contains(h)
+    with pytest.raises(NotFoundError):
+        graph.get(h)
+
+
+def test_keep_incident_links_invalidates_link_cache(graph: HyperGraph):
+    a, b = graph.add("a"), graph.add("b")
+    l = graph.add_link((a, b))
+    assert graph.get(l).targets == (a, b)  # warm the cache
+    graph.remove(a, keep_incident_links=True)
+    assert graph.get(l).targets == (b,)
+
+
+def test_events_deferred_until_commit(graph: HyperGraph):
+    seen = []
+    graph.events.add_listener(
+        ev.HGAtomAddedEvent, lambda g, e: seen.append(e.handle) or 0
+    )
+
+    def work():
+        graph.add("ghost")
+        assert seen == []  # not yet committed
+        raise RuntimeError("abort")
+
+    with pytest.raises(RuntimeError):
+        graph.txman.transact(work)
+    assert seen == []  # aborted adds never reach listeners
+
+    h = graph.txman.transact(lambda: graph.add("real"))
+    assert seen == [h]
+
+
+def test_mutation_counter_not_bumped_on_abort(graph: HyperGraph):
+    before = graph._mutations
+
+    def work():
+        graph.add("ghost")
+        raise RuntimeError("abort")
+
+    with pytest.raises(RuntimeError):
+        graph.txman.transact(work)
+    assert graph._mutations == before
+
+
+def test_atoms_sees_parent_tx_writes(graph: HyperGraph):
+    outer = graph.txman.begin()
+    h = graph.add("outer-atom")
+    inner = graph.txman.begin()
+    assert h in set(graph.atoms())  # read-your-writes through the chain
+    graph.txman.abort(inner)
+    graph.txman.abort(outer)
+
+
+def test_scan_keys_consistent_after_tx_removal(graph: HyperGraph):
+    idx = graph.store.get_index("sk")
+    idx.add_entry(b"only", 7)
+    tx = graph.txman.begin()
+    idx2 = graph.store.get_index("sk")
+    idx2.remove_entry(b"only", 7)
+    assert len(idx2.find(b"only")) == 0
+    assert b"only" not in list(idx2.scan_keys())
+    graph.txman.abort(tx)
+    assert b"only" in list(graph.store.get_index("sk").scan_keys())
+
+
+def test_environment_does_not_mutate_caller_config(tmp_path):
+    from hypergraphdb_tpu import HGConfiguration
+    from hypergraphdb_tpu.core import environment
+
+    cfg = HGConfiguration()
+    g = environment.get(str(tmp_path / "db"), cfg)
+    assert cfg.location is None
+    assert cfg.store_backend == "memory"
+    environment.close(str(tmp_path / "db"))
