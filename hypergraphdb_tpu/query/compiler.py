@@ -1,0 +1,671 @@
+"""Query compiler: conditions → physical plans → (host|device) execution.
+
+Re-expression of the reference's compile pipeline (``cond2qry/
+ExpressionBasedQuery.java:853-875``): preprocess → expand → toDNF →
+simplify → translate, with the cost-based conjunction planner of
+``AndToQuery`` (``cond2qry/AndToQuery.java:102-306``: partition conjuncts
+into set-producing vs predicate classes, sort by expected size, intersect
+smallest-first, demote the rest to filters).
+
+The execution model is deliberately different from the reference's lazy
+cursor trees: every set-producing conjunct materializes as a **sorted int64
+array** (they already live in that form in the storage layer), and
+intersections/unions are vectorized merges — ``np.intersect1d`` is the
+batched equivalent of the reference's ZigZag/SortedIntersection duality
+(``impl/ZigZagIntersectionResult.java:23``). That same array form is what
+the device executor consumes: large plans are pushed to TPU as sorted-set
+kernels (``ops/setops.py``) while small ones stay on host — the planner
+duality from SURVEY §7 ("hard parts" #4).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Iterable, Optional, Sequence
+
+import numpy as np
+
+from hypergraphdb_tpu.core.errors import QueryError
+from hypergraphdb_tpu.query import conditions as c
+
+# ============================================================ physical plans
+
+
+class Plan:
+    """A physical plan node. ``run(graph) -> sorted np.int64 array``."""
+
+    def run(self, graph) -> np.ndarray:
+        raise NotImplementedError
+
+    def estimate(self, graph) -> float:
+        """Expected result size (the reference's ``QueryMetaData`` expected
+        size used for intersection ordering)."""
+        return float("inf")
+
+    def describe(self) -> str:
+        return type(self).__name__
+
+
+_EMPTY = np.empty(0, dtype=np.int64)
+
+
+@dataclass
+class EmptyPlan(Plan):
+    def run(self, graph):
+        return _EMPTY
+
+    def estimate(self, graph):
+        return 0.0
+
+    def describe(self):
+        return "∅"
+
+
+@dataclass
+class SingletonPlan(Plan):
+    handle: int
+
+    def run(self, graph):
+        if graph.contains(self.handle):
+            return np.asarray([self.handle], dtype=np.int64)
+        return _EMPTY
+
+    def estimate(self, graph):
+        return 1.0
+
+    def describe(self):
+        return f"is({self.handle})"
+
+
+@dataclass
+class AllAtomsPlan(Plan):
+    def run(self, graph):
+        return np.fromiter(graph.atoms(), dtype=np.int64)
+
+    def estimate(self, graph):
+        return 1e12  # deliberately last in any intersection ordering
+
+    def describe(self):
+        return "scan(*)"
+
+
+@dataclass
+class TypeSetPlan(Plan):
+    """All atoms of a type — by-type system index lookup."""
+
+    type_handle: int
+
+    def run(self, graph):
+        from hypergraphdb_tpu.core.graph import IDX_BY_TYPE, _type_key
+
+        return graph.store.get_index(IDX_BY_TYPE).find(
+            _type_key(self.type_handle)
+        ).array()
+
+    def estimate(self, graph):
+        from hypergraphdb_tpu.core.graph import IDX_BY_TYPE, _type_key
+
+        return float(
+            graph.store.get_index(IDX_BY_TYPE).count(_type_key(self.type_handle))
+        )
+
+    def describe(self):
+        return f"type({self.type_handle})"
+
+
+@dataclass
+class ValueSetPlan(Plan):
+    """Atoms by value via the by-value system index; eq or ordered range."""
+
+    key: bytes
+    op: str = "eq"
+    kind: bytes = b""  # kind prefix bounding range scans
+
+    def _find(self, graph):
+        from hypergraphdb_tpu.core.graph import IDX_BY_VALUE
+
+        idx = graph.store.get_index(IDX_BY_VALUE)
+        if self.op == "eq":
+            return idx.find(self.key)
+        hi_kind = bytes([self.kind[0] + 1]) if self.kind else None
+        if self.op == "lt":
+            return idx.find_range(lo=self.kind, hi=self.key, hi_inclusive=False)
+        if self.op == "lte":
+            return idx.find_range(lo=self.kind, hi=self.key, hi_inclusive=True)
+        if self.op == "gt":
+            return idx.find_range(lo=self.key, hi=hi_kind, lo_inclusive=False)
+        if self.op == "gte":
+            return idx.find_range(lo=self.key, hi=hi_kind, lo_inclusive=True)
+        raise QueryError(f"bad value op {self.op}")
+
+    def run(self, graph):
+        return self._find(graph).array()
+
+    def estimate(self, graph):
+        if self.op == "eq":
+            from hypergraphdb_tpu.core.graph import IDX_BY_VALUE
+
+            return float(graph.store.get_index(IDX_BY_VALUE).count(self.key))
+        return 1e6  # range: unknown without stats; assume large-ish
+
+    def describe(self):
+        return f"value[{self.op}]"
+
+
+@dataclass
+class IncidentPlan(Plan):
+    """The incidence set of an atom — sorted by construction."""
+
+    target: int
+
+    def run(self, graph):
+        return graph.get_incidence_set(self.target).array()
+
+    def estimate(self, graph):
+        return float(graph.store.incidence_count(self.target))
+
+    def describe(self):
+        return f"incident({self.target})"
+
+
+@dataclass
+class TargetSetPlan(Plan):
+    """The (sorted, deduped) targets of a link."""
+
+    link: int
+
+    def run(self, graph):
+        try:
+            ts = graph.get_targets(self.link)
+        except Exception:
+            return _EMPTY
+        return np.unique(np.asarray(ts, dtype=np.int64)) if ts else _EMPTY
+
+    def estimate(self, graph):
+        try:
+            return float(graph.arity(self.link))
+        except Exception:
+            return 0.0
+
+    def describe(self):
+        return f"targets({self.link})"
+
+
+@dataclass
+class IndexSetPlan(Plan):
+    """Lookup in a registered user index."""
+
+    name: str
+    key: bytes
+    op: str = "eq"
+
+    def run(self, graph):
+        from hypergraphdb_tpu.indexing.manager import get_index
+
+        idx = get_index(graph, self.name)
+        if self.op == "eq":
+            return idx.find(self.key).array()
+        return {
+            "lt": idx.find_lt,
+            "lte": idx.find_lte,
+            "gt": idx.find_gt,
+            "gte": idx.find_gte,
+        }[self.op](self.key).array()
+
+    def estimate(self, graph):
+        from hypergraphdb_tpu.indexing.manager import get_index
+
+        if self.op == "eq":
+            return float(get_index(graph, self.name).count(self.key))
+        return 1e6
+
+    def describe(self):
+        return f"index({self.name})[{self.op}]"
+
+
+@dataclass
+class TraversalPlan(Plan):
+    """Reachable-set materialization of a BFS/DFS condition (the reference's
+    ``TraversalBasedQuery``). Device-accelerated for large graphs via the
+    CSR snapshot BFS kernel."""
+
+    start: int
+    max_distance: Optional[int]
+    include_start: bool
+    depth_first: bool = False
+
+    def run(self, graph):
+        from hypergraphdb_tpu.algorithms.traversals import (
+            HGBreadthFirstTraversal,
+            HGDepthFirstTraversal,
+        )
+
+        cls = HGDepthFirstTraversal if self.depth_first else HGBreadthFirstTraversal
+        out = [a for _, a in cls(graph, self.start, max_distance=self.max_distance)]
+        if self.include_start:
+            out.append(int(self.start))
+        return np.unique(np.asarray(out, dtype=np.int64)) if out else _EMPTY
+
+    def describe(self):
+        return f"{'dfs' if self.depth_first else 'bfs'}({self.start})"
+
+
+@dataclass
+class IntersectPlan(Plan):
+    """Sorted-set intersection of children + residual predicate filters —
+    the vectorized AndToQuery output."""
+
+    children: list[Plan]
+    predicates: list[c.HGQueryCondition] = field(default_factory=list)
+
+    def run(self, graph):
+        ordered = sorted(self.children, key=lambda p: p.estimate(graph))
+        cfg = graph.config.query
+        # planner duality (SURVEY §7 hard part 4): small intersections stay
+        # on host cursors; large ones amortize a device kernel launch
+        if (
+            cfg.prefer_device
+            and len(ordered) > 1
+            and ordered[0].estimate(graph) >= cfg.device_min_batch
+        ):
+            try:
+                from hypergraphdb_tpu.ops.setops import device_intersect_sorted
+
+                arrays = [c.run(graph) for c in ordered]
+                if any(len(a) == 0 for a in arrays):
+                    return _EMPTY
+                arr = device_intersect_sorted(arrays)
+                return filter_predicates(graph, arr, self.predicates)
+            except Exception:
+                pass  # fall back to host path
+        arr = ordered[0].run(graph)
+        for child in ordered[1:]:
+            if len(arr) == 0:
+                return arr
+            arr = intersect_sorted(graph, arr, child.run(graph))
+        return filter_predicates(graph, arr, self.predicates)
+
+    def estimate(self, graph):
+        return min((p.estimate(graph) for p in self.children), default=0.0)
+
+    def describe(self):
+        inner = " ∩ ".join(p.describe() for p in self.children)
+        if self.predicates:
+            inner += " | " + ",".join(type(p).__name__ for p in self.predicates)
+        return f"({inner})"
+
+
+@dataclass
+class UnionPlan(Plan):
+    children: list[Plan]
+    parallel: bool = False
+
+    def run(self, graph):
+        if self.parallel and len(self.children) > 1:
+            # OrToParellelQuery/UnionResultAsync analogue
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=min(8, len(self.children))) as ex:
+                arrays = list(ex.map(lambda p: p.run(graph), self.children))
+        else:
+            arrays = [p.run(graph) for p in self.children]
+        arrays = [a for a in arrays if len(a)]
+        if not arrays:
+            return _EMPTY
+        return np.unique(np.concatenate(arrays))
+
+    def estimate(self, graph):
+        return sum(p.estimate(graph) for p in self.children)
+
+    def describe(self):
+        return "(" + " ∪ ".join(p.describe() for p in self.children) + ")"
+
+
+@dataclass
+class FilterScanPlan(Plan):
+    """Full scan + predicates — the W class: no index narrows it."""
+
+    predicates: list[c.HGQueryCondition]
+
+    def run(self, graph):
+        arr = np.fromiter(graph.atoms(), dtype=np.int64)
+        return filter_predicates(graph, arr, self.predicates)
+
+    def describe(self):
+        return "scan|" + ",".join(type(p).__name__ for p in self.predicates)
+
+
+# ============================================================ helpers
+
+
+def intersect_sorted(graph, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Vectorized sorted intersection. For wildly different sizes use
+    searchsorted probing (the zig-zag/leapfrog analogue); otherwise a
+    merge (``np.intersect1d``) — mirroring the reference's
+    ZigZag-vs-SortedIntersection choice by size ratio."""
+    if len(a) == 0 or len(b) == 0:
+        return _EMPTY
+    small, large = (a, b) if len(a) <= len(b) else (b, a)
+    if len(large) > 32 * len(small):
+        pos = np.searchsorted(large, small)
+        pos = np.minimum(pos, len(large) - 1)
+        return small[large[pos] == small]
+    return np.intersect1d(a, b, assume_unique=True)
+
+
+def filter_predicates(
+    graph, arr: np.ndarray, predicates: Sequence[c.HGQueryCondition]
+) -> np.ndarray:
+    if not predicates or len(arr) == 0:
+        return arr
+    keep = [h for h in arr.tolist() if all(p.satisfies(graph, h) for p in predicates)]
+    return np.asarray(keep, dtype=np.int64)
+
+
+# ============================================================ rewriting
+
+
+def expand(graph, cond: c.HGQueryCondition) -> c.HGQueryCondition:
+    """Expansion pass (``ExpressionBasedQuery.expand`` :603): rewrite sugar
+    into primitive conditions + discover applicable user indices."""
+    if isinstance(cond, c.And):
+        return c.And(*(expand(graph, x) for x in cond.clauses))
+    if isinstance(cond, c.Or):
+        return c.Or(*(expand(graph, x) for x in cond.clauses))
+    if isinstance(cond, c.Not):
+        return c.Not(expand(graph, cond.clause))
+    if isinstance(cond, c.TypePlus):
+        ts = graph.typesystem
+        name = cond.type if isinstance(cond.type, str) else ts.name_of(cond.type)
+        closure = sorted(ts.subtypes_closure(name))
+        return c.Or(*(c.AtomType(n) for n in closure))
+    if isinstance(cond, c.Link):
+        if not cond.targets:
+            return c.IsLink()
+        return c.And(*(c.Incident(t) for t in cond.targets))
+    if isinstance(cond, c.OrderedLink):
+        if not cond.targets:
+            return c.IsLink()
+        # incidence narrows; the order itself stays a predicate
+        return c.And(*(c.Incident(t) for t in cond.targets), cond)
+    if isinstance(cond, c.TypedValue):
+        return c.And(c.AtomType(cond.type), c.AtomValue(cond.value, cond.op))
+    return cond
+
+
+def _find_part_index(graph, cond: c.AtomPart, type_handles: set[int]
+                     ) -> Optional[c.IndexCondition]:
+    """Index discovery (``ExpressionBasedQuery.findIndex`` :59): an
+    ``AtomPart`` becomes a direct index lookup ONLY when the enclosing
+    conjunction already constrains the atom type to one covered by a
+    registered ByPartIndexer — an index must never change query answers by
+    excluding other types."""
+    from hypergraphdb_tpu.indexing.manager import ByPartIndexer, _registry
+
+    pt = graph.typesystem.infer(cond.value)
+    if pt is None:
+        return None
+    for type_handle, idxs in _registry(graph).items():
+        if int(type_handle) not in type_handles:
+            continue
+        for ix in idxs:
+            if isinstance(ix, ByPartIndexer) and ix.dimension == cond.path:
+                return c.IndexCondition(ix.name, pt.to_key(cond.value), cond.op)
+    return None
+
+
+def _substitute_part_indices(graph, conj: c.And) -> c.And:
+    """Within one conjunction, swap AtomPart conditions for index lookups
+    where sound (the type is pinned and indexed on that dimension)."""
+    type_handles = {
+        x.type_handle(graph) for x in conj.clauses if isinstance(x, c.AtomType)
+    }
+    if not type_handles:
+        return conj
+    out = []
+    for cl in conj.clauses:
+        if isinstance(cl, c.AtomPart):
+            sub = _find_part_index(graph, cl, type_handles)
+            out.append(sub if sub is not None else cl)
+        else:
+            out.append(cl)
+    return c.And(*out)
+
+
+def to_dnf(cond: c.HGQueryCondition) -> c.HGQueryCondition:
+    """DNF normalization (``ExpressionBasedQuery.toDNF`` :94) with negation
+    pushed to the leaves."""
+    cond = _push_not(cond, False)
+    return _distribute(cond)
+
+
+def _push_not(cond: c.HGQueryCondition, neg: bool) -> c.HGQueryCondition:
+    if isinstance(cond, c.Not):
+        return _push_not(cond.clause, not neg)
+    if isinstance(cond, c.And):
+        parts = [_push_not(x, neg) for x in cond.clauses]
+        return c.Or(*parts) if neg else c.And(*parts)
+    if isinstance(cond, c.Or):
+        parts = [_push_not(x, neg) for x in cond.clauses]
+        return c.And(*parts) if neg else c.Or(*parts)
+    if neg:
+        if isinstance(cond, c.AnyAtom):
+            return c.Nothing()
+        if isinstance(cond, c.Nothing):
+            return c.AnyAtom()
+        return c.Not(cond)
+    return cond
+
+
+def _distribute(cond: c.HGQueryCondition) -> c.HGQueryCondition:
+    if isinstance(cond, c.Or):
+        return c.Or(*(_distribute(x) for x in cond.clauses))
+    if isinstance(cond, c.And):
+        clauses = [_distribute(x) for x in cond.clauses]
+        # flatten nested Ands
+        flat: list = []
+        for cl in clauses:
+            if isinstance(cl, c.And):
+                flat.extend(cl.clauses)
+            else:
+                flat.append(cl)
+        or_idx = next((i for i, cl in enumerate(flat) if isinstance(cl, c.Or)), None)
+        if or_idx is None:
+            return c.And(*flat)
+        the_or = flat[or_idx]
+        rest = flat[:or_idx] + flat[or_idx + 1 :]
+        return _distribute(
+            c.Or(*(c.And(branch, *rest) for branch in the_or.clauses))
+        )
+    return cond
+
+
+def simplify(graph, cond: c.HGQueryCondition) -> c.HGQueryCondition:
+    """Simplification (``ExpressionBasedQuery.simplify`` :219): flatten,
+    dedupe, fold contradictions to Nothing, drop AnyAtom in conjunctions."""
+    if isinstance(cond, c.Or):
+        out = []
+        for cl in cond.clauses:
+            s = simplify(graph, cl)
+            if isinstance(s, c.Nothing):
+                continue
+            if isinstance(s, c.AnyAtom):
+                return c.AnyAtom()
+            if isinstance(s, c.Or):
+                out.extend(s.clauses)
+            else:
+                out.append(s)
+        out = list(dict.fromkeys(out))
+        if not out:
+            return c.Nothing()
+        return out[0] if len(out) == 1 else c.Or(*out)
+    if isinstance(cond, c.And):
+        out = []
+        for cl in cond.clauses:
+            s = simplify(graph, cl)
+            if isinstance(s, c.Nothing):
+                return c.Nothing()
+            if isinstance(s, c.AnyAtom):
+                continue
+            if isinstance(s, c.And):
+                out.extend(s.clauses)
+            else:
+                out.append(s)
+        out = list(dict.fromkeys(out))
+        # contradiction: two different exact types
+        types = {
+            x.type_handle(graph) for x in out if isinstance(x, c.AtomType)
+        }
+        if len(types) > 1:
+            return c.Nothing()
+        # contradiction: Is(h) conflicting with Is(h')
+        handles = {x.handle for x in out if isinstance(x, c.Is)}
+        if len(handles) > 1:
+            return c.Nothing()
+        if not out:
+            return c.AnyAtom()
+        return out[0] if len(out) == 1 else c.And(*out)
+    if isinstance(cond, c.Not):
+        inner = simplify(graph, cond.clause)
+        if isinstance(inner, c.Nothing):
+            return c.AnyAtom()
+        if isinstance(inner, c.AnyAtom):
+            return c.Nothing()
+        return c.Not(inner)
+    return cond
+
+
+def _apply_index_substitution(graph, cond: c.HGQueryCondition) -> c.HGQueryCondition:
+    """Per-conjunction index substitution (the reference folds this into
+    ``simplify``, ``ExpressionBasedQuery.java:449-541``)."""
+    if isinstance(cond, c.Or):
+        return c.Or(*(_apply_index_substitution(graph, x) for x in cond.clauses))
+    if isinstance(cond, c.And):
+        return _substitute_part_indices(graph, cond)
+    return cond
+
+
+# ============================================================ translation
+
+
+def _leaf_plan(graph, cond: c.HGQueryCondition) -> Optional[Plan]:
+    """Set-producing translation of a leaf (the ORA/O classes of
+    ``AndToQuery.java:114-149``); None means predicate-only (P class)."""
+    if isinstance(cond, c.AtomType):
+        return TypeSetPlan(cond.type_handle(graph))
+    if isinstance(cond, c.AtomValue):
+        vt = graph.typesystem.infer(cond.value)
+        if vt is None:
+            return None
+        return ValueSetPlan(vt.to_key(cond.value), cond.op, kind=vt.kind)
+    if isinstance(cond, c.Incident):
+        return IncidentPlan(int(cond.target))
+    if isinstance(cond, c.PositionedIncident):
+        # incidence narrows, position check stays a predicate (cheap)
+        return IncidentPlan(int(cond.target))
+    if isinstance(cond, c.Target):
+        return TargetSetPlan(int(cond.link))
+    if isinstance(cond, c.Is):
+        return SingletonPlan(int(cond.handle))
+    if isinstance(cond, c.IndexCondition):
+        return IndexSetPlan(cond.name, cond.key, cond.op)
+    if isinstance(cond, c.BFS):
+        return TraversalPlan(cond.start, cond.max_distance, cond.include_start, False)
+    if isinstance(cond, c.DFS):
+        return TraversalPlan(cond.start, cond.max_distance, cond.include_start, True)
+    if isinstance(cond, c.SubgraphMember):
+        from hypergraphdb_tpu.atom.subgraph import member_index_plan
+
+        return member_index_plan(graph, cond.subgraph)
+    if isinstance(cond, c.AnyAtom):
+        return AllAtomsPlan()
+    if isinstance(cond, c.Nothing):
+        return EmptyPlan()
+    return None
+
+
+# predicates that still narrow results when combined with a set: keep as filter
+def _residual_predicate(cond: c.HGQueryCondition) -> Optional[c.HGQueryCondition]:
+    if isinstance(cond, c.PositionedIncident):
+        return cond  # set + this position filter
+    return None
+
+
+def translate(graph, cond: c.HGQueryCondition, parallel_or: bool = False) -> Plan:
+    """Translate a simplified DNF condition into a physical plan
+    (``QueryCompile.translate`` → ``ToQueryMap`` dispatch)."""
+    if isinstance(cond, c.Or):
+        return UnionPlan(
+            [translate(graph, x, parallel_or) for x in cond.clauses],
+            parallel=parallel_or,
+        )
+    if isinstance(cond, c.And):
+        sets: list[Plan] = []
+        preds: list[c.HGQueryCondition] = []
+        for cl in cond.clauses:
+            p = _leaf_plan(graph, cl)
+            if p is None:
+                preds.append(cl)
+            else:
+                sets.append(p)
+                extra = _residual_predicate(cl)
+                if extra is not None:
+                    preds.append(extra)
+        if not sets:
+            return FilterScanPlan(preds)
+        if len(sets) == 1 and not preds:
+            return sets[0]
+        return IntersectPlan(sets, preds)
+    # single leaf
+    p = _leaf_plan(graph, cond)
+    if p is not None:
+        extra = _residual_predicate(cond)
+        if extra is not None:
+            return IntersectPlan([p], [extra])
+        return p
+    return FilterScanPlan([cond])
+
+
+# ============================================================ compiled query
+
+
+@dataclass
+class CompiledQuery:
+    """The executable query handle (``HGQuery`` + ``AnalyzedQuery``
+    introspection: ``plan.describe()`` is the plan dump)."""
+
+    graph: Any
+    condition: c.HGQueryCondition
+    simplified: c.HGQueryCondition
+    plan: Plan
+
+    def execute(self) -> Iterable[int]:
+        def run():
+            return self.plan.run(self.graph)
+
+        arr = self.graph.txman.ensure_transaction(run, readonly=True)
+        return iter(arr.tolist())
+
+    def results(self) -> np.ndarray:
+        return self.plan.run(self.graph)
+
+    def count(self) -> int:
+        return int(len(self.plan.run(self.graph)))
+
+    def analyze(self) -> str:
+        return self.plan.describe()
+
+
+def compile_query(graph, condition: c.HGQueryCondition) -> CompiledQuery:
+    """The full pipeline (``ExpressionBasedQuery.compileProcess`` :853)."""
+    if not isinstance(condition, c.HGQueryCondition):
+        raise QueryError(f"not a condition: {condition!r}")
+    expanded = expand(graph, condition)
+    dnf = to_dnf(expanded)
+    simplified = simplify(graph, dnf)
+    simplified = _apply_index_substitution(graph, simplified)
+    plan = translate(
+        graph, simplified, parallel_or=graph.config.query.parallel_or
+    )
+    return CompiledQuery(graph, condition, simplified, plan)

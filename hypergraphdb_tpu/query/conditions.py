@@ -1,0 +1,451 @@
+"""Query condition vocabulary.
+
+Re-expression of the reference's 41-file condition package
+(``core/src/java/org/hypergraphdb/query/`` — SURVEY §2.1 "Query
+conditions"): ``And/Or/Not/Nothing``, ``AtomTypeCondition``,
+``TypePlusCondition``, ``AtomValueCondition``, ``AtomPartCondition``,
+``TypedValueCondition``, ``IncidentCondition``,
+``PositionedIncidentCondition``, ``LinkCondition``,
+``OrderedLinkCondition``, ``TargetCondition``, ``ArityCondition``,
+``BFSCondition``/``DFSCondition``, ``SubgraphMemberCondition``,
+``IndexCondition``, ``MapCondition`` (here: ``Predicate``), ``IsCondition``,
+``AnyAtomCondition``.
+
+Conditions are frozen dataclasses — pure values the compiler rewrites.
+Every condition can also act as a per-atom predicate via ``satisfies``
+(the ``HGAtomPredicate.satisfies(graph, handle)`` contract), which is the
+fallback execution mode when no index applies.
+"""
+
+from __future__ import annotations
+
+import operator
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from hypergraphdb_tpu.core.handles import HGHandle
+
+_OPS = {
+    "eq": operator.eq,
+    "lt": operator.lt,
+    "lte": operator.le,
+    "gt": operator.gt,
+    "gte": operator.ge,
+}
+
+
+class HGQueryCondition:
+    """Base class; every condition is also an atom predicate."""
+
+    def satisfies(self, graph, h: HGHandle) -> bool:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------- trivial
+
+
+@dataclass(frozen=True)
+class AnyAtom(HGQueryCondition):
+    def satisfies(self, graph, h):
+        return graph.contains(h)
+
+
+@dataclass(frozen=True)
+class Nothing(HGQueryCondition):
+    def satisfies(self, graph, h):
+        return False
+
+
+# ---------------------------------------------------------------- boolean
+
+
+@dataclass(frozen=True)
+class And(HGQueryCondition):
+    clauses: tuple[HGQueryCondition, ...]
+
+    def __init__(self, *clauses: HGQueryCondition):
+        object.__setattr__(self, "clauses", tuple(clauses))
+
+    def satisfies(self, graph, h):
+        return all(c.satisfies(graph, h) for c in self.clauses)
+
+
+@dataclass(frozen=True)
+class Or(HGQueryCondition):
+    clauses: tuple[HGQueryCondition, ...]
+
+    def __init__(self, *clauses: HGQueryCondition):
+        object.__setattr__(self, "clauses", tuple(clauses))
+
+    def satisfies(self, graph, h):
+        return any(c.satisfies(graph, h) for c in self.clauses)
+
+
+@dataclass(frozen=True)
+class Not(HGQueryCondition):
+    clause: HGQueryCondition
+
+    def satisfies(self, graph, h):
+        return not self.clause.satisfies(graph, h)
+
+
+# ---------------------------------------------------------------- identity
+
+
+@dataclass(frozen=True)
+class Is(HGQueryCondition):
+    """Identity (``IsCondition``)."""
+
+    handle: HGHandle
+
+    def satisfies(self, graph, h):
+        return int(h) == int(self.handle)
+
+
+# ---------------------------------------------------------------- type
+
+
+@dataclass(frozen=True)
+class AtomType(HGQueryCondition):
+    """Exact type (``AtomTypeCondition.java:38``). ``type`` is a type name
+    or a type-atom handle."""
+
+    type: Any
+
+    def type_handle(self, graph) -> HGHandle:
+        if isinstance(self.type, str):
+            return graph.typesystem.handle_of(self.type)
+        return int(self.type)
+
+    def satisfies(self, graph, h):
+        return graph.get_type_handle_of(h) == self.type_handle(graph)
+
+
+@dataclass(frozen=True)
+class TypePlus(HGQueryCondition):
+    """Type or any of its subtypes (``TypePlusCondition``); expanded to an
+    ``Or`` of ``AtomType`` during compilation."""
+
+    type: Any
+
+    def satisfies(self, graph, h):
+        ts = graph.typesystem
+        name = self.type if isinstance(self.type, str) else ts.name_of(self.type)
+        closure = {ts.handle_of(n) for n in ts.subtypes_closure(name)}
+        return graph.get_type_handle_of(h) in closure
+
+
+# ---------------------------------------------------------------- value
+
+
+def _key_compare(graph, atom_key: bytes, query_key: bytes, op: str) -> bool:
+    """Compare two order-preserving value keys. Cross-kind comparisons are
+    always False (the reference's Java ``equals``/comparator is likewise
+    type-strict), which keeps the predicate path bit-identical to the
+    by-value index path."""
+    if atom_key[:1] != query_key[:1]:
+        return False
+    return _OPS[op](atom_key, query_key)
+
+
+@dataclass(frozen=True)
+class AtomValue(HGQueryCondition):
+    """Value comparison (``AtomValueCondition``); ``op`` one of
+    eq/lt/lte/gt/gte — non-eq ops require an ordered value kind.
+
+    Comparison is type-strict via order-preserving keys, so predicate
+    evaluation and index lookup agree exactly."""
+
+    value: Any
+    op: str = "eq"
+
+    def satisfies(self, graph, h):
+        from hypergraphdb_tpu.core.graph import HGLink
+
+        v = graph.get(h)
+        if isinstance(v, HGLink):
+            v = v.value
+        at = graph.typesystem.get_type(graph.get_type_handle_of(h))
+        qt = graph.typesystem.infer(self.value)
+        if qt is None:
+            return False
+        try:
+            return _key_compare(graph, at.to_key(v), qt.to_key(self.value), self.op)
+        except Exception:
+            return False
+
+
+@dataclass(frozen=True)
+class TypedValue(HGQueryCondition):
+    """Value + type (``TypedValueCondition``)."""
+
+    value: Any
+    type: Any
+    op: str = "eq"
+
+    def satisfies(self, graph, h):
+        return AtomType(self.type).satisfies(graph, h) and AtomValue(
+            self.value, self.op
+        ).satisfies(graph, h)
+
+
+@dataclass(frozen=True)
+class AtomPart(HGQueryCondition):
+    """Projection-path comparison on record values (``AtomPartCondition``)."""
+
+    path: str
+    value: Any
+    op: str = "eq"
+
+    def satisfies(self, graph, h):
+        from hypergraphdb_tpu.core.graph import HGLink
+
+        v = graph.get(h)
+        if isinstance(v, HGLink):
+            v = v.value
+        th = graph.get_type_handle_of(h)
+        atype = graph.typesystem.get_type(th)
+        try:
+            part = atype.project(v, self.path)
+        except Exception:
+            return False
+        if part is None:
+            return False
+        pt = graph.typesystem.infer(part)
+        qt = graph.typesystem.infer(self.value)
+        if pt is None or qt is None:
+            return False
+        try:
+            return _key_compare(
+                graph, pt.to_key(part), qt.to_key(self.value), self.op
+            )
+        except Exception:
+            return False
+
+
+# ---------------------------------------------------------------- structure
+
+
+@dataclass(frozen=True)
+class Incident(HGQueryCondition):
+    """Links pointing at ``target`` (``IncidentCondition``) — i.e. membership
+    in the target's incidence set. THE building block of graph patterns."""
+
+    target: HGHandle
+
+    def satisfies(self, graph, h):
+        return int(h) in graph.get_incidence_set(self.target)
+
+
+@dataclass(frozen=True)
+class PositionedIncident(HGQueryCondition):
+    """Links having ``target`` at position ``position``
+    (``PositionedIncidentCondition``)."""
+
+    target: HGHandle
+    position: int
+
+    def satisfies(self, graph, h):
+        try:
+            ts = graph.get_targets(h)
+        except Exception:
+            return False
+        return self.position < len(ts) and ts[self.position] == int(self.target)
+
+
+@dataclass(frozen=True)
+class Link(HGQueryCondition):
+    """Links containing ALL the given targets, any positions
+    (``LinkCondition``); expanded to ``And`` of ``Incident``."""
+
+    targets: tuple[HGHandle, ...]
+
+    def __init__(self, *targets: HGHandle):
+        object.__setattr__(self, "targets", tuple(int(t) for t in targets))
+
+    def satisfies(self, graph, h):
+        try:
+            ts = set(graph.get_targets(h))
+        except Exception:
+            return False
+        return set(self.targets) <= ts
+
+
+@dataclass(frozen=True)
+class OrderedLink(HGQueryCondition):
+    """Links whose target tuple starts with exactly these targets in order
+    (``OrderedLinkCondition``)."""
+
+    targets: tuple[HGHandle, ...]
+
+    def __init__(self, *targets: HGHandle):
+        object.__setattr__(self, "targets", tuple(int(t) for t in targets))
+
+    def satisfies(self, graph, h):
+        try:
+            ts = graph.get_targets(h)
+        except Exception:
+            return False
+        return ts[: len(self.targets)] == self.targets
+
+
+@dataclass(frozen=True)
+class Target(HGQueryCondition):
+    """Atoms that are targets of the given link (``TargetCondition``)."""
+
+    link: HGHandle
+
+    def satisfies(self, graph, h):
+        try:
+            return int(h) in graph.get_targets(self.link)
+        except Exception:
+            return False
+
+
+@dataclass(frozen=True)
+class Arity(HGQueryCondition):
+    """Link arity comparison (``ArityCondition``)."""
+
+    arity: int
+    op: str = "eq"
+
+    def satisfies(self, graph, h):
+        try:
+            n = graph.arity(h)
+        except Exception:
+            return False
+        return _OPS[self.op](n, self.arity)
+
+
+@dataclass(frozen=True)
+class IsLink(HGQueryCondition):
+    def satisfies(self, graph, h):
+        try:
+            return graph.is_link(h)
+        except Exception:
+            return False
+
+
+@dataclass(frozen=True)
+class IsNode(HGQueryCondition):
+    def satisfies(self, graph, h):
+        try:
+            return not graph.is_link(h)
+        except Exception:
+            return False
+
+
+# ---------------------------------------------------------------- index
+
+
+@dataclass(frozen=True)
+class IndexCondition(HGQueryCondition):
+    """Direct lookup in a registered user index (``IndexCondition`` /
+    ``IndexedPartCondition``): key comparison against index ``name``."""
+
+    name: str
+    key: bytes
+    op: str = "eq"
+
+    def satisfies(self, graph, h):
+        from hypergraphdb_tpu.indexing.manager import get_index
+
+        idx = get_index(graph, self.name)
+        if self.op == "eq":
+            return int(h) in idx.find(self.key)
+        rs = {
+            "lt": idx.find_lt,
+            "lte": idx.find_lte,
+            "gt": idx.find_gt,
+            "gte": idx.find_gte,
+        }[self.op](self.key)
+        return int(h) in rs
+
+
+# ---------------------------------------------------------------- traversal
+
+
+@dataclass(frozen=True)
+class BFS(HGQueryCondition):
+    """Atoms reachable breadth-first from ``start`` (``BFSCondition``)."""
+
+    start: HGHandle
+    max_distance: Optional[int] = None
+    include_start: bool = False
+
+    def satisfies(self, graph, h):
+        from hypergraphdb_tpu.algorithms.traversals import HGBreadthFirstTraversal
+
+        if self.include_start and int(h) == int(self.start):
+            return True
+        for _, atom in HGBreadthFirstTraversal(
+            graph, self.start, max_distance=self.max_distance
+        ):
+            if atom == int(h):
+                return True
+        return False
+
+
+@dataclass(frozen=True)
+class DFS(HGQueryCondition):
+    """Atoms reachable depth-first from ``start`` (``DFSCondition``)."""
+
+    start: HGHandle
+    max_distance: Optional[int] = None
+    include_start: bool = False
+
+    def satisfies(self, graph, h):
+        from hypergraphdb_tpu.algorithms.traversals import HGDepthFirstTraversal
+
+        if self.include_start and int(h) == int(self.start):
+            return True
+        for _, atom in HGDepthFirstTraversal(
+            graph, self.start, max_distance=self.max_distance
+        ):
+            if atom == int(h):
+                return True
+        return False
+
+
+# ---------------------------------------------------------------- subgraph
+
+
+@dataclass(frozen=True)
+class SubgraphMember(HGQueryCondition):
+    """Members of a named subgraph (``SubgraphMemberCondition``)."""
+
+    subgraph: HGHandle
+
+    def satisfies(self, graph, h):
+        from hypergraphdb_tpu.atom.subgraph import HGSubgraph
+
+        return HGSubgraph.of(graph, self.subgraph).is_member(h)
+
+
+@dataclass(frozen=True)
+class SubgraphContains(HGQueryCondition):
+    """Subgraphs containing the given atom (``SubgraphContainsCondition``)."""
+
+    atom: HGHandle
+
+    def satisfies(self, graph, h):
+        from hypergraphdb_tpu.atom.subgraph import HGSubgraph
+
+        try:
+            return HGSubgraph.of(graph, h).is_member(self.atom)
+        except Exception:
+            return False
+
+
+# ---------------------------------------------------------------- arbitrary
+
+
+@dataclass(frozen=True)
+class Predicate(HGQueryCondition):
+    """Arbitrary predicate over (graph, handle) (``MapCondition`` /
+    user ``HGAtomPredicate``). Opaque to the planner: always a filter."""
+
+    fn: Callable[[Any, HGHandle], bool]
+
+    def satisfies(self, graph, h):
+        return self.fn(graph, h)

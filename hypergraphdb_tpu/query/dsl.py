@@ -1,0 +1,153 @@
+"""The ``hg`` query DSL.
+
+Mirror of the reference's ``hg`` expression namespace
+(``core/src/java/org/hypergraphdb/HGQuery.java:364`` — ``hg.type(...)``,
+``hg.value(...)``, ``hg.incident(...)``, ``hg.and(...)``, ``hg.findAll``).
+
+    from hypergraphdb_tpu.query import dsl as hg
+    hg.find_all(graph, hg.and_(hg.type("string"), hg.incident(h)))
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from hypergraphdb_tpu.query import conditions as c
+
+# condition constructors ------------------------------------------------------
+
+all_atoms = c.AnyAtom
+nothing = c.Nothing
+
+
+def and_(*clauses) -> c.And:
+    return c.And(*clauses)
+
+
+def or_(*clauses) -> c.Or:
+    return c.Or(*clauses)
+
+
+def not_(clause) -> c.Not:
+    return c.Not(clause)
+
+
+def is_(handle) -> c.Is:
+    return c.Is(int(handle))
+
+
+def type_(t) -> c.AtomType:
+    return c.AtomType(t)
+
+
+# keep reference-style aliases too
+type = type_  # noqa: A001
+typePlus = type_plus = lambda t: c.TypePlus(t)  # noqa: E731
+
+
+def value(v, op: str = "eq") -> c.AtomValue:
+    return c.AtomValue(v, op)
+
+
+def eq(v) -> c.AtomValue:
+    return c.AtomValue(v, "eq")
+
+
+def lt(v) -> c.AtomValue:
+    return c.AtomValue(v, "lt")
+
+
+def lte(v) -> c.AtomValue:
+    return c.AtomValue(v, "lte")
+
+
+def gt(v) -> c.AtomValue:
+    return c.AtomValue(v, "gt")
+
+
+def gte(v) -> c.AtomValue:
+    return c.AtomValue(v, "gte")
+
+
+def typed_value(t, v, op: str = "eq") -> c.TypedValue:
+    return c.TypedValue(v, t, op)
+
+
+def part(path: str, v, op: str = "eq") -> c.AtomPart:
+    return c.AtomPart(path, v, op)
+
+
+def incident(target) -> c.Incident:
+    return c.Incident(int(target))
+
+
+def incident_at(target, position: int) -> c.PositionedIncident:
+    return c.PositionedIncident(int(target), position)
+
+
+def link(*targets) -> c.Link:
+    return c.Link(*targets)
+
+
+def ordered_link(*targets) -> c.OrderedLink:
+    return c.OrderedLink(*targets)
+
+
+def target(link_handle) -> c.Target:
+    return c.Target(int(link_handle))
+
+
+def arity(n: int, op: str = "eq") -> c.Arity:
+    return c.Arity(n, op)
+
+
+is_link = c.IsLink
+is_node = c.IsNode
+
+
+def in_index(name: str, key: bytes, op: str = "eq") -> c.IndexCondition:
+    return c.IndexCondition(name, key, op)
+
+
+def bfs(start, max_distance: Optional[int] = None, include_start: bool = False) -> c.BFS:
+    return c.BFS(int(start), max_distance, include_start)
+
+
+def dfs(start, max_distance: Optional[int] = None, include_start: bool = False) -> c.DFS:
+    return c.DFS(int(start), max_distance, include_start)
+
+
+def member_of(subgraph) -> c.SubgraphMember:
+    return c.SubgraphMember(int(subgraph))
+
+
+def contains(atom) -> c.SubgraphContains:
+    return c.SubgraphContains(int(atom))
+
+
+def predicate(fn) -> c.Predicate:
+    return c.Predicate(fn)
+
+
+# execution helpers (hg.findAll / hg.getAll / hg.count) -----------------------
+
+
+def find_all(graph, condition) -> list[int]:
+    return graph.find_all(condition)
+
+
+def find_one(graph, condition) -> Optional[int]:
+    return graph.find_one(condition)
+
+
+def get_all(graph, condition) -> list[Any]:
+    return [graph.get(h) for h in graph.find_all(condition)]
+
+
+def get_one(graph, condition) -> Any:
+    h = graph.find_one(condition)
+    return None if h is None else graph.get(h)
+
+
+def count(graph, condition) -> int:
+    return graph.count(condition)

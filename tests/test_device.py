@@ -1,0 +1,165 @@
+"""Device-plane tests: CSR snapshot correctness + differential kernel checks.
+
+The M4 gate from SURVEY §7: device BFS and conjunctive-pattern kernels must
+match the host engine bit-for-bit on random hypergraphs.
+"""
+
+import numpy as np
+import pytest
+
+from hypergraphdb_tpu import HyperGraph
+from hypergraphdb_tpu.algorithms.traversals import HGBreadthFirstTraversal
+from hypergraphdb_tpu.ops import frontier as F
+from hypergraphdb_tpu.ops import setops as S
+from hypergraphdb_tpu.query import dsl as hg
+
+from conftest import make_random_hypergraph
+
+
+@pytest.fixture(scope="module")
+def random_db():
+    g = HyperGraph()
+    nodes, links = make_random_hypergraph(g, n_nodes=150, n_links=400, max_arity=4,
+                                          seed=3)
+    snap = g.snapshot()
+    yield g, nodes, links, snap
+    g.close()
+
+
+# ---------------------------------------------------------------- snapshot
+
+
+def test_snapshot_incidence_matches_store(random_db):
+    g, nodes, links, snap = random_db
+    for a in nodes[:20]:
+        expected = g.get_incidence_set(a).array()
+        got = snap.incidence_row(a)
+        assert got.tolist() == expected.tolist()
+
+
+def test_snapshot_targets_match_store(random_db):
+    g, nodes, links, snap = random_db
+    for l in links[:20]:
+        assert snap.targets_row(l).tolist() == list(g.get_targets(l))
+        assert snap.arity[l] == g.arity(l)
+        assert bool(snap.is_link[l])
+
+
+def test_snapshot_types_match(random_db):
+    g, nodes, links, snap = random_db
+    for h in (*nodes[:10], *links[:10]):
+        assert snap.type_of[h] == g.get_type_handle_of(h)
+
+
+def test_snapshot_by_type_index(random_db):
+    g, nodes, links, snap = random_db
+    th = g.typesystem.handle_of("string")
+    expected = sorted(g.find_all(hg.type_("string")))
+    assert snap.type_set(th).tolist() == expected
+
+
+def test_snapshot_version_caching(graph):
+    graph.add("x")
+    s1 = graph.snapshot()
+    s2 = graph.snapshot()
+    assert s1 is s2  # fresh → cached
+    graph.add("y")
+    s3 = graph.snapshot()
+    assert s3 is not s1
+
+
+# ---------------------------------------------------------------- BFS kernel
+
+
+def _host_bfs_set(g, seed, hops):
+    return sorted(
+        a for _, a in HGBreadthFirstTraversal(g, seed, max_distance=hops)
+    )
+
+
+@pytest.mark.parametrize("hops", [1, 2, 3])
+def test_device_bfs_matches_host(random_db, hops):
+    g, nodes, links, snap = random_db
+    seeds = np.asarray(nodes[:32], dtype=np.int32)
+    device_results = F.bfs_reachable_host(snap, seeds, hops)
+    for s, dev_set in zip(seeds.tolist(), device_results):
+        assert dev_set.tolist() == _host_bfs_set(g, s, hops), f"seed {s} hops {hops}"
+
+
+def test_device_bfs_levels(random_db):
+    g, nodes, links, snap = random_db
+    import jax.numpy as jnp
+
+    seed = nodes[0]
+    levels, visited = F.bfs_levels(snap.device, jnp.asarray([seed]), 3)
+    levels = np.asarray(levels)[0]
+    # distance-1 atoms = host BFS with max_distance 1
+    d1 = set(_host_bfs_set(g, seed, 1))
+    got_d1 = set(np.nonzero(levels == 1)[0].tolist())
+    assert got_d1 == d1
+    assert levels[seed] == 0
+
+
+def test_frontier_edge_counts_positive(random_db):
+    g, nodes, links, snap = random_db
+    import jax.numpy as jnp
+
+    n = F.frontier_edge_counts(snap.device, jnp.asarray(nodes[:8], dtype=jnp.int32), 2)
+    assert np.asarray(n).sum() > 0
+
+
+# ---------------------------------------------------------------- set kernels
+
+
+def test_device_intersect_matches_numpy(rng):
+    for _ in range(5):
+        a = np.unique(rng.integers(0, 500, size=rng.integers(1, 200)))
+        b = np.unique(rng.integers(0, 500, size=rng.integers(1, 200)))
+        c = np.unique(rng.integers(0, 500, size=rng.integers(1, 200)))
+        got = S.device_intersect_sorted([a, b, c])
+        expected = np.intersect1d(np.intersect1d(a, b), c)
+        assert got.tolist() == expected.tolist()
+
+
+def test_and_incident_pattern_matches_query(random_db):
+    g, nodes, links, snap = random_db
+    # pick anchor pairs that share at least one link where possible
+    pairs = []
+    for l in links[:40]:
+        ts = g.get_targets(l)
+        if len(ts) >= 2:
+            pairs.append((int(ts[0]), int(ts[1])))
+        if len(pairs) == 16:
+            break
+    results = S.and_incident_pattern(snap, pairs)
+    for (a, b), got in zip(pairs, results):
+        expected = sorted(g.find_all(hg.and_(hg.incident(a), hg.incident(b))))
+        assert got.tolist() == expected
+
+
+def test_and_incident_pattern_with_type(random_db):
+    g, nodes, links, snap = random_db
+    th = int(g.typesystem.handle_of("int"))
+    pairs = []
+    for l in links[:20]:
+        ts = g.get_targets(l)
+        if len(ts) >= 2:
+            pairs.append((int(ts[0]), int(ts[1])))
+    results = S.and_incident_pattern(snap, pairs, type_handle=th)
+    for (a, b), got in zip(pairs, results):
+        expected = sorted(
+            g.find_all(
+                hg.and_(hg.type_("int"), hg.incident(a), hg.incident(b))
+            )
+        )
+        assert got.tolist() == expected
+
+
+def test_member_mask_edges():
+    import jax.numpy as jnp
+
+    ref = jnp.asarray(S.pad_sorted(np.asarray([2, 5, 9], dtype=np.int32), 8))
+    q = jnp.asarray(S.pad_sorted(np.asarray([1, 2, 9, 10], dtype=np.int32), 8))
+    got = np.asarray(S.member_mask(ref, q))
+    assert got[:4].tolist() == [False, True, True, False]
+    assert not got[4:].any()  # padding never matches

@@ -1,0 +1,219 @@
+"""Query engine tests: DSL coverage, plan shape, correctness.
+
+Covers the intent of ``testcore/test/java/hgtest/query/`` (``Queries.java``
+DSL coverage, ``QueryCompilation.java`` plan shape, ``Inters1``
+intersection correctness — SURVEY §4), plus a differential check that the
+planner's index-based answers match brute-force predicate evaluation.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from hypergraphdb_tpu import HyperGraph
+from hypergraphdb_tpu.query import conditions as c
+from hypergraphdb_tpu.query import dsl as hg
+from hypergraphdb_tpu.query.compiler import compile_query
+
+from conftest import make_random_hypergraph
+
+
+@dataclasses.dataclass
+class Person:
+    name: str
+    age: int
+
+
+@pytest.fixture
+def populated(graph: HyperGraph):
+    g = graph
+    strings = [g.add(s) for s in ("apple", "banana", "cherry")]
+    ints = [g.add(i) for i in (1, 2, 3, 42)]
+    people = [g.add(Person("ada", 36)), g.add(Person("bob", 25))]
+    l1 = g.add_link((strings[0], ints[0]), value="l1")
+    l2 = g.add_link((strings[0], ints[1]), value="l2")
+    l3 = g.add_link((strings[1], ints[0], ints[1]), value="l3")
+    return g, strings, ints, people, (l1, l2, l3)
+
+
+def test_find_by_type(populated):
+    g, strings, ints, people, links = populated
+    res = g.find_all(hg.type_("string"))
+    assert set(strings) | {links[0], links[1], links[2]} >= set(res)
+    assert set(strings) <= set(res)
+
+
+def test_find_by_value(populated):
+    g, strings, ints, *_ = populated
+    assert g.find_all(hg.eq("banana")) == [strings[1]]
+    assert g.find_all(hg.eq(42)) == [ints[3]]
+    assert g.find_all(hg.eq("nope")) == []
+
+
+def test_value_type_strict(populated):
+    """int 1 must not match float 1.0 or bool True (reference Java equals)."""
+    g, strings, ints, *_ = populated
+    fh = g.add(1.0)
+    bh = g.add(True)
+    res = g.find_all(hg.eq(1))
+    assert ints[0] in res
+    assert fh not in res and bh not in res
+
+
+def test_value_ranges(populated):
+    g, strings, ints, *_ = populated
+    assert set(g.find_all(hg.lt(3))) == {ints[0], ints[1]}
+    assert set(g.find_all(hg.gte(3))) == {ints[2], ints[3]}
+    assert set(g.find_all(hg.and_(hg.gt(1), hg.lt(42)))) == {ints[1], ints[2]}
+
+
+def test_typed_value(populated):
+    g, strings, *_ = populated
+    assert g.find_all(hg.typed_value("string", "apple")) == [strings[0]]
+    assert g.find_all(hg.typed_value("int", "apple")) == []
+
+
+def test_incident(populated):
+    g, strings, ints, people, (l1, l2, l3) = populated
+    assert set(g.find_all(hg.incident(strings[0]))) == {l1, l2}
+    assert set(g.find_all(hg.incident(ints[0]))) == {l1, l3}
+    # conjunctive pattern: And(incident, incident) — the headline query shape
+    assert g.find_all(hg.and_(hg.incident(strings[0]), hg.incident(ints[0]))) == [l1]
+
+
+def test_incident_at_position(populated):
+    g, strings, ints, people, (l1, l2, l3) = populated
+    assert set(g.find_all(hg.incident_at(ints[0], 1))) == {l1, l3}
+    assert g.find_all(hg.incident_at(ints[0], 0)) == []
+
+
+def test_link_condition(populated):
+    g, strings, ints, people, (l1, l2, l3) = populated
+    assert set(g.find_all(hg.link(strings[0]))) == {l1, l2}
+    assert g.find_all(hg.link(ints[0], ints[1])) == [l3]
+
+
+def test_ordered_link(populated):
+    g, strings, ints, people, (l1, l2, l3) = populated
+    assert g.find_all(hg.ordered_link(strings[1], ints[0])) == [l3]
+    assert g.find_all(hg.ordered_link(ints[0], strings[1])) == []
+
+
+def test_target(populated):
+    g, strings, ints, people, (l1, l2, l3) = populated
+    assert set(g.find_all(hg.target(l3))) == {strings[1], ints[0], ints[1]}
+
+
+def test_arity_and_islink(populated):
+    g, strings, ints, people, (l1, l2, l3) = populated
+    res = g.find_all(hg.and_(hg.is_link(), hg.arity(3)))
+    assert res == [l3]
+    nodes = g.find_all(hg.and_(hg.type_("int"), hg.is_node()))
+    assert set(nodes) == set(ints)
+
+
+def test_or_and_not(populated):
+    g, strings, ints, *_ = populated
+    res = set(g.find_all(hg.or_(hg.eq("apple"), hg.eq("banana"))))
+    assert res == {strings[0], strings[1]}
+    res = set(
+        g.find_all(hg.and_(hg.type_("string"), hg.not_(hg.eq("apple")), hg.is_node()))
+    )
+    assert res == {strings[1], strings[2]}
+
+
+def test_nothing_and_any(populated):
+    g, *_ = populated
+    assert g.find_all(hg.nothing()) == []
+    assert g.count(hg.all_atoms()) == g.atom_count()
+    # contradiction folds to Nothing at compile time
+    q = compile_query(g, hg.and_(hg.type_("int"), hg.type_("string")))
+    assert isinstance(q.simplified, c.Nothing)
+
+
+def test_is_identity(populated):
+    g, strings, *_ = populated
+    assert g.find_all(hg.is_(strings[0])) == [strings[0]]
+    assert g.find_all(hg.and_(hg.is_(strings[0]), hg.type_("int"))) == []
+
+
+def test_part_condition(populated):
+    g, strings, ints, people, links = populated
+    assert g.find_all(hg.part("name", "ada")) == [people[0]]
+    assert set(g.find_all(hg.part("age", 26, "lt"))) == {people[1]}
+
+
+def test_type_plus(populated):
+    g, *_ = populated
+
+    @dataclasses.dataclass
+    class Base:
+        x: int
+
+    @dataclasses.dataclass
+    class Derived(Base):
+        y: int = 0
+
+    b = g.add(Base(1))
+    d = g.add(Derived(2, 3))
+    base_t = g.typesystem.infer(Base(0)).name
+    assert set(g.find_all(hg.type_plus(base_t))) == {b, d}
+    assert g.find_all(hg.type_(base_t)) == [b]
+
+
+def test_predicate_condition(populated):
+    g, strings, ints, *_ = populated
+    odd = g.find_all(
+        hg.and_(hg.type_("int"), hg.predicate(lambda gr, h: gr.get(h) % 2 == 1))
+    )
+    assert set(odd) == {ints[0], ints[2]}
+
+
+def test_plan_shapes(populated):
+    """QueryCompilation analogue: check the planner picks indices."""
+    g, strings, ints, people, (l1, l2, l3) = populated
+    q = compile_query(g, hg.and_(hg.type_("string"), hg.incident(ints[0])))
+    d = q.analyze()
+    assert "type" in d and "incident" in d and "∩" in d
+    q2 = compile_query(g, hg.eq("apple"))
+    assert "value" in q2.analyze()
+    q3 = compile_query(g, hg.predicate(lambda gr, h: True))
+    assert "scan" in q3.analyze()
+
+
+def test_query_count(populated):
+    g, strings, *_ = populated
+    assert g.count(hg.type_("int")) == 4
+
+
+def test_parallel_or(populated):
+    g, strings, ints, *_ = populated
+    g.config.query.parallel_or = True
+    res = set(g.find_all(hg.or_(hg.eq("apple"), hg.eq(42), hg.eq(1))))
+    assert res == {strings[0], ints[3], ints[0]}
+    g.config.query.parallel_or = False
+
+
+def test_differential_random_graph(graph: HyperGraph):
+    """Planner answers == brute-force predicate answers on a random graph."""
+    g = graph
+    nodes, links = make_random_hypergraph(g, n_nodes=60, n_links=120, seed=7)
+    conds = [
+        hg.type_("string"),
+        hg.type_("int"),
+        hg.incident(nodes[0]),
+        hg.incident(nodes[1]),
+        hg.and_(hg.type_("int"), hg.incident(nodes[0])),
+        hg.and_(hg.incident(nodes[0]), hg.incident(nodes[1])),
+        hg.or_(hg.incident(nodes[2]), hg.incident(nodes[3])),
+        hg.and_(hg.is_link(), hg.arity(2)),
+        hg.and_(hg.type_("int"), hg.not_(hg.incident(nodes[0]))),
+        hg.lt(50),
+        hg.and_(hg.gte(10), hg.lt(20)),
+    ]
+    all_atoms = list(g.atoms())
+    for cond in conds:
+        expected = sorted(h for h in all_atoms if cond.satisfies(g, h))
+        got = sorted(g.find_all(cond))
+        assert got == expected, f"mismatch for {cond}"

@@ -1,0 +1,157 @@
+"""Subgraph + user-indexing tests, incl. review-finding regressions."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from hypergraphdb_tpu import HyperGraph
+from hypergraphdb_tpu.atom.subgraph import HGSubgraph
+from hypergraphdb_tpu.indexing import manager as im
+from hypergraphdb_tpu.query import dsl as hg
+
+
+@dataclasses.dataclass
+class Person:
+    name: str
+    age: int
+
+
+@dataclasses.dataclass
+class Robot:
+    name: str
+
+
+def test_subgraph_membership(graph: HyperGraph):
+    sg = HGSubgraph.create(graph, "mine")
+    a = sg.add("a")
+    b = graph.add("b")
+    sg.add_member(b)
+    assert sg.is_member(a) and sg.is_member(b)
+    assert sorted(sg) == sorted([a, b])
+    assert set(graph.find_all(hg.member_of(sg.handle))) == {a, b}
+    sg.remove_member(b)
+    assert not sg.is_member(b)
+
+
+def test_subgraph_find_by_name(graph: HyperGraph):
+    HGSubgraph.create(graph, "one")
+    sg2 = HGSubgraph.create(graph, "two")
+    found = HGSubgraph.find_by_name(graph, "two")
+    assert found is not None and found.handle == sg2.handle
+
+
+def test_subgraph_contains_query(graph: HyperGraph):
+    sg = HGSubgraph.create(graph, "s")
+    a = sg.add("a")
+    res = graph.find_all(hg.contains(a))
+    assert res == [sg.handle]
+
+
+def test_removed_atom_leaves_subgraph(graph: HyperGraph):
+    """Regression: graph.remove() must purge membership index entries."""
+    sg = HGSubgraph.create(graph, "s")
+    a = sg.add("x")
+    graph.remove(a)
+    assert not sg.is_member(a)
+    assert graph.find_all(hg.member_of(sg.handle)) == []
+
+
+def test_removed_subgraph_drops_member_list(graph: HyperGraph):
+    sg = HGSubgraph.create(graph, "s")
+    a = sg.add("x")
+    graph.remove(sg.handle, keep_incident_links=True)
+    sg2 = HGSubgraph.of(graph, sg.handle)
+    assert len(sg2) == 0
+
+
+# ---------------------------------------------------------------- indexing
+
+
+def test_by_part_indexer_used_when_type_pinned(graph: HyperGraph):
+    people = [graph.add(Person(f"p{i}", i)) for i in range(20)]
+    th = graph.get_type_handle_of(people[0])
+    im.register(graph, im.ByPartIndexer("person.name", th, "name"))
+    tname = graph.typesystem.name_of(th)
+    res = graph.find_all(hg.and_(hg.type_(tname), hg.part("name", "p7")))
+    assert res == [people[7]]
+    # plan shows the index lookup
+    from hypergraphdb_tpu.query.compiler import compile_query
+
+    d = compile_query(
+        graph, hg.and_(hg.type_(tname), hg.part("name", "p7"))
+    ).analyze()
+    assert "index(person.name)" in d
+
+
+def test_part_index_does_not_change_untyped_answers(graph: HyperGraph):
+    """Regression: registering an index must not exclude other types from
+    an unconstrained AtomPart query."""
+    p = graph.add(Person("ada", 1))
+    r = graph.add(Robot("ada"))
+    before = sorted(graph.find_all(hg.part("name", "ada")))
+    th = graph.get_type_handle_of(p)
+    im.register(graph, im.ByPartIndexer("pname", th, "name"))
+    after = sorted(graph.find_all(hg.part("name", "ada")))
+    assert before == after == sorted([p, r])
+
+
+def test_by_target_indexer(graph: HyperGraph):
+    a, b, c = graph.add("a"), graph.add("b"), graph.add("c")
+    l1 = graph.add_link((a, b), value=1)
+    l2 = graph.add_link((a, c), value=2)
+    th = graph.typesystem.handle_of("int")
+    im.register(graph, im.ByTargetIndexer("bytarget0", th, 0))
+    from hypergraphdb_tpu.utils.ordered_bytes import encode_int
+
+    idx = im.get_index(graph, "bytarget0")
+    assert sorted(idx.find(encode_int(a))) == sorted([l1, l2])
+    graph.remove(l1)
+    assert sorted(idx.find(encode_int(a))) == [l2]
+
+
+def test_target_to_target_indexer(graph: HyperGraph):
+    a, b = graph.add("a"), graph.add("b")
+    l = graph.add_link((a, b), value=1)
+    th = graph.typesystem.handle_of("int")
+    im.register(graph, im.TargetToTargetIndexer("t2t", th, 0, 1))
+    from hypergraphdb_tpu.utils.ordered_bytes import encode_int
+
+    idx = im.get_index(graph, "t2t")
+    assert idx.find(encode_int(a)).array().tolist() == [b]
+
+
+def test_indexer_rebuild_covers_existing_atoms(graph: HyperGraph):
+    people = [graph.add(Person(f"p{i}", i)) for i in range(5)]
+    th = graph.get_type_handle_of(people[0])
+    im.register(graph, im.ByPartIndexer("names", th, "name"), populate=True)
+    st = graph.typesystem.get_type("string")
+    idx = im.get_index(graph, "names")
+    assert idx.find(st.to_key("p3")).array().tolist() == [people[3]]
+
+
+def test_unregister_removes_index(graph: HyperGraph):
+    p = graph.add(Person("x", 1))
+    th = graph.get_type_handle_of(p)
+    im.register(graph, im.ByPartIndexer("tmp", th, "name"))
+    im.unregister(graph, "tmp")
+    assert "hg.user.tmp" not in graph.store.index_names()
+
+
+# ---------------------------------------------------------------- setops pad
+
+
+def test_pattern_kernel_asymmetric_incidence(graph: HyperGraph):
+    """Regression: pad_len must cover the longest anchor row, not anchor 0's."""
+    a = graph.add("rare")
+    b = graph.add("hub")
+    others = list(graph.add_nodes_bulk([f"o{i}" for i in range(300)]))
+    # 300 links on b so the shared link sorts late in b's row
+    for o in others:
+        graph.add_link((o, b))
+    shared = graph.add_link((a, b))
+    snap = graph.snapshot()
+    from hypergraphdb_tpu.ops.setops import and_incident_pattern
+
+    got = and_incident_pattern(snap, [(a, b)])[0]
+    assert got.tolist() == [shared]
