@@ -20,6 +20,12 @@ from hypergraphdb_tpu.utils.ordered_bytes import encode_int
 IDX_SUBGRAPH = "hg.subgraph"
 
 
+def member_key(handle: HGHandle) -> bytes:
+    """Index key of a subgraph's member list — the ONE key encoding shared
+    by membership ops here and the purge in ``HyperGraph.remove``."""
+    return encode_int(int(handle))
+
+
 @dataclass
 class SubgraphValue:
     """The stored value of a subgraph atom."""
@@ -54,7 +60,7 @@ class HGSubgraph:
 
     # -- membership ----------------------------------------------------------
     def _key(self) -> bytes:
-        return encode_int(self.handle)
+        return member_key(self.handle)
 
     def _index(self):
         return self.graph.store.get_index(IDX_SUBGRAPH)
@@ -97,10 +103,10 @@ def member_index_plan(graph, subgraph_handle: HGHandle):
             self.h = int(h)
 
         def run(self, g):
-            return g.store.get_index(IDX_SUBGRAPH).find(encode_int(self.h)).array()
+            return g.store.get_index(IDX_SUBGRAPH).find(member_key(self.h)).array()
 
         def estimate(self, g):
-            return float(g.store.get_index(IDX_SUBGRAPH).count(encode_int(self.h)))
+            return float(g.store.get_index(IDX_SUBGRAPH).count(member_key(self.h)))
 
         def describe(self):
             return f"subgraph({self.h})"

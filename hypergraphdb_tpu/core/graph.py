@@ -456,13 +456,13 @@ class HyperGraph:
         maybe_unindex(self, h, type_handle, value, targets or None)
         # purge subgraph memberships (member entries AND, if the atom is
         # itself a subgraph, its whole member list)
-        from hypergraphdb_tpu.atom.subgraph import IDX_SUBGRAPH
+        from hypergraphdb_tpu.atom.subgraph import IDX_SUBGRAPH, member_key
 
         sub_idx = self.store.get_index(IDX_SUBGRAPH, create=False)
         if sub_idx is not None:
             for key in sub_idx.find_by_value(h):
                 sub_idx.remove_entry(key, h)
-            sub_idx.remove_all_entries(_type_key(h))
+            sub_idx.remove_all_entries(member_key(h))
         # un-link from target incidence sets
         for t in targets:
             self.store.remove_incidence_link(t, h)

@@ -53,7 +53,8 @@ def _register_device_snapshot_pytree() -> None:
             (
                 s.inc_offsets, s.inc_links, s.inc_src,
                 s.tgt_offsets, s.tgt_flat, s.tgt_src,
-                s.type_of, s.is_link, s.arity, s.value_rank,
+                s.type_of, s.is_link, s.arity,
+                s.value_rank_hi, s.value_rank_lo,
             ),
             s.num_atoms,
         ),
@@ -239,7 +240,12 @@ class DeviceSnapshot:
     type_of: "jax.Array"  # noqa: F821
     is_link: "jax.Array"  # noqa: F821
     arity: "jax.Array"  # noqa: F821
-    value_rank: "jax.Array"  # noqa: F821
+    # the 64-bit order-preserving value ranks, split into two uint32 words
+    # (compare lexicographically hi-then-lo): jnp.asarray would silently
+    # truncate uint64 to its LOW 32 bits under default x64-disabled JAX,
+    # destroying the ordering
+    value_rank_hi: "jax.Array"  # noqa: F821
+    value_rank_lo: "jax.Array"  # noqa: F821
 
     @staticmethod
     def from_host(snap: CSRSnapshot) -> "DeviceSnapshot":
@@ -256,7 +262,12 @@ class DeviceSnapshot:
             type_of=jnp.asarray(snap.type_of),
             is_link=jnp.asarray(snap.is_link),
             arity=jnp.asarray(snap.arity),
-            value_rank=jnp.asarray(snap.value_rank),
+            value_rank_hi=jnp.asarray(
+                (snap.value_rank >> np.uint64(32)).astype(np.uint32)
+            ),
+            value_rank_lo=jnp.asarray(
+                (snap.value_rank & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+            ),
         )
 
 

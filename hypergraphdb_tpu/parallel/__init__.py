@@ -1,0 +1,24 @@
+"""Multi-chip SPMD execution over a ``jax.sharding.Mesh`` (SURVEY §2.5/§5).
+
+The data plane: edge-sharded CSR snapshots, OR-allreduce frontier exchange
+over ICI, candidate-sharded pattern matching. The host-side control plane
+(peer identity, replication, remote query) lives in ``hypergraphdb_tpu.peer``.
+"""
+
+from hypergraphdb_tpu.parallel.sharded import (
+    AXIS,
+    ShardedSnapshot,
+    and_incident_pattern_sharded,
+    bfs_levels_sharded,
+    make_mesh,
+    match_candidates_sharded,
+)
+
+__all__ = [
+    "AXIS",
+    "ShardedSnapshot",
+    "and_incident_pattern_sharded",
+    "bfs_levels_sharded",
+    "make_mesh",
+    "match_candidates_sharded",
+]

@@ -1,0 +1,240 @@
+"""Multi-chip execution: CSR snapshot sharded over a ``jax.sharding.Mesh``.
+
+The reference scales out with Hazelcast-partitioned storage and XMPP peers
+(`storage/hazelstore/`, `p2p/` — SURVEY §2.5); computation never leaves one
+JVM thread pool. The TPU-native replacement is SPMD over a device mesh:
+
+- **Edge parallelism** (the "model parallel" axis): the flattened COO
+  incidence/target relations are split contiguously across devices along the
+  edge dimension. Each device owns ``E/n_dev`` edges of each relation.
+- **Frontier exchange over ICI**: one BFS hop is two local scatter-OR ops
+  followed by a ``psum``-style OR-allreduce of the partial bitmaps — the
+  frontier-partition exchange SURVEY §5 calls the "ring-attention analogue".
+  A bitmap over 10M atoms is ~10 MB of bool — one allreduce per relation per
+  hop rides ICI comfortably.
+- **Candidate parallelism** (the "data parallel" axis): conjunctive pattern
+  match shards the by-type candidate array across devices; each device
+  filters its slice against (replicated) incidence rows and shard_map
+  assembles the sharded result mask.
+
+Everything is expressed with ``jax.shard_map`` over an explicit ``Mesh`` so
+XLA inserts the collectives; no NCCL/MPI translation (SURVEY §2.5 mapping).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from hypergraphdb_tpu.ops.snapshot import CSRSnapshot, _pad_to
+from hypergraphdb_tpu.ops.setops import SENTINEL, _bucket, member_mask, pad_sorted
+
+#: name of the device-mesh axis edges/candidates are sharded over
+AXIS = "shard"
+
+
+def make_mesh(devices=None, axis: str = AXIS) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), (axis,))
+
+
+@dataclass
+class ShardedSnapshot:
+    """Device-sharded twin of :class:`CSRSnapshot`.
+
+    Edge (COO) arrays are sharded along their only axis; per-atom arrays are
+    replicated (they are O(N) int32 — cheap relative to edges; row-sharding
+    them is the next scaling step and changes only ``from_host``).
+    """
+
+    mesh: Mesh
+    num_atoms: int
+    inc_links: jax.Array   # (E_inc,) sharded
+    inc_src: jax.Array     # (E_inc,) sharded
+    tgt_flat: jax.Array    # (E_tgt,) sharded
+    tgt_src: jax.Array     # (E_tgt,) sharded
+    type_of: jax.Array        # (N+1,) replicated
+    is_link: jax.Array        # (N+1,) replicated
+    arity: jax.Array          # (N+1,) replicated
+    value_rank_hi: jax.Array  # (N+1,) replicated uint32 (see DeviceSnapshot)
+    value_rank_lo: jax.Array  # (N+1,) replicated uint32
+
+    @staticmethod
+    def from_host(snap: CSRSnapshot, mesh: Mesh) -> "ShardedSnapshot":
+        n_dev = mesh.devices.size
+        N = snap.num_atoms
+        shard = NamedSharding(mesh, P(AXIS))
+        repl = NamedSharding(mesh, P())
+
+        def put_edges(a):
+            return jax.device_put(jnp.asarray(_pad_to(a, n_dev, N)), shard)
+
+        def put_repl(a):
+            return jax.device_put(jnp.asarray(a), repl)
+
+        return ShardedSnapshot(
+            mesh=mesh,
+            num_atoms=N,
+            inc_links=put_edges(snap.inc_links),
+            inc_src=put_edges(snap.inc_src),
+            tgt_flat=put_edges(snap.tgt_flat),
+            tgt_src=put_edges(snap.tgt_src),
+            type_of=put_repl(snap.type_of),
+            is_link=put_repl(snap.is_link),
+            arity=put_repl(snap.arity),
+            value_rank_hi=put_repl(
+                (snap.value_rank >> np.uint64(32)).astype(np.uint32)
+            ),
+            value_rank_lo=put_repl(
+                (snap.value_rank & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+            ),
+        )
+
+
+def _register_pytree() -> None:
+    jax.tree_util.register_pytree_node(
+        ShardedSnapshot,
+        lambda s: (
+            (s.inc_links, s.inc_src, s.tgt_flat, s.tgt_src,
+             s.type_of, s.is_link, s.arity, s.value_rank_hi, s.value_rank_lo),
+            (s.mesh, s.num_atoms),
+        ),
+        lambda aux, ch: ShardedSnapshot(aux[0], aux[1], *ch),
+    )
+
+
+_register_pytree()
+
+
+# --------------------------------------------------------------------------
+# sharded BFS: edge-parallel scatter + OR-allreduce frontier exchange
+# --------------------------------------------------------------------------
+
+def _expand_local(inc_links, inc_src, tgt_flat, tgt_src, frontier):
+    """Per-device partial hop over the local edge slice.
+
+    frontier: (K, N+1) replicated bool → partial neighbor bitmap (K, N+1).
+    Collectives (OR via psum of bool→int max) happen outside, once per
+    relation, so atom→link and link→target each cross ICI exactly once.
+    """
+    K = frontier.shape[0]
+    n1 = frontier.shape[1]
+
+    def one(f):
+        la = jnp.zeros(n1, dtype=bool).at[inc_links].max(f[inc_src])
+        return la
+
+    link_partial = jax.vmap(one)(frontier)
+    link_active = jax.lax.pmax(link_partial.astype(jnp.int8), AXIS) > 0
+
+    def two(la):
+        nb = jnp.zeros(n1, dtype=bool).at[tgt_flat].max(la[tgt_src])
+        return nb
+
+    nbr_partial = jax.vmap(two)(link_active)
+    nbrs = jax.lax.pmax(nbr_partial.astype(jnp.int8), AXIS) > 0
+    return nbrs
+
+
+@partial(jax.jit, static_argnames=("max_hops",))
+def bfs_levels_sharded(
+    sdev: ShardedSnapshot, seeds: jax.Array, max_hops: int
+) -> tuple[jax.Array, jax.Array]:
+    """Batched K-seed BFS over the mesh. Same contract as
+    ``ops.frontier.bfs_levels`` — (levels, visited), each (K, N+1).
+
+    The full multi-hop loop is one XLA program per device; per hop there are
+    exactly two OR-allreduces over ICI (link activation + neighbor bitmap).
+    """
+    mesh = sdev.mesh
+    K = seeds.shape[0]
+    n1 = sdev.type_of.shape[0]
+
+    def stepper(inc_links, inc_src, tgt_flat, tgt_src, seeds):
+        frontier = (
+            jnp.zeros((K, n1), dtype=bool).at[jnp.arange(K), seeds].set(True)
+        )
+        visited = frontier
+        levels = jnp.where(frontier, 0, -1).astype(jnp.int32)
+
+        def body(i, state):
+            frontier, visited, levels = state
+            nxt = _expand_local(inc_links, inc_src, tgt_flat, tgt_src, frontier)
+            nxt = nxt.at[:, n1 - 1].set(False) & ~visited
+            levels = jnp.where(nxt, i + 1, levels)
+            return nxt, visited | nxt, levels
+
+        return jax.lax.fori_loop(0, max_hops, body, (frontier, visited, levels))
+
+    fn = jax.shard_map(
+        stepper,
+        mesh=mesh,
+        in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P()),
+        out_specs=(P(), P(), P()),
+    )
+    frontier, visited, levels = fn(
+        sdev.inc_links, sdev.inc_src, sdev.tgt_flat, sdev.tgt_src,
+        jnp.asarray(seeds, dtype=jnp.int32),
+    )
+    return levels, visited
+
+
+# --------------------------------------------------------------------------
+# sharded conjunctive pattern match: candidate-parallel membership filter
+# --------------------------------------------------------------------------
+
+@jax.jit
+def match_candidates_sharded(
+    sdev: ShardedSnapshot,
+    candidates: jax.Array,     # (C,) atom ids, replicated input
+    anchor_rows: jax.Array,    # (A, L) SENTINEL-padded sorted rows, replicated
+) -> jax.Array:
+    """``And(type, incident(a1), ..., incident(ak))`` on the mesh.
+
+    Candidates (the by-type sorted id array) are split across devices; each
+    device checks membership of its slice in every anchor's (replicated,
+    sorted) incidence row via ``setops.member_mask`` — the vectorized
+    zig-zag join (``ZigZagIntersectionResult.java:37-75``); shard_map
+    assembles the per-device mask shards into the full mask.
+    """
+    mesh = sdev.mesh
+    n_dev = mesh.devices.size
+    C = candidates.shape[0]
+    pad = (-C) % n_dev
+    cand = jnp.concatenate(
+        [candidates, jnp.full((pad,), SENTINEL, dtype=candidates.dtype)]
+    ) if pad else candidates
+
+    def local(cand_slice, rows):
+        # (A, C_local) membership of every local candidate in every anchor
+        # row, AND-ed over anchors; local shard returned, shard_map assembles
+        hits = jax.vmap(lambda row: member_mask(row, cand_slice))(rows)
+        return jnp.all(hits, axis=0)
+
+    fn = jax.shard_map(
+        local, mesh=mesh, in_specs=(P(AXIS), P()), out_specs=P(AXIS),
+    )
+    full = fn(cand, anchor_rows)
+    return full[:C]
+
+
+def and_incident_pattern_sharded(
+    snap: CSRSnapshot, sdev: ShardedSnapshot, type_handle: int,
+    anchors: list[int],
+) -> np.ndarray:
+    """Host wrapper: ids of atoms of ``type_handle`` incident to every anchor."""
+    cands = snap.type_set(type_handle)
+    if len(cands) == 0 or not anchors:
+        return np.empty(0, dtype=np.int32)
+    rows = [snap.incidence_row(a) for a in anchors]
+    L = _bucket(max((len(r) for r in rows), default=1))
+    padded = np.stack([pad_sorted(r, L) for r in rows])
+    mask = match_candidates_sharded(
+        sdev, jnp.asarray(cands), jnp.asarray(padded)
+    )
+    return np.asarray(cands)[np.asarray(mask)]

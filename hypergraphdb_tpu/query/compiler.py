@@ -21,6 +21,7 @@ duality from SURVEY §7 ("hard parts" #4).
 from __future__ import annotations
 
 import itertools
+import logging
 from dataclasses import dataclass, field
 from typing import Any, Iterable, Optional, Sequence
 
@@ -28,6 +29,8 @@ import numpy as np
 
 from hypergraphdb_tpu.core.errors import QueryError
 from hypergraphdb_tpu.query import conditions as c
+
+logger = logging.getLogger("hypergraphdb_tpu.query")
 
 # ============================================================ physical plans
 
@@ -264,21 +267,32 @@ class IntersectPlan(Plan):
         cfg = graph.config.query
         # planner duality (SURVEY §7 hard part 4): small intersections stay
         # on host cursors; large ones amortize a device kernel launch
-        if (
+        use_device = (
             cfg.prefer_device
             and len(ordered) > 1
             and ordered[0].estimate(graph) >= cfg.device_min_batch
-        ):
+        )
+        if use_device:
+            arrays = [c.run(graph) for c in ordered]
+            if any(len(a) == 0 for a in arrays):
+                return _EMPTY
             try:
                 from hypergraphdb_tpu.ops.setops import device_intersect_sorted
 
-                arrays = [c.run(graph) for c in ordered]
-                if any(len(a) == 0 for a in arrays):
-                    return _EMPTY
                 arr = device_intersect_sorted(arrays)
-                return filter_predicates(graph, arr, self.predicates)
             except Exception:
-                pass  # fall back to host path
+                # host merge reuses the already-materialized arrays — no
+                # re-execution of child plans on fallback
+                logger.warning(
+                    "device intersection failed; host merge fallback",
+                    exc_info=True,
+                )
+                arr = arrays[0]
+                for a in arrays[1:]:
+                    if len(arr) == 0:
+                        break
+                    arr = intersect_sorted(graph, arr, a)
+            return filter_predicates(graph, arr, self.predicates)
         arr = ordered[0].run(graph)
         for child in ordered[1:]:
             if len(arr) == 0:
@@ -303,11 +317,20 @@ class UnionPlan(Plan):
 
     def run(self, graph):
         if self.parallel and len(self.children) > 1:
-            # OrToParellelQuery/UnionResultAsync analogue
+            # OrToParellelQuery/UnionResultAsync analogue. The caller's
+            # transaction lives in a thread-local stack, so each worker must
+            # explicitly join it — otherwise branches read committed state
+            # only and miss the tx's own writes.
             from concurrent.futures import ThreadPoolExecutor
 
+            tx = graph.txman.current()
+
+            def run_child(p):
+                with graph.txman.scoped(tx):
+                    return p.run(graph)
+
             with ThreadPoolExecutor(max_workers=min(8, len(self.children))) as ex:
-                arrays = list(ex.map(lambda p: p.run(graph), self.children))
+                arrays = list(ex.map(run_child, self.children))
         else:
             arrays = [p.run(graph) for p in self.children]
         arrays = [a for a in arrays if len(a)]
@@ -481,6 +504,19 @@ def _distribute(cond: c.HGQueryCondition) -> c.HGQueryCondition:
     return cond
 
 
+def _dedupe(items: list) -> list:
+    """Order-preserving dedupe tolerant of unhashable condition payloads
+    (e.g. AtomValue holding a non-frozen dataclass or a list)."""
+    try:
+        return list(dict.fromkeys(items))
+    except TypeError:
+        out: list = []
+        for x in items:
+            if not any(x == y for y in out):
+                out.append(x)
+        return out
+
+
 def simplify(graph, cond: c.HGQueryCondition) -> c.HGQueryCondition:
     """Simplification (``ExpressionBasedQuery.simplify`` :219): flatten,
     dedupe, fold contradictions to Nothing, drop AnyAtom in conjunctions."""
@@ -496,7 +532,7 @@ def simplify(graph, cond: c.HGQueryCondition) -> c.HGQueryCondition:
                 out.extend(s.clauses)
             else:
                 out.append(s)
-        out = list(dict.fromkeys(out))
+        out = _dedupe(out)
         if not out:
             return c.Nothing()
         return out[0] if len(out) == 1 else c.Or(*out)
@@ -512,7 +548,7 @@ def simplify(graph, cond: c.HGQueryCondition) -> c.HGQueryCondition:
                 out.extend(s.clauses)
             else:
                 out.append(s)
-        out = list(dict.fromkeys(out))
+        out = _dedupe(out)
         # contradiction: two different exact types
         types = {
             x.type_handle(graph) for x in out if isinstance(x, c.AtomType)

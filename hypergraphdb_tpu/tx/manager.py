@@ -26,6 +26,7 @@ Design differences, deliberate:
 from __future__ import annotations
 
 import threading
+from contextlib import contextmanager
 from typing import Any, Callable, Optional, Sequence, TypeVar
 
 import numpy as np
@@ -161,6 +162,23 @@ class HGTransactionManager:
     def current(self) -> Optional[HGTransaction]:
         st = self._stack()
         return st[-1] if st else None
+
+    @contextmanager
+    def scoped(self, tx: Optional[HGTransaction]):
+        """Join an existing transaction from ANOTHER thread for the dynamic
+        extent of the block (parallel query-union workers run child plans
+        under the caller's tx). Safe for concurrent *reads*: ``note_read``
+        records via a single ``dict.setdefault`` call, atomic under the GIL;
+        workers must not write through a shared tx."""
+        if tx is None:
+            yield
+            return
+        st = self._stack()
+        st.append(tx)
+        try:
+            yield
+        finally:
+            st.pop()
 
     # -- lifecycle --------------------------------------------------------------
     def begin(self, readonly: bool = False) -> HGTransaction:

@@ -8,12 +8,20 @@ Must run before the first ``import jax`` anywhere in the test process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The image's sitecustomize imports jax (registering the TPU backend) before
+# this conftest runs, so the env vars above are too late for jax.config —
+# override the already-imported config directly. Backend init is lazy, so
+# this still takes effect as long as no test touched jax.devices() yet.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

@@ -1,0 +1,62 @@
+"""Regression tests for code-review findings (round 1)."""
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from hypergraphdb_tpu.query import dsl as q
+
+
+@dataclass
+class MutablePerson:  # eq=True, frozen=False → __hash__ is None
+    name: str = ""
+    age: int = 0
+
+
+def test_query_on_unhashable_record_value(graph):
+    """simplify() must dedupe conditions whose payload is unhashable."""
+    h = graph.add(MutablePerson("ada", 36))
+    graph.add(MutablePerson("bob", 9))
+    # duplicate clause forces the dedupe path in And
+    res = q.find_all(
+        graph, q.and_(q.eq(MutablePerson("ada", 36)), q.eq(MutablePerson("ada", 36)))
+    )
+    assert res == [int(h)]
+    # Or branch too
+    res = q.find_all(
+        graph, q.or_(q.eq(MutablePerson("ada", 36)), q.eq(MutablePerson("ada", 36)))
+    )
+    assert res == [int(h)]
+
+
+def test_parallel_union_sees_tx_writes(graph):
+    """Parallel Or-branches must observe the calling tx's uncommitted writes."""
+    graph.config.query.parallel_or = True
+    pre = graph.add("pre-existing")
+
+    def inside():
+        fresh = graph.add("fresh-in-tx")
+        res = q.find_all(graph, q.or_(q.value("pre-existing"),
+                                      q.value("fresh-in-tx")))
+        assert int(pre) in res
+        assert int(fresh) in res, "parallel union lost the caller's tx context"
+        return fresh
+
+    graph.txman.transact(inside)
+
+
+def test_device_value_rank_not_truncated(graph):
+    """value_rank must survive device transfer with its HIGH 32 bits intact."""
+    a = graph.add("aaaa-low")
+    b = graph.add("zzzz-high")
+    snap = graph.snapshot()
+    dev = snap.device
+    hi = np.asarray(dev.value_rank_hi)
+    lo = np.asarray(dev.value_rank_lo)
+    full = (hi.astype(np.uint64) << np.uint64(32)) | lo.astype(np.uint64)
+    np.testing.assert_array_equal(full, snap.value_rank)
+    # ordering is dominated by the high word for string keys
+    ra, rb = snap.value_rank[int(a)], snap.value_rank[int(b)]
+    assert (ra < rb) == (
+        (hi[int(a)], lo[int(a)]) < (hi[int(b)], lo[int(b)])
+    )
