@@ -49,6 +49,8 @@ _FLAG_LINK = 1
 #: index names for the two system indices
 IDX_BY_TYPE = "hg.bytype"
 IDX_BY_VALUE = "hg.byvalue"
+#: persistent type-name → type-atom-handle index (reopen recovery)
+IDX_TYPE_NAME = "hg.typename"
 
 
 @dataclass(frozen=True)
@@ -234,6 +236,14 @@ class HyperGraph:
 
         maybe_index(self, h, type_handle, value, targets)
 
+    def _find_type_atom(self, name: str) -> Optional[HGHandle]:
+        """Look up a persisted type atom by name (reopen path: the class↔type
+        index dbs of the reference, ``HGTypeSystem.java:97-98``)."""
+        idx = self.store.get_index(IDX_TYPE_NAME, create=False)
+        if idx is None:
+            return None
+        return idx.find_first(name.encode("utf-8"))
+
     def _add_type_atom(self, name: str) -> HGHandle:
         """Bootstrap-time creation of a type atom; the top type atom is its
         own type (the reference's Top, ``type/Top.java:25``)."""
@@ -251,6 +261,7 @@ class HyperGraph:
             self.store.store_link(h, record)
             self.store.get_index(IDX_BY_TYPE).add_entry(_type_key(type_handle), h)
             self.store.get_index(IDX_BY_VALUE).add_entry(top.to_key(name), h)
+            self.store.get_index(IDX_TYPE_NAME).add_entry(name.encode("utf-8"), h)
             return h
 
         return self.txman.ensure_transaction(run)

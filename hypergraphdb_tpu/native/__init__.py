@@ -1,0 +1,127 @@
+"""Native (C++) storage engine build + load.
+
+``lib()`` returns the loaded ``libhgstore.so``, compiling it from
+``hgstore.cpp`` with g++ on first use (and whenever the source is newer than
+the binary). The reference ships native code as a separate Maven module
+linked against BerkeleyDB C (``storage/bdb-native/pom.xml:100-120``); here
+the native engine is self-contained and built on demand.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(_HERE, "hgstore.cpp")
+SO = os.path.join(_HERE, "libhgstore.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def build(force: bool = False) -> str:
+    """Compile hgstore.cpp → libhgstore.so if missing or stale."""
+    with _lock:
+        if (
+            not force
+            and os.path.exists(SO)
+            and os.path.getmtime(SO) >= os.path.getmtime(SRC)
+        ):
+            return SO
+        tmp = SO + ".tmp"
+        cmd = [
+            "g++", "-std=c++17", "-O2", "-fPIC", "-shared",
+            "-o", tmp, SRC,
+        ]
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=300
+            )
+        except (OSError, subprocess.TimeoutExpired) as e:
+            raise NativeBuildError(f"g++ invocation failed: {e}") from e
+        if proc.returncode != 0:
+            raise NativeBuildError(
+                f"native build failed:\n{proc.stderr[-4000:]}"
+            )
+        os.replace(tmp, SO)
+        return SO
+
+
+def lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    path = build()
+    with _lock:
+        if _lib is None:
+            _lib = _bind(ctypes.CDLL(path))
+    return _lib
+
+
+def _bind(L: ctypes.CDLL) -> ctypes.CDLL:
+    i64 = ctypes.c_int64
+    u32 = ctypes.c_uint32
+    p = ctypes.POINTER
+    vp = ctypes.c_void_p
+    cp = ctypes.c_char_p
+
+    L.hgs_open.argtypes = [cp]
+    L.hgs_open.restype = vp
+    L.hgs_close.argtypes = [vp]
+    L.hgs_checkpoint.argtypes = [vp]
+    L.hgs_checkpoint.restype = ctypes.c_int
+    L.hgs_wal_ok.argtypes = [vp]
+    L.hgs_wal_ok.restype = ctypes.c_int
+    L.hgs_batch_begin.argtypes = [vp]
+    L.hgs_batch_commit.argtypes = [vp]
+    L.hgs_free.argtypes = [vp]
+    L.hgs_max_handle.argtypes = [vp]
+    L.hgs_max_handle.restype = i64
+
+    L.hgs_store_link.argtypes = [vp, i64, p(i64), u32]
+    L.hgs_get_link.argtypes = [vp, i64, p(p(i64)), p(u32)]
+    L.hgs_get_link.restype = ctypes.c_int
+    L.hgs_remove_link.argtypes = [vp, i64]
+    L.hgs_contains_link.argtypes = [vp, i64]
+    L.hgs_contains_link.restype = ctypes.c_int
+    L.hgs_link_count.argtypes = [vp]
+    L.hgs_link_count.restype = u32
+    L.hgs_bulk_links.argtypes = [vp, p(p(i64)), p(p(i64)), p(p(i64)), p(u32), p(u32)]
+
+    L.hgs_store_data.argtypes = [vp, i64, cp, u32]
+    L.hgs_get_data.argtypes = [vp, i64, p(cp), p(u32)]
+    L.hgs_get_data.restype = ctypes.c_int
+    L.hgs_remove_data.argtypes = [vp, i64]
+
+    L.hgs_inc_add.argtypes = [vp, i64, i64]
+    L.hgs_inc_remove.argtypes = [vp, i64, i64]
+    L.hgs_inc_clear.argtypes = [vp, i64]
+    L.hgs_inc_get.argtypes = [vp, i64, p(p(i64)), p(u32)]
+    L.hgs_inc_count.argtypes = [vp, i64]
+    L.hgs_inc_count.restype = u32
+
+    L.hgs_idx_add.argtypes = [vp, cp, cp, u32, i64]
+    L.hgs_idx_remove.argtypes = [vp, cp, cp, u32, i64]
+    L.hgs_idx_remove_all.argtypes = [vp, cp, cp, u32]
+    L.hgs_idx_drop.argtypes = [vp, cp]
+    L.hgs_idx_touch.argtypes = [vp, cp]
+    L.hgs_idx_exists.argtypes = [vp, cp]
+    L.hgs_idx_exists.restype = ctypes.c_int
+    L.hgs_idx_find.argtypes = [vp, cp, cp, u32, p(p(i64)), p(u32)]
+    L.hgs_idx_range.argtypes = [
+        vp, cp, cp, u32, ctypes.c_int, ctypes.c_int,
+        cp, u32, ctypes.c_int, ctypes.c_int, p(p(i64)), p(u32),
+    ]
+    L.hgs_idx_key_count.argtypes = [vp, cp]
+    L.hgs_idx_key_count.restype = u32
+    L.hgs_idx_scan_keys.argtypes = [vp, cp, p(cp), p(u32), p(u32)]
+    L.hgs_idx_find_by_value.argtypes = [vp, cp, i64, p(cp), p(u32), p(u32)]
+    L.hgs_idx_names.argtypes = [vp, p(cp), p(u32), p(u32)]
+    return L

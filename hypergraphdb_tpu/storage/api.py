@@ -158,6 +158,14 @@ class StorageBackend:
     def checkpoint(self) -> None:
         """Flush to durable media (no-op for memory)."""
 
+    def commit_batch_begin(self) -> None:
+        """Mark the start of one transaction's worth of mutations. Durable
+        backends make everything until ``commit_batch_end`` replay
+        atomically (all-or-nothing) after a crash. No-op for memory."""
+
+    def commit_batch_end(self) -> None:
+        """Seal the commit batch (see ``commit_batch_begin``)."""
+
     # -- link store: handle → ordered tuple of target handles ---------------
     def store_link(self, h: HGHandle, targets: Sequence[HGHandle]) -> None:
         raise NotImplementedError

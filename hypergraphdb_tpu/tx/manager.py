@@ -240,6 +240,17 @@ class HGTransactionManager:
 
     def _apply(self, tx: HGTransaction) -> None:
         b = self.backend
+        # bracket the whole application in a backend commit batch so durable
+        # backends replay it atomically after a crash (no half-applied
+        # commits — the WAL analogue of the reference's BDB txn commit)
+        b.commit_batch_begin()
+        try:
+            self._apply_ops(tx, b)
+        finally:
+            b.commit_batch_end()
+
+    @staticmethod
+    def _apply_ops(tx: HGTransaction, b: StorageBackend) -> None:
         for h, v in tx.links.items():
             if v is _TOMBSTONE:
                 b.remove_link(h)

@@ -143,8 +143,13 @@ class HGTypeSystem:
         if atype.name in self._by_name:
             return self._handle_by_name[atype.name]
         self._by_name[atype.name] = atype
-        # the type atom: value = type name, type = top
-        h = self.graph._add_type_atom(atype.name)
+        # the type atom: value = type name, type = top. On a persistent
+        # backend the atom may already exist from a previous open — adopt
+        # its handle so stored atoms keep resolving (HGTypeSystem.java:97-98
+        # class↔type index recovery).
+        h = self.graph._find_type_atom(atype.name)
+        if h is None:
+            h = self.graph._add_type_atom(atype.name)
         self._handle_by_name[atype.name] = h
         self._name_by_handle[h] = atype.name
         for c in classes:
@@ -166,8 +171,37 @@ class HGTypeSystem:
             return t
         name = self._name_by_handle.get(int(name_or_handle))
         if name is None:
+            name = self._recover_type_name(int(name_or_handle))
+        if name is None:
             raise TypeError_(f"handle {name_or_handle} is not a type atom")
         return self._by_name[name]
+
+    def _type_atom_name(self, handle: int) -> Optional[str]:
+        """If ``handle`` is a persisted type atom (typed by top), return its
+        stored name — whether or not that type is registered this session."""
+        rec = self.graph.store.get_link(handle)
+        if rec is None or len(rec) < 3:
+            return None
+        # only atoms typed by top (or top itself) are type atoms
+        top_h = self._handle_by_name.get("top")
+        if top_h is not None and rec[0] != int(top_h) and handle != int(top_h):
+            return None
+        data = self.graph.store.get_data(rec[1]) if rec[1] >= 0 else None
+        if data is None:
+            return None
+        return self.top.make(data)
+
+    def _recover_type_name(self, handle: int) -> Optional[str]:
+        """Reopen path: a persisted type atom whose name was registered this
+        session under a different handle, or not yet touched. Read the name
+        from the store and adopt the persisted handle if it matches a
+        registered type."""
+        name = self._type_atom_name(handle)
+        if name is not None and name in self._by_name:
+            self._handle_by_name.setdefault(name, handle)
+            self._name_by_handle[handle] = name
+            return name
+        return None
 
     def handle_of(self, name: str) -> HGHandle:
         h = self._handle_by_name.get(name)
@@ -179,7 +213,10 @@ class HGTypeSystem:
         return self._name_by_handle[int(handle)]
 
     def is_type_handle(self, handle: HGHandle) -> bool:
-        return int(handle) in self._name_by_handle
+        h = int(handle)
+        # persisted-but-unregistered type atoms count too: the remove guard
+        # must protect them across sessions, not just this session's registry
+        return h in self._name_by_handle or self._type_atom_name(h) is not None
 
     def get_type_handle(self, value: Any) -> HGHandle:
         """Infer the type of a runtime value (``HyperGraph.add`` step 1).

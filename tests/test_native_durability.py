@@ -1,0 +1,207 @@
+"""Durability tests for the native C++ backend.
+
+Models the reference's crash-recovery coverage (``testcore`` ``AbruptExit``
+kill-process test + BDB log replay on open, SURVEY §4/§5): state written
+before an abrupt process death must be fully visible after reopen; a torn
+WAL tail must be truncated, not poison the store.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+pytest.importorskip("hypergraphdb_tpu.storage.native")
+
+from hypergraphdb_tpu.storage.native import NativeStorage
+
+
+def test_reopen_sees_committed_state(tmp_path):
+    loc = str(tmp_path / "db")
+    s = NativeStorage(loc)
+    s.startup()
+    s.store_link(1, (10, 20))
+    s.store_data(2, b"payload")
+    s.add_incidence_link(10, 1)
+    s.get_index("by-name").add_entry(b"k", 7)
+    s.shutdown()
+
+    s2 = NativeStorage(loc)
+    s2.startup()
+    assert s2.get_link(1) == (10, 20)
+    assert s2.get_data(2) == b"payload"
+    assert s2.get_incidence_set(10).array().tolist() == [1]
+    assert s2.get_index("by-name").find(b"k").array().tolist() == [7]
+    assert s2.max_handle() >= 21
+    s2.shutdown()
+
+
+def test_abrupt_exit_recovery(tmp_path):
+    """Write in a subprocess that dies via os._exit (no shutdown/flush of
+    Python state); everything written must survive."""
+    loc = str(tmp_path / "db")
+    code = textwrap.dedent(f"""
+        import os, sys
+        sys.path.insert(0, {str(os.getcwd())!r})
+        from hypergraphdb_tpu.storage.native import NativeStorage
+        s = NativeStorage({loc!r})
+        s.startup()
+        for i in range(500):
+            s.store_link(i, (i + 1000, i + 2000))
+            s.add_incidence_link(i + 1000, i)
+        s.get_index("idx").add_entry(b"key", 42)
+        os._exit(9)  # abrupt: no shutdown, no atexit
+    """)
+    proc = subprocess.run([sys.executable, "-c", code], cwd=os.getcwd())
+    assert proc.returncode == 9
+
+    s = NativeStorage(loc)
+    s.startup()
+    assert s.get_link(499) == (1499, 2499)
+    assert s.get_incidence_set(1499).array().tolist() == [499]
+    assert s.get_index("idx").find(b"key").array().tolist() == [42]
+    s.shutdown()
+
+
+def test_checkpoint_compacts_and_survives(tmp_path):
+    loc = str(tmp_path / "db")
+    s = NativeStorage(loc)
+    s.startup()
+    for i in range(100):
+        s.store_link(i, (i + 100,))
+    s.checkpoint()
+    assert os.path.getsize(os.path.join(loc, "wal.log")) == 0
+    s.store_link(777, (1, 2, 3))  # post-checkpoint delta goes to fresh WAL
+    s.shutdown()
+
+    s2 = NativeStorage(loc)
+    s2.startup()
+    assert s2.get_link(50) == (150,)
+    assert s2.get_link(777) == (1, 2, 3)
+    s2.shutdown()
+
+
+def test_torn_wal_tail_truncated(tmp_path):
+    loc = str(tmp_path / "db")
+    s = NativeStorage(loc)
+    s.startup()
+    s.store_link(1, (2, 3))
+    s.shutdown()
+
+    # simulate a torn write: garbage partial record at the tail
+    wal = os.path.join(loc, "wal.log")
+    with open(wal, "ab") as f:
+        f.write(b"\xff\xff\xff\x7f\x01partial")
+
+    s2 = NativeStorage(loc)
+    s2.startup()
+    assert s2.get_link(1) == (2, 3)
+    # and the tail was cleaned: store accepts and persists new writes
+    s2.store_link(9, (8,))
+    s2.shutdown()
+    s3 = NativeStorage(loc)
+    s3.startup()
+    assert s3.get_link(9) == (8,)
+    s3.shutdown()
+
+
+def test_graph_over_native_backend(tmp_path):
+    """Full HyperGraph stack over the native backend, reopened."""
+    import hypergraphdb_tpu as hg
+
+    loc = str(tmp_path / "gdb")
+    cfg = hg.HGConfiguration(store_backend="native", location=loc)
+    g = hg.HyperGraph(cfg)
+    a = g.add("alpha")
+    b = g.add("beta")
+    l = g.add_link((a, b), value="rel")
+    g.close()
+
+    g2 = hg.HyperGraph(hg.HGConfiguration(store_backend="native", location=loc))
+    assert g2.get(a) == "alpha"
+    assert g2.get(l).targets == (a, b)
+    assert g2.get_incidence_set(a).array().tolist() == [int(l)]
+    from hypergraphdb_tpu.query import dsl as q
+
+    assert q.find_all(g2, q.value("beta")) == [int(b)]
+    g2.close()
+
+
+def test_mid_commit_crash_is_atomic(tmp_path):
+    """A process dying mid-commit-batch must leave NO partial state: records
+    between batch_begin and batch_commit replay all-or-nothing."""
+    loc = str(tmp_path / "db")
+    code = textwrap.dedent(f"""
+        import os, sys
+        sys.path.insert(0, {str(os.getcwd())!r})
+        from hypergraphdb_tpu.storage.native import NativeStorage
+        s = NativeStorage({loc!r})
+        s.startup()
+        # one complete commit
+        s.commit_batch_begin()
+        s.store_link(1, (10,))
+        s.add_incidence_link(10, 1)
+        s.commit_batch_end()
+        # one commit cut off mid-flight: link written, incidence NOT
+        s.commit_batch_begin()
+        s.store_link(2, (20,))
+        os._exit(9)
+    """)
+    proc = subprocess.run([sys.executable, "-c", code], cwd=os.getcwd())
+    assert proc.returncode == 9
+
+    s = NativeStorage(loc)
+    s.startup()
+    assert s.get_link(1) == (10,)
+    assert s.get_incidence_set(10).array().tolist() == [1]
+    # the unterminated batch must have been discarded entirely
+    assert s.get_link(2) is None
+    s.shutdown()
+
+
+def test_graph_commit_is_batched(tmp_path):
+    """HyperGraph.add over the native backend groups its writes into one
+    WAL commit batch (link + data + incidence + index entries atomic)."""
+    import hypergraphdb_tpu as hg
+
+    loc = str(tmp_path / "gdb")
+    g = hg.HyperGraph(hg.HGConfiguration(store_backend="native", location=loc))
+    a = g.add("x")
+    wal = os.path.join(loc, "wal.log")
+    raw = open(wal, "rb").read()
+    # batch markers present: op 13 (begin) and 14 (commit)
+    ops = []
+    pos = 0
+    while pos + 5 <= len(raw):
+        ln = int.from_bytes(raw[pos:pos + 4], "little")
+        ops.append(raw[pos + 4])
+        pos += 4 + ln
+    assert 13 in ops and 14 in ops
+    g.close()
+
+
+def test_type_atom_protected_across_sessions(tmp_path):
+    """A persisted type atom must be unremovable even in a session that
+    never (re-)registered its type."""
+    import hypergraphdb_tpu as hg
+    from dataclasses import dataclass
+
+    @dataclass(frozen=True)
+    class Marker:
+        tag: str = ""
+
+    loc = str(tmp_path / "gdb")
+    g = hg.HyperGraph(hg.HGConfiguration(store_backend="native", location=loc))
+    g.add(Marker("m1"))  # auto-registers the record type, creating its atom
+    th = int(g.get_type_handle_of(hg.HGHandle(0)) if False else
+             g.typesystem.handle_of(g.typesystem.infer(Marker("m1")).name))
+    g.close()
+
+    g2 = hg.HyperGraph(hg.HGConfiguration(store_backend="native", location=loc))
+    # session 2 never touches Marker; the guard must still refuse
+    import pytest as _pytest
+    with _pytest.raises(hg.HGException):
+        g2.remove(th)
+    g2.close()
