@@ -35,7 +35,7 @@ def build(force: bool = False) -> str:
             and os.path.getmtime(SO) >= os.path.getmtime(SRC)
         ):
             return SO
-        tmp = SO + ".tmp"
+        tmp = SO + f".tmp.{os.getpid()}"  # concurrent builders must not share
         cmd = [
             "g++", "-std=c++17", "-O2", "-fPIC", "-shared",
             "-o", tmp, SRC,
@@ -81,6 +81,7 @@ def _bind(L: ctypes.CDLL) -> ctypes.CDLL:
     L.hgs_wal_ok.restype = ctypes.c_int
     L.hgs_batch_begin.argtypes = [vp]
     L.hgs_batch_commit.argtypes = [vp]
+    L.hgs_batch_abort.argtypes = [vp]
     L.hgs_free.argtypes = [vp]
     L.hgs_max_handle.argtypes = [vp]
     L.hgs_max_handle.restype = i64

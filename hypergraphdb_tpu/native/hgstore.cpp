@@ -64,6 +64,7 @@ enum Op : u8 {
   OP_IDX_TOUCH = 12,
   OP_BATCH_BEGIN = 13,
   OP_BATCH_COMMIT = 14,
+  OP_BATCH_ABORT = 15,
 };
 
 struct Index {
@@ -180,7 +181,11 @@ struct Reader {
 };
 
 void wal_append(Store* s, u8 op, const std::string& payload) {
-  if (s->replaying || !s->wal) return;
+  if (s->replaying) return;
+  if (!s->wal) {  // e.g. checkpoint failed to reopen the log
+    s->wal_ok = false;
+    return;
+  }
   u32 len = static_cast<u32>(payload.size()) + 1;
   bool ok = fwrite(&len, 4, 1, s->wal) == 1 &&
             fwrite(&op, 1, 1, s->wal) == 1 &&
@@ -476,6 +481,10 @@ bool replay_wal(Store* s) {
       pending.clear();
       batch = false;
       good = (p + 4 + len) - buf.data();
+    } else if (op == OP_BATCH_ABORT) {
+      pending.clear();
+      batch = false;
+      good = (p + 4 + len) - buf.data();
     } else if (batch) {
       pending.push_back(std::make_pair(
           op, std::make_pair(body, body_end)));
@@ -531,7 +540,10 @@ int hgs_checkpoint(Store* s) {
   if (!save_checkpoint(s)) return -1;
   if (s->wal) fclose(s->wal);
   s->wal = fopen(s->wal_path().c_str(), "wb");  // truncate
-  if (!s->wal) return -1;
+  if (!s->wal) {
+    s->wal_ok = false;  // nothing can be logged until reopen
+    return -1;
+  }
   return 0;
 }
 
@@ -551,6 +563,13 @@ void hgs_batch_begin(Store* s) {
 void hgs_batch_commit(Store* s) {
   s->in_batch = false;
   wal_append(s, OP_BATCH_COMMIT, std::string());
+}
+
+// abort: the batch's records stay in the log but replay discards them at
+// this barrier — the durable image never shows a half-applied commit
+void hgs_batch_abort(Store* s) {
+  s->in_batch = false;
+  wal_append(s, OP_BATCH_ABORT, std::string());
 }
 
 i64 hgs_max_handle(Store* s) { return s->max_handle; }

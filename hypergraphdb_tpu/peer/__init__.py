@@ -1,0 +1,21 @@
+"""P2P distribution layer (SURVEY §2.3): peer runtime, pluggable transports
+(loopback + TCP), FIPA-ACL messages, activity state machines, remote graph
+ops (CACT), interest-based replication with op-log catch-up.
+
+This is the host-side control plane over DCN; the on-device data plane
+(collectives over ICI) lives in ``hypergraphdb_tpu.parallel`` (SURVEY §5
+"Distributed communication backend": two planes)."""
+
+from hypergraphdb_tpu.peer.peer import HyperGraphPeer
+from hypergraphdb_tpu.peer.transport import (
+    LoopbackNetwork,
+    PeerInterface,
+    TCPPeerInterface,
+)
+
+__all__ = [
+    "HyperGraphPeer",
+    "LoopbackNetwork",
+    "PeerInterface",
+    "TCPPeerInterface",
+]

@@ -1,0 +1,202 @@
+"""CACT — cluster activities: remote graph operations.
+
+Re-expression of the reference's ``peer/cact/`` package: ``AddAtom``,
+``GetAtom``, ``RemoveAtom``, ``ReplaceAtom``, ``GetIncidenceSet``,
+``QueryCount``, ``RunRemoteQuery`` and the cursor-streaming
+``RemoteQueryExecution`` (``peer/cact/RemoteQueryExecution.java:34``: the
+server compiles+runs the query locally, holds the result open, and the
+client pages through it over the wire).
+
+Each op is a two-sided FSM activity over the performative protocol:
+client sends REQUEST with op payload; server replies INFORM (result) or
+FAILURE. RemoteQuery adds a paging loop (QUERY_REF → INFORM chunks →
+CANCEL/complete)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from hypergraphdb_tpu.peer import messages as M
+from hypergraphdb_tpu.peer import transfer
+from hypergraphdb_tpu.peer.activity import Activity, STARTED, from_state
+from hypergraphdb_tpu.query import serialize as qser
+
+
+# --------------------------------------------------------------- client side
+
+
+class RemoteOpClient(Activity):
+    """Generic request/response client activity."""
+
+    TYPE = "cact"
+
+    def __init__(self, peer, target: Optional[str] = None, op: Optional[dict] = None,
+                 activity_id: Optional[str] = None):
+        super().__init__(peer, activity_id)
+        self.target = target
+        self.op = op or {}
+
+    def initiate(self) -> None:
+        self.send(self.target, M.REQUEST, self.op)
+
+    @from_state(STARTED, M.INFORM)
+    def on_result(self, sender: str, msg: dict) -> None:
+        self.complete(msg["content"])
+
+    @from_state(STARTED, M.FAILURE)
+    def on_failure(self, sender: str, msg: dict) -> None:
+        self.fail(RuntimeError(str(msg["content"])))
+
+
+class RemoteOpServer(Activity):
+    """Generic server: executes the op against the local graph."""
+
+    TYPE = "cact"
+
+    OPS = {}
+
+    @from_state(STARTED, M.REQUEST)
+    def on_request(self, sender: str, msg: dict) -> None:
+        op = msg["content"] or {}
+        handler = self.OPS.get(op.get("op"))
+        if handler is None:
+            self.reply(sender, msg, M.FAILURE, f"unknown op {op.get('op')}")
+            self.fail(f"unknown op {op.get('op')}")
+            return
+        try:
+            result = handler(self, op)
+        except Exception as e:
+            self.reply(sender, msg, M.FAILURE, f"{type(e).__name__}: {e}")
+            self.fail(e)
+            return
+        self.reply(sender, msg, M.INFORM, result)
+        self.complete(result)
+
+    # -- op handlers (the cact/ class-per-op set) -------------------------
+
+    def _op_define_atom(self, op: dict) -> Any:
+        """AddAtom/DefineAtom: store a transferred closure locally."""
+        handles = transfer.store_closure(self.peer.graph, op["atoms"])
+        return {"handles": handles}
+
+    def _op_get_atom(self, op: dict) -> Any:
+        g = self.peer.graph
+        gid = op.get("gid")
+        h = transfer.lookup_local(g, gid) if gid else op.get("handle")
+        if h is None or not g.contains(int(h)):
+            raise KeyError(f"atom not found: {gid or op.get('handle')}")
+        return {"atoms": transfer.serialize_closure(g, int(h), self.peer.identity)}
+
+    def _op_remove_atom(self, op: dict) -> Any:
+        g = self.peer.graph
+        gid = op.get("gid")
+        h = transfer.lookup_local(g, gid) if gid else op.get("handle")
+        ok = bool(h is not None and g.remove(int(h)))
+        return {"removed": ok}
+
+    def _op_get_incidence_set(self, op: dict) -> Any:
+        g = self.peer.graph
+        h = int(op["handle"])
+        return {"incidence": g.get_incidence_set(h).array().tolist()}
+
+    def _op_query_count(self, op: dict) -> Any:
+        cond = qser.from_json(op["condition"])
+        return {"count": self.peer.graph.count(cond)}
+
+    def _op_run_query(self, op: dict) -> Any:
+        """One-shot remote query: compile + run + return all handles.
+        (Streaming variant: RemoteQueryServer below.)"""
+        cond = qser.from_json(op["condition"])
+        return {"handles": [int(h) for h in self.peer.graph.find_all(cond)]}
+
+
+RemoteOpServer.OPS = {
+    "define_atom": RemoteOpServer._op_define_atom,
+    "get_atom": RemoteOpServer._op_get_atom,
+    "remove_atom": RemoteOpServer._op_remove_atom,
+    "get_incidence_set": RemoteOpServer._op_get_incidence_set,
+    "query_count": RemoteOpServer._op_query_count,
+    "run_query": RemoteOpServer._op_run_query,
+}
+
+
+# ------------------------------------------------------- streaming remote query
+
+
+class RemoteQueryClient(Activity):
+    """Cursor-paging remote query (RemoteQueryExecution): QUERY_REF opens a
+    server-held result; INFORM chunks stream back; the final chunk (eof)
+    completes with the full handle list."""
+
+    TYPE = "cact-query"
+
+    def __init__(self, peer, target: Optional[str] = None,
+                 condition=None, page: int = 64,
+                 activity_id: Optional[str] = None):
+        super().__init__(peer, activity_id)
+        self.target = target
+        self.condition = condition
+        self.page = page
+        self.rows: list[int] = []
+
+    def initiate(self) -> None:
+        self.send(self.target, M.QUERY_REF, {
+            "condition": qser.to_json(self.condition),
+            "page": self.page,
+        })
+
+    @from_state(STARTED, M.INFORM)
+    def on_chunk(self, sender: str, msg: dict) -> None:
+        c = msg["content"]
+        self.rows.extend(c["rows"])
+        if c["eof"]:
+            self.complete(self.rows)
+        else:
+            self.reply(sender, msg, M.CONFIRM)  # pull next page
+
+    @from_state(STARTED, M.FAILURE)
+    def on_failure(self, sender: str, msg: dict) -> None:
+        self.fail(RuntimeError(str(msg["content"])))
+
+
+class RemoteQueryServer(Activity):
+    """Server side: executes once, then streams pages on CONFIRM pulls —
+    the server-held open-result-set state (``state=ResultSetOpen``)."""
+
+    TYPE = "cact-query"
+
+    def __init__(self, peer, activity_id: Optional[str] = None):
+        super().__init__(peer, activity_id)
+        self.results: Optional[list[int]] = None
+        self.pos = 0
+        self.page = 64
+
+    @from_state(STARTED, M.QUERY_REF)
+    def on_open(self, sender: str, msg: dict) -> None:
+        content = msg["content"]
+        try:
+            cond = qser.from_json(content["condition"])
+            self.page = int(content.get("page", 64))
+            self.results = [int(h) for h in self.peer.graph.find_all(cond)]
+        except Exception as e:
+            self.reply(sender, msg, M.FAILURE, f"{type(e).__name__}: {e}")
+            self.fail(e)
+            return
+        self.state = "ResultSetOpen"
+        self._send_page(sender, msg)
+
+    @from_state("ResultSetOpen", M.CONFIRM)
+    def on_pull(self, sender: str, msg: dict) -> None:
+        self._send_page(sender, msg)
+
+    @from_state("ResultSetOpen", M.CANCEL)
+    def on_cancel(self, sender: str, msg: dict) -> None:
+        self.complete(None)
+
+    def _send_page(self, sender: str, msg: dict) -> None:
+        rows = self.results[self.pos : self.pos + self.page]
+        self.pos += len(rows)
+        eof = self.pos >= len(self.results)
+        self.reply(sender, msg, M.INFORM, {"rows": rows, "eof": eof})
+        if eof:
+            self.complete(len(self.results))

@@ -1,0 +1,53 @@
+"""FIPA-ACL-style message envelopes.
+
+Re-expression of the reference's performative vocabulary and Json envelopes
+(``peer/Performative.java``, ``peer/Messages.java:22``): every message
+carries a performative, an activity type + id (conversation correlation),
+and content. ``reply_to`` builds the response envelope with the same
+conversation id (the ``Messages.getReply`` analogue).
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Optional
+
+# the performative constant pool (Performative.java)
+REQUEST = "request"
+INFORM = "inform"
+QUERY_REF = "query-ref"
+PROPOSE = "propose"
+ACCEPT_PROPOSAL = "accept-proposal"
+REJECT_PROPOSAL = "reject-proposal"
+AGREE = "agree"
+REFUSE = "refuse"
+FAILURE = "failure"
+CONFIRM = "confirm"
+DISCONFIRM = "disconfirm"
+CANCEL = "cancel"
+SUBSCRIBE = "subscribe"
+NOT_UNDERSTOOD = "not-understood"
+
+
+def make_message(
+    performative: str,
+    activity_type: str,
+    content: Any = None,
+    activity_id: Optional[str] = None,
+) -> dict:
+    return {
+        "performative": performative,
+        "activity_type": activity_type,
+        "activity_id": activity_id or str(uuid.uuid4()),
+        "content": content,
+    }
+
+
+def reply_to(msg: dict, performative: str, content: Any = None) -> dict:
+    """Response envelope correlated to the same activity/conversation."""
+    return {
+        "performative": performative,
+        "activity_type": msg["activity_type"],
+        "activity_id": msg["activity_id"],
+        "content": content,
+    }

@@ -1,0 +1,161 @@
+"""HyperGraphPeer — the peer runtime.
+
+Re-expression of ``peer/HyperGraphPeer.java:57``: owns a local graph, a
+persisted identity, a pluggable transport, the activity scheduler, and the
+bootstrap services (identity handshake, CACT responders, replication) —
+``HyperGraphPeer.start()`` at :307-353.
+
+Config is a plain dict (the reference uses a JSON file; ``from_config``
+accepts the same shape)::
+
+    peer = HyperGraphPeer(graph, interface=LoopbackNetwork().interface("p1"))
+    peer.start()
+    handles = peer.run_remote_query(other_id, q.type_("string"))
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Optional
+
+from hypergraphdb_tpu.peer import cact
+from hypergraphdb_tpu.peer.activity import ActivityManager
+from hypergraphdb_tpu.peer.replication import Replication
+from hypergraphdb_tpu.peer.transport import (
+    LoopbackNetwork,
+    PeerInterface,
+    TCPPeerInterface,
+)
+
+
+class HyperGraphPeer:
+    def __init__(
+        self,
+        graph,
+        interface: PeerInterface,
+        identity: Optional[str] = None,
+    ):
+        self.graph = graph
+        self.interface = interface
+        #: persisted peer identity (HGPeerIdentity analogue)
+        self.identity = identity or self._load_identity()
+        self.activities = ActivityManager(self)
+        self.replication = Replication(self)
+        self._started = False
+
+        # bootstrap: server-side activity factories (CACTBootstrap analogue)
+        self.activities.register_type("cact", lambda peer, activity_id=None:
+                                      cact.RemoteOpServer(peer, activity_id))
+        self.activities.register_type("cact-query",
+                                      lambda peer, activity_id=None:
+                                      cact.RemoteQueryServer(peer, activity_id))
+
+    def _load_identity(self) -> str:
+        """Stable identity persisted in the graph (one per database)."""
+        idx = self.graph.store.get_index("hg.peer.identity")
+        existing = idx.find_first(b"self")
+        if existing is not None:
+            data = self.graph.store.get_data(int(existing))
+            if data:
+                return data.decode("utf-8")
+        ident = uuid.uuid4().hex
+
+        def run():
+            h = self.graph.handles.make()
+            self.graph.store.store_data(h, ident.encode("utf-8"))
+            idx.add_entry(b"self", h)
+
+        self.graph.txman.ensure_transaction(run)
+        return ident
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        if self._started:
+            return
+        self.interface.peer_id = self.identity
+        self.interface.on_message(self._dispatch)
+        self.interface.start()
+        self.activities.start()
+        self.replication.attach()
+        self._started = True
+
+    def stop(self) -> None:
+        if not self._started:
+            return
+        self.activities.stop()
+        self.interface.stop()
+        self._started = False
+
+    def _dispatch(self, sender: str, msg: dict) -> None:
+        # replication messages are lightweight service traffic; everything
+        # else is conversation-scoped and goes through the activity scheduler
+        if self.replication.handle(sender, msg):
+            return
+        self.activities.on_message(sender, msg)
+
+    # -- remote op façade (the cact client calls) -----------------------------
+    def _run_op(self, target: str, op: dict, timeout: float = 10.0) -> Any:
+        act = self.activities.initiate(
+            cact.RemoteOpClient(self, target=target, op=op)
+        )
+        return act.future.result(timeout=timeout)
+
+    def define_remote(self, target: str, handle, timeout: float = 10.0) -> list[int]:
+        """Push an atom closure to a remote peer (AddAtom/DefineAtom)."""
+        from hypergraphdb_tpu.peer import transfer
+
+        atoms = transfer.serialize_closure(self.graph, int(handle), self.identity)
+        return self._run_op(target, {"op": "define_atom", "atoms": atoms},
+                            timeout)["handles"]
+
+    def get_remote(self, target: str, gid: str, timeout: float = 10.0) -> list[int]:
+        """Fetch a remote atom closure and store it locally (GetAtom)."""
+        from hypergraphdb_tpu.peer import transfer
+
+        result = self._run_op(target, {"op": "get_atom", "gid": gid}, timeout)
+        return transfer.store_closure(self.graph, result["atoms"])
+
+    def remove_remote(self, target: str, gid: str, timeout: float = 10.0) -> bool:
+        return self._run_op(target, {"op": "remove_atom", "gid": gid},
+                            timeout)["removed"]
+
+    def remote_incidence_set(self, target: str, handle: int,
+                             timeout: float = 10.0) -> list[int]:
+        return self._run_op(
+            target, {"op": "get_incidence_set", "handle": int(handle)}, timeout
+        )["incidence"]
+
+    def count_remote(self, target: str, condition, timeout: float = 10.0) -> int:
+        from hypergraphdb_tpu.query import serialize as qser
+
+        return self._run_op(
+            target, {"op": "query_count", "condition": qser.to_json(condition)},
+            timeout,
+        )["count"]
+
+    def run_remote_query(self, target: str, condition, page: int = 64,
+                         timeout: float = 10.0) -> list[int]:
+        """Streaming remote query (RemoteQueryExecution): pages a server-held
+        result cursor; returns all remote handles."""
+        act = self.activities.initiate(
+            cact.RemoteQueryClient(self, target=target, condition=condition,
+                                   page=page)
+        )
+        return act.future.result(timeout=timeout)
+
+    # -- convenience constructors ---------------------------------------------
+    @staticmethod
+    def loopback(graph, network: LoopbackNetwork,
+                 identity: Optional[str] = None) -> "HyperGraphPeer":
+        peer = HyperGraphPeer(graph, network.interface("pending"), identity)
+        peer.interface.peer_id = peer.identity
+        return peer
+
+    @staticmethod
+    def tcp(graph, host: str = "127.0.0.1", port: int = 0,
+            identity: Optional[str] = None) -> "HyperGraphPeer":
+        peer = HyperGraphPeer(
+            graph, TCPPeerInterface("pending", host, port), identity
+        )
+        peer.interface.peer_id = peer.identity
+        return peer

@@ -1,0 +1,205 @@
+"""Interest-based replication with an op log and offline catch-up.
+
+Re-expression of the reference's ``peer/replication/`` + ``peer/log/``:
+
+- **Interest predicates** (``Replication.java:19``): each peer publishes a
+  serialized query condition; others push atom changes matching it
+  (``PublishInterestsTask``/``RememberTaskClient.java:54``).
+- **Op log with vector timestamps** (``peer/log/Log.java:34``): every local
+  mutation appends (seq, op, atom closure); peers track how far they've
+  seen each other's logs.
+- **Catch-up** (``CatchUpTaskClient.java:33``): a peer that was offline
+  requests entries since its recorded timestamp and applies them in order.
+
+Eventual consistency, no consensus — deliberately matching the reference's
+stance (SURVEY §7 hard part 5)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from hypergraphdb_tpu.core import events as ev
+from hypergraphdb_tpu.peer import messages as M
+from hypergraphdb_tpu.peer import transfer
+from hypergraphdb_tpu.query import serialize as qser
+
+
+class OpLog:
+    """Append-only in-memory log of local mutations (one per peer).
+
+    Entries: (seq, kind, payload). seq is this peer's own monotonically
+    increasing timestamp — the vector-clock component it owns."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.entries: list[tuple[int, str, Any]] = []
+
+    def append(self, kind: str, payload: Any) -> int:
+        with self._lock:
+            seq = len(self.entries) + 1
+            self.entries.append((seq, kind, payload))
+            return seq
+
+    def since(self, seq: int) -> list[tuple[int, str, Any]]:
+        with self._lock:
+            return [e for e in self.entries if e[0] > seq]
+
+    @property
+    def head(self) -> int:
+        with self._lock:
+            return len(self.entries)
+
+
+class Replication:
+    """Per-peer replication service: publishes interests, pushes matching
+    changes, applies incoming pushes, serves/runs catch-up."""
+
+    ACTIVITY_TYPE = "replication"
+
+    def __init__(self, peer):
+        self.peer = peer
+        self.log = OpLog()
+        #: my interest predicate (None = not interested in anything)
+        self.interest = None
+        #: peer id -> their deserialized interest condition
+        self.peer_interests: dict[str, Any] = {}
+        #: vector clock: peer id -> last seq of THEIR log I've applied
+        self.last_seen: dict[str, int] = {}
+        self._listening = False
+        # thread-local "applying a foreign push" flag: suppresses the local
+        # event listeners so replicated writes don't echo back out, without
+        # blinding OTHER threads' genuine local mutations
+        self._tls = threading.local()
+
+    # -- wiring ---------------------------------------------------------------
+    def attach(self) -> None:
+        """Subscribe to local graph events (HGAtomAddedEvent push path)."""
+        if self._listening:
+            return
+        g = self.peer.graph
+        g.events.add_listener(ev.HGAtomAddedEvent, self._on_added)
+        g.events.add_listener(ev.HGAtomRemovedEvent, self._on_removed)
+        g.events.add_listener(ev.HGAtomReplacedEvent, self._on_replaced)
+        self._listening = True
+
+    # -- local mutation hooks → log + push ------------------------------------
+    def _on_added(self, graph, event) -> None:
+        self._record("add", int(event.handle))
+
+    def _on_replaced(self, graph, event) -> None:
+        self._record("add", int(event.handle))  # same write-through semantics
+
+    @property
+    def _applying(self) -> bool:
+        return getattr(self._tls, "applying", False)
+
+    def _on_removed(self, graph, event) -> None:
+        if self._applying:
+            return
+        h = int(event.handle)
+        gid = transfer.gid_of(self.peer.graph, h, self.peer.identity)
+        entry = {"gid": gid}
+        self.log.append("remove", entry)
+        for pid in list(self.peer_interests):
+            self._push(pid, "remove", entry)
+
+    def _record(self, kind: str, h: int) -> None:
+        if self._applying:
+            # this write IS a replicated one — re-pushing it would echo
+            # forever between interested peers
+            return
+        g = self.peer.graph
+        if not g.contains(h):
+            return
+        atoms = transfer.serialize_closure(g, h, self.peer.identity)
+        entry = {"atoms": atoms,
+                 "root": transfer.gid_of(g, h, self.peer.identity)}
+        self.log.append(kind, entry)
+        for pid, cond in list(self.peer_interests.items()):
+            if cond is None or self._matches(cond, h):
+                self._push(pid, kind, entry)
+
+    def _matches(self, cond, h: int) -> bool:
+        try:
+            return bool(cond.satisfies(self.peer.graph, h))
+        except Exception:
+            return False
+
+    def _push(self, pid: str, kind: str, entry: dict) -> None:
+        self.peer.interface.send(pid, M.make_message(
+            M.INFORM, self.ACTIVITY_TYPE,
+            {"what": "push", "kind": kind, "entry": entry,
+             "seq": self.log.head},
+        ))
+
+    # -- interest publication ---------------------------------------------------
+    def publish_interest(self, condition) -> None:
+        """Declare what I want replicated to me, to every known peer."""
+        self.interest = condition
+        payload = None if condition is None else qser.to_json(condition)
+        for pid in self.peer.interface.peers():
+            self.peer.interface.send(pid, M.make_message(
+                M.SUBSCRIBE, self.ACTIVITY_TYPE,
+                {"what": "interest", "condition": payload},
+            ))
+
+    # -- catch-up ---------------------------------------------------------------
+    def catch_up(self, pid: str) -> None:
+        """Ask ``pid`` for its log entries after my recorded position."""
+        self.peer.interface.send(pid, M.make_message(
+            M.REQUEST, self.ACTIVITY_TYPE,
+            {"what": "catchup", "since": self.last_seen.get(pid, 0)},
+        ))
+
+    # -- message handling (runs on the peer's dispatch path) --------------------
+    def handle(self, sender: str, msg: dict) -> bool:
+        if msg.get("activity_type") != self.ACTIVITY_TYPE:
+            return False
+        content = msg.get("content") or {}
+        if not isinstance(content, dict):
+            return False
+        what = content.get("what")
+        if what == "interest":
+            cond = content.get("condition")
+            self.peer_interests[sender] = (
+                None if cond is None else qser.from_json(cond)
+            )
+        elif what == "push":
+            self._apply(sender, content["kind"], content["entry"])
+            self.last_seen[sender] = max(
+                self.last_seen.get(sender, 0), int(content.get("seq", 0))
+            )
+        elif what == "catchup":
+            since = int(content.get("since", 0))
+            entries = [
+                {"seq": seq, "kind": kind, "entry": entry}
+                for seq, kind, entry in self.log.since(since)
+            ]
+            self.peer.interface.send(sender, M.make_message(
+                M.INFORM, self.ACTIVITY_TYPE,
+                {"what": "catchup-result", "entries": entries,
+                 "head": self.log.head},
+            ))
+        elif what == "catchup-result":
+            for e in content.get("entries", ()):
+                self._apply(sender, e["kind"], e["entry"])
+                self.last_seen[sender] = max(
+                    self.last_seen.get(sender, 0), int(e["seq"])
+                )
+        else:
+            return False
+        return True
+
+    def _apply(self, sender: str, kind: str, entry: dict) -> None:
+        g = self.peer.graph
+        self._tls.applying = True
+        try:
+            if kind == "remove":
+                local = transfer.lookup_local(g, entry["gid"])
+                if local is not None and g.contains(int(local)):
+                    g.remove(int(local))
+                return
+            transfer.store_closure(g, entry["atoms"])
+        finally:
+            self._tls.applying = False

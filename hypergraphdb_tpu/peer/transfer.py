@@ -1,0 +1,123 @@
+"""Atom (sub)graph transfer: serialize atom closures between peers.
+
+Re-expression of ``SubgraphManager`` (``peer/SubgraphManager.java:57``) —
+atoms travel as (type name, value bytes, target refs) records and are
+written through on the receiving side. Identity: local handles are dense
+per-graph ints (not the reference's global UUIDs), so every transferred
+atom carries a **global id** ``origin_peer:origin_handle``; each peer keeps
+a persistent ``hg.peer.atommap`` index translating global ids to local
+handles (created on first sight, updated on replace)."""
+
+from __future__ import annotations
+
+import base64
+from typing import Optional
+
+IDX_ATOM_MAP = "hg.peer.atommap"
+
+
+def global_id(origin_peer: str, origin_handle: int) -> str:
+    return f"{origin_peer}:{int(origin_handle)}"
+
+
+def gid_of(graph, h: int, origin_peer: str) -> str:
+    """The atom's global id. Atoms that arrived FROM another peer (or were
+    exported before) already have a mapping in the atom map — reuse it, so
+    a replicated atom keeps ONE identity everywhere instead of being
+    re-minted (and duplicated) on push-back. Fresh local atoms are assigned
+    ``origin_peer:handle`` and recorded for the same reason."""
+    h = int(h)
+    keys = _atom_map(graph).find_by_value(h)
+    if keys:
+        return keys[0].decode("utf-8")
+    gid = global_id(origin_peer, h)
+    graph.txman.ensure_transaction(
+        lambda: _atom_map(graph).add_entry(gid.encode("utf-8"), h)
+    )
+    return gid
+
+
+def serialize_atom(graph, h: int, origin_peer: str) -> dict:
+    """One atom → wire dict; the atom and its targets are referenced by
+    their global ids (existing mappings reused, see ``gid_of``)."""
+    h = int(h)
+    rec = graph.store.get_link(h)
+    if rec is None:
+        raise KeyError(h)
+    type_handle, value_handle, flags = rec[0], rec[1], rec[2]
+    targets = rec[3:]
+    data = graph.store.get_data(value_handle) if value_handle >= 0 else None
+    return {
+        "gid": gid_of(graph, h, origin_peer),
+        "type": graph.typesystem.name_of(type_handle),
+        "value_b64": (
+            base64.b64encode(data).decode("ascii") if data is not None else None
+        ),
+        "is_link": bool(flags & 1),
+        "targets": [gid_of(graph, t, origin_peer) for t in targets],
+    }
+
+
+def serialize_closure(graph, h: int, origin_peer: str) -> list[dict]:
+    """The atom plus its transitive target closure, dependencies first."""
+    out: list[dict] = []
+    seen: set[int] = set()
+
+    def visit(x: int) -> None:
+        x = int(x)
+        if x in seen:
+            return
+        seen.add(x)
+        rec = graph.store.get_link(x)
+        if rec is None:
+            return
+        for t in rec[3:]:
+            visit(t)
+        out.append(serialize_atom(graph, x, origin_peer))
+
+    visit(h)
+    return out
+
+
+def _atom_map(graph):
+    return graph.store.get_index(IDX_ATOM_MAP)
+
+
+def lookup_local(graph, gid: str) -> Optional[int]:
+    return _atom_map(graph).find_first(gid.encode("utf-8"))
+
+
+def store_atom(graph, wire: dict) -> int:
+    """Write one transferred atom (write-through, ``HGStore.attachOverlayGraph``
+    analogue): create or replace the local twin of ``wire['gid']``.
+    Targets must already be mapped (send closures dependencies-first)."""
+    gid = wire["gid"]
+    atype = graph.typesystem.get_type(wire["type"])
+    value = (
+        atype.make(base64.b64decode(wire["value_b64"]))
+        if wire["value_b64"] is not None
+        else None
+    )
+    targets = []
+    for tg in wire["targets"]:
+        lt = lookup_local(graph, tg)
+        if lt is None:
+            raise KeyError(f"unmapped target {tg}")
+        targets.append(int(lt))
+
+    local = lookup_local(graph, gid)
+    if local is not None:
+        if graph.contains(local):
+            graph.replace(local, value)
+            return int(local)
+        _atom_map(graph).remove_entry(gid.encode("utf-8"), local)
+    if wire["is_link"]:
+        h = graph.add_link(targets, value=value, type=wire["type"])
+    else:
+        h = graph.add_node(value, type=wire["type"])
+    _atom_map(graph).add_entry(gid.encode("utf-8"), int(h))
+    return int(h)
+
+
+def store_closure(graph, atoms: list[dict]) -> list[int]:
+    return [store_atom(graph, w) for w in atoms]

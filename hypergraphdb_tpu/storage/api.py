@@ -166,6 +166,10 @@ class StorageBackend:
     def commit_batch_end(self) -> None:
         """Seal the commit batch (see ``commit_batch_begin``)."""
 
+    def commit_batch_abort(self) -> None:
+        """Mark the open commit batch failed: durable backends must discard
+        its records on replay instead of applying them. No-op for memory."""
+
     # -- link store: handle → ordered tuple of target handles ---------------
     def store_link(self, h: HGHandle, targets: Sequence[HGHandle]) -> None:
         raise NotImplementedError

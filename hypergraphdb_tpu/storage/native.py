@@ -172,6 +172,9 @@ class NativeStorage(StorageBackend):
         self._L.hgs_batch_commit(self._h)
         self._check_wal()
 
+    def commit_batch_abort(self) -> None:
+        self._L.hgs_batch_abort(self._h)
+
     def _check_wal(self) -> None:
         """Surface any latched WAL write failure (disk full, IO error) —
         silent durability loss is worse than a failing commit."""

@@ -246,7 +246,12 @@ class HGTransactionManager:
         b.commit_batch_begin()
         try:
             self._apply_ops(tx, b)
-        finally:
+        except BaseException:
+            # an error mid-apply must NOT seal the batch: sealing would make
+            # the half-applied commit replay as atomic. Abort discards it.
+            b.commit_batch_abort()
+            raise
+        else:
             b.commit_batch_end()
 
     @staticmethod

@@ -195,8 +195,7 @@ def test_type_atom_protected_across_sessions(tmp_path):
     loc = str(tmp_path / "gdb")
     g = hg.HyperGraph(hg.HGConfiguration(store_backend="native", location=loc))
     g.add(Marker("m1"))  # auto-registers the record type, creating its atom
-    th = int(g.get_type_handle_of(hg.HGHandle(0)) if False else
-             g.typesystem.handle_of(g.typesystem.infer(Marker("m1")).name))
+    th = int(g.typesystem.handle_of(g.typesystem.infer(Marker("m1")).name))
     g.close()
 
     g2 = hg.HyperGraph(hg.HGConfiguration(store_backend="native", location=loc))
@@ -205,3 +204,22 @@ def test_type_atom_protected_across_sessions(tmp_path):
     with _pytest.raises(hg.HGException):
         g2.remove(th)
     g2.close()
+
+
+def test_aborted_batch_discarded_on_replay(tmp_path):
+    """commit_batch_abort must make the batch invisible after reopen while
+    later writes still apply."""
+    loc = str(tmp_path / "db")
+    s = NativeStorage(loc)
+    s.startup()
+    s.commit_batch_begin()
+    s.store_link(1, (10,))
+    s.commit_batch_abort()
+    s.store_link(2, (20,))  # standalone write after the abort
+    s.shutdown()
+
+    s2 = NativeStorage(loc)
+    s2.startup()
+    assert s2.get_link(1) is None, "aborted batch leaked into replay"
+    assert s2.get_link(2) == (20,)
+    s2.shutdown()
