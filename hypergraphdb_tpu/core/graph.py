@@ -97,6 +97,9 @@ class HyperGraph:
         self.typesystem = HGTypeSystem(self)
         self.typesystem.bootstrap()
         self.stats = HGStats()
+        from hypergraphdb_tpu.utils.metrics import Metrics
+
+        self.metrics = Metrics()
         self._snapshot_cache = None
         self._mutations = 0  # bumped on every committed structural change
         self.events.dispatch(self, ev.HGOpenedEvent(graph=self))
@@ -204,6 +207,7 @@ class HyperGraph:
 
     def _committed_mutation(self, event: ev.HGEvent, n: int = 1) -> None:
         self._mutations += n
+        self.metrics.incr("graph.mutations", n)
         self.events.dispatch(self, event)
 
     def _write_atom(
@@ -420,20 +424,31 @@ class HyperGraph:
             ev.HGAtomRemovedEvent(h)))
         return True
 
-    def _remove_rec(self, h: int, keep: bool, seen: set[int]) -> None:
+    def _remove_rec(self, h: int, keep: bool, seen: set[int],
+                    root: bool = True) -> None:
         if h in seen:
             return
         seen.add(h)
         rec = self.store.get_link(h)
         if rec is None:
             return
+        # cascaded atoms get the same veto chance as the root (the root's
+        # event fired in remove()); a veto mid-cascade aborts the whole
+        # removal — partial cascades would leave dangling targets
+        if not root and (
+            self.events.dispatch(self, ev.HGAtomRemoveRequestEvent(h))
+            == ev.HGListener.CANCEL
+        ):
+            raise HGException(
+                f"cascade removal of atom {h} vetoed by listener"
+            )
         type_handle, value_handle, flags = rec[0], rec[1], rec[2]
         targets = tuple(rec[3:])
         # incident links: either cascade-remove or rewrite their target lists
         incident = self.store.get_incidence_set(h).array().tolist()
         for link in incident:
             if not keep:
-                self._remove_rec(int(link), keep, seen)
+                self._remove_rec(int(link), keep, seen, root=False)
             else:
                 link = int(link)
                 lrec = self.store.get_link(link)
@@ -588,8 +603,12 @@ class HyperGraph:
 
         snap = self._snapshot_cache
         if snap is not None and not refresh and snap.version == self._mutations:
+            self.metrics.incr("snapshot.cache_hits")
             return snap
-        snap = CSRSnapshot.pack(self, version=self._mutations)
+        with self.metrics.timer("snapshot.pack"):
+            snap = CSRSnapshot.pack(self, version=self._mutations)
+        self.metrics.gauge("snapshot.num_atoms", snap.num_atoms)
+        self.metrics.gauge("snapshot.incidence_edges", snap.n_edges_inc)
         self._snapshot_cache = snap
         return snap
 

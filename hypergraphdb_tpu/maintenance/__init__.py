@@ -1,0 +1,25 @@
+"""Maintenance: resumable offline operations run at open.
+
+Re-expression of the reference's ``maintenance/`` package
+(``MaintenanceOperation``, ``ApplyNewIndexer`` with its batch-100
+``lastProcessed`` cursor at ``maintenance/ApplyNewIndexer.java:36-41``,
+``Migration``/``Upgrade``): a maintenance operation is persisted AS AN
+ATOM, executes in batches with a persisted cursor, and — if the process
+dies mid-run — resumes from the cursor on the next open.
+"""
+
+from hypergraphdb_tpu.maintenance.operations import (
+    ApplyNewIndexer,
+    MaintenanceException,
+    MaintenanceOperation,
+    run_pending,
+    schedule,
+)
+
+__all__ = [
+    "ApplyNewIndexer",
+    "MaintenanceException",
+    "MaintenanceOperation",
+    "run_pending",
+    "schedule",
+]

@@ -680,7 +680,9 @@ class CompiledQuery:
         def run():
             return self.plan.run(self.graph)
 
-        arr = self.graph.txman.ensure_transaction(run, readonly=True)
+        with self.graph.metrics.timer("query.execute"):
+            arr = self.graph.txman.ensure_transaction(run, readonly=True)
+        self.graph.metrics.incr("query.executed")
         return iter(arr.tolist())
 
     def results(self) -> np.ndarray:
@@ -690,7 +692,13 @@ class CompiledQuery:
         return int(len(self.plan.run(self.graph)))
 
     def analyze(self) -> str:
-        return self.plan.describe()
+        """Plan dump (AnalyzedQuery: condition → simplified form → physical
+        plan, ``QueryCompile.analyze`` ``query/QueryCompile.java:148``)."""
+        return (
+            f"condition:  {self.condition}\n"
+            f"simplified: {self.simplified}\n"
+            f"plan:       {self.plan.describe()}"
+        )
 
 
 def compile_query(graph, condition: c.HGQueryCondition) -> CompiledQuery:

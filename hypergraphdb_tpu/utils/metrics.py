@@ -1,0 +1,76 @@
+"""Structured metrics — counters, gauges, timers per graph instance.
+
+The reference's observability is a handful of ad-hoc counters (``HGStats``
+atom access counts ``atom/HGStats.java:20``, ``TxMonitor`` tx bookkeeping,
+``HGIndexStats`` planner estimates) with no unified surface. SURVEY §5
+asks for structured metrics from day one: ingest rate, frontier sizes,
+kernel timings, query latencies — one registry, one ``snapshot()`` dump.
+
+Thread-safe; cheap enough to stay on in production (a dict update and a
+perf_counter per event)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.counters: dict[str, int] = {}
+        self.gauges: dict[str, float] = {}
+        # name -> (count, total_seconds, max_seconds)
+        self.timings: dict[str, tuple[int, float, float]] = {}
+
+    # -- primitives ----------------------------------------------------------
+    def incr(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self.gauges[name] = float(value)
+
+    def observe(self, name: str, seconds: float) -> None:
+        with self._lock:
+            cnt, tot, mx = self.timings.get(name, (0, 0.0, 0.0))
+            self.timings[name] = (cnt + 1, tot + seconds, max(mx, seconds))
+
+    @contextmanager
+    def timer(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(name, time.perf_counter() - t0)
+
+    # -- reporting -----------------------------------------------------------
+    def snapshot(self) -> dict:
+        """One structured dump: counters, gauges, and per-timer
+        count/total/mean/max (seconds)."""
+        with self._lock:
+            return {
+                "counters": dict(self.counters),
+                "gauges": dict(self.gauges),
+                "timings": {
+                    k: {
+                        "count": c,
+                        "total_s": t,
+                        "mean_s": (t / c if c else 0.0),
+                        "max_s": m,
+                    }
+                    for k, (c, t, m) in self.timings.items()
+                },
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self.counters.clear()
+            self.gauges.clear()
+            self.timings.clear()
+
+
+#: process-wide registry for code without a graph in reach (kernel wrappers)
+global_metrics = Metrics()

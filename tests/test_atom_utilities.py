@@ -1,0 +1,273 @@
+"""Atom utilities (refs, Berge links, relations, subsumption) and
+resumable maintenance operations."""
+
+import pytest
+
+import hypergraphdb_tpu as hg
+from hypergraphdb_tpu.atom.utilities import (
+    HARD,
+    SYMBOLIC,
+    BergeValue,
+    HGAtomRef,
+    HGBergeLink,
+    RelTypeValue,
+    add_rel,
+    declare_subsumes,
+    define_rel_type,
+    install_ref_maintenance,
+    load_subsumptions,
+)
+from hypergraphdb_tpu.query import dsl as q
+
+
+# ---------------------------------------------------------------- atom refs
+
+
+def test_hard_ref_pins_referent(graph):
+    install_ref_maintenance(graph)
+    target = graph.add("pinned")
+    ref_holder = graph.add(HGAtomRef(int(target), HARD))
+    # removal vetoed while a hard ref exists
+    assert graph.remove(int(target)) is False
+    assert graph.contains(int(target))
+    # dropping the referrer releases the pin
+    assert graph.remove(int(ref_holder))
+    assert graph.remove(int(target))
+
+
+def test_symbolic_ref_dangles(graph):
+    install_ref_maintenance(graph)
+    target = graph.add("temp")
+    holder = graph.add(HGAtomRef(int(target), SYMBOLIC))
+    assert graph.remove(int(target))  # not pinned
+    ref = graph.get(int(holder))
+    assert ref.deref(graph) is None  # dangling resolves to None
+
+
+def test_hard_ref_to_missing_atom_rejected(graph):
+    install_ref_maintenance(graph)
+    with pytest.raises(hg.HGException):
+        graph.add(HGAtomRef(999_999, HARD))
+
+
+# ---------------------------------------------------------------- berge links
+
+
+def test_berge_link_head_tail(graph):
+    a, b, c, d = (graph.add(x) for x in "abcd")
+    bl = HGBergeLink.add(graph, head=[a, b], tail=[c, d], payload="flow")
+    assert bl.head == (int(a), int(b))
+    assert bl.tail == (int(c), int(d))
+    assert bl.payload == "flow"
+    # it is an ordinary link to the device plane
+    assert graph.arity(bl.handle) == 4
+    assert int(bl.handle) in graph.get_incidence_set(a).array().tolist()
+
+
+# ---------------------------------------------------------------- relations
+
+
+def test_rel_type_and_instances(graph):
+    works_at = define_rel_type(graph, "works-at", 2)
+    alice = graph.add("alice")
+    acme = graph.add("acme")
+    r = add_rel(graph, works_at, int(alice), int(acme))
+    assert graph.get_targets(r) == (int(alice), int(acme))
+    # arity enforced
+    with pytest.raises(hg.HGException):
+        add_rel(graph, works_at, int(alice))
+    # rel type is found, not duplicated
+    assert int(define_rel_type(graph, "works-at", 2)) == int(works_at)
+
+
+# ---------------------------------------------------------------- subsumption
+
+
+def test_subsumes_persisted_and_reloaded(graph):
+    from dataclasses import dataclass
+
+    @dataclass(frozen=True)
+    class Animal:
+        name: str = ""
+
+    @dataclass(frozen=True)
+    class Dog:
+        name: str = ""
+
+    graph.add(Animal("generic"))
+    rex = graph.add(Dog("rex"))
+    at = graph.typesystem.infer(Animal("x")).name
+    dt = graph.typesystem.infer(Dog("x")).name
+    declare_subsumes(graph, at, dt)
+
+    # TypePlus(Animal) now reaches Dog atoms
+    res = q.find_all(graph, q.type_plus(at)) if hasattr(q, "type_plus") else None
+    if res is not None:
+        assert int(rex) in res
+
+    # wipe the in-memory subsumption map, reload from persisted links
+    graph.typesystem._supertypes.clear()
+    assert load_subsumptions(graph) == 1
+    assert dt in graph.typesystem.subtypes_closure(at)
+
+
+# ---------------------------------------------------------------- maintenance
+
+
+def test_apply_new_indexer_resumable(graph):
+    from dataclasses import dataclass
+
+    from hypergraphdb_tpu.indexing.manager import ByPartIndexer, get_index, register
+    from hypergraphdb_tpu.maintenance import ApplyNewIndexer, run_pending, schedule
+
+    @dataclass(frozen=True)
+    class Person:
+        name: str = ""
+        age: int = 0
+
+    people = [graph.add(Person(f"p{i}", i)) for i in range(25)]
+    th = graph.typesystem.handle_of(graph.typesystem.infer(Person("x")).name)
+
+    # register WITHOUT populating; schedule the offline batch build
+    ix = ByPartIndexer("person-by-name", int(th), "name")
+    register(graph, ix, populate=False)
+    op = ApplyNewIndexer(indexer_name="person-by-name", type_handle=int(th),
+                         batch_size=7)
+    oph = schedule(graph, op)
+
+    # run TWO batches (bound capture + one real batch), then "crash": the
+    # cursor is persisted in the op atom
+    cur = graph.get(oph)
+    cur = getattr(cur, "value", cur)
+    nxt = cur.execute_batch(graph)       # captures the frozen scan bound
+    assert nxt.end_bound > 0
+    nxt = nxt.execute_batch(graph)       # first real batch
+    graph.replace(oph, nxt)
+    assert nxt.last_processed >= 0
+
+    # resume to completion
+    assert run_pending(graph) == 1
+    idx = get_index(graph, "person-by-name")
+    pt = graph.typesystem.infer("p3")
+    assert int(people[3]) in idx.find(pt.to_key("p3")).array().tolist()
+    # and no duplicate entries for already-processed prefix atoms
+    assert len(idx.find(pt.to_key("p3"))) == 1
+
+
+# ---------------------------------------------------------------- metrics
+
+
+def test_metrics_surface(graph):
+    graph.add("m1")
+    graph.add("m2")
+    graph.snapshot()
+    graph.find_all(q.value("m1"))
+    snap = graph.metrics.snapshot()
+    assert snap["counters"]["graph.mutations"] >= 2
+    assert snap["counters"]["query.executed"] >= 1
+    assert snap["timings"]["snapshot.pack"]["count"] >= 1
+    assert snap["gauges"]["snapshot.num_atoms"] > 0
+
+
+def test_query_analyze_plan_dump(graph):
+    graph.add("x")
+    from hypergraphdb_tpu.query.compiler import compile_query
+
+    cq = compile_query(graph, q.and_(q.type_("string"), q.incident(0)))
+    text = cq.analyze()
+    assert "condition:" in text and "plan:" in text
+
+
+# ------------------------------------------- review regressions (round 3)
+
+
+def test_invalid_hard_ref_not_persisted(graph):
+    """Validation runs pre-write: a rejected add leaves nothing behind."""
+    install_ref_maintenance(graph)
+    before = graph.atom_count()
+    with pytest.raises(hg.HGException):
+        graph.add(HGAtomRef(999_999, HARD))
+    assert graph.atom_count() == before
+
+
+def test_cascade_remove_respects_pin(graph):
+    """Cascade removal must not delete a pinned incident link."""
+    install_ref_maintenance(graph)
+    n = graph.add("node")
+    other = graph.add("other")
+    l = graph.add_link((n, other), value="pinned-link")
+    graph.add(HGAtomRef(int(l), HARD))
+    # removing n would cascade to l, which is pinned → whole remove aborts
+    with pytest.raises(hg.HGException):
+        graph.remove(int(n))
+    assert graph.contains(int(l)) and graph.contains(int(n))
+
+
+def test_replace_maintains_pins(graph):
+    install_ref_maintenance(graph)
+    t1 = graph.add("t1")
+    t2 = graph.add("t2")
+    holder = graph.add(HGAtomRef(int(t1), HARD))
+    graph.replace(int(holder), HGAtomRef(int(t2), HARD))
+    # old pin released, new pin active
+    assert graph.remove(int(t1)) is True
+    assert graph.remove(int(t2)) is False
+    # replacing away the ref releases the pin entirely
+    graph.replace(int(holder), "plain")
+    assert graph.remove(int(t2)) is True
+
+
+def test_offline_indexer_covers_subtypes(graph):
+    from dataclasses import dataclass
+
+    from hypergraphdb_tpu.indexing.manager import ByPartIndexer, get_index, register
+    from hypergraphdb_tpu.maintenance import ApplyNewIndexer, run_pending, schedule
+
+    @dataclass(frozen=True)
+    class Animal2:
+        name: str = ""
+
+    @dataclass(frozen=True)
+    class Dog2:
+        name: str = ""
+
+    graph.add(Animal2("generic"))
+    rex = graph.add(Dog2("rex"))
+    at = graph.typesystem.infer(Animal2("x")).name
+    dt = graph.typesystem.infer(Dog2("x")).name
+    declare_subsumes(graph, at, dt)
+
+    th = graph.typesystem.handle_of(at)
+    register(graph, ByPartIndexer("animal-by-name", int(th), "name"),
+             populate=False)
+    schedule(graph, ApplyNewIndexer(indexer_name="animal-by-name",
+                                    type_handle=int(th), batch_size=50))
+    assert run_pending(graph) == 1
+    idx = get_index(graph, "animal-by-name")
+    kt = graph.typesystem.infer("rex")
+    assert int(rex) in idx.find(kt.to_key("rex")).array().tolist()
+
+
+def test_rel_type_not_confused_by_lookalike(graph):
+    from dataclasses import dataclass
+
+    @dataclass(frozen=True)
+    class WidgetSpec:
+        name: str = ""
+        arity: int = 0
+
+    graph.add(WidgetSpec(name="ships-to", arity=2))
+    rt = define_rel_type(graph, "ships-to", 2)
+    v = graph.get(int(rt))
+    v = getattr(v, "value", v)
+    assert isinstance(v, RelTypeValue)
+
+
+def test_run_pending_skips_unregistered_indexer(graph):
+    """A pending op whose indexer isn't registered this session defers,
+    without aborting other pending operations."""
+    from hypergraphdb_tpu.maintenance import ApplyNewIndexer, run_pending, schedule
+
+    schedule(graph, ApplyNewIndexer(indexer_name="ghost-indexer",
+                                    type_handle=1, batch_size=10))
+    assert run_pending(graph) == 0  # deferred, not crashed
