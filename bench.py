@@ -18,24 +18,14 @@ import numpy as np
 
 def build_graph(n_nodes: int = 80_000, n_links: int = 40_000, seed: int = 7):
     """Synthetic WordNet-shaped hypergraph: ~120K atoms, skewed-degree
-    links of arity 2-5 (WordNet relations are mostly binary with some
-    higher-arity frames)."""
+    links of arity 2-5 (see ``models/generators.py``)."""
     from hypergraphdb_tpu import HyperGraph
+    from hypergraphdb_tpu.models import zipf_hypergraph
 
     g = HyperGraph()
-    r = np.random.default_rng(seed)
-    nodes = g.add_nodes_bulk(np.arange(n_nodes).tolist())
-    node0 = nodes[0]
-    # zipf-ish hub structure like lexical graphs
-    popularity = r.zipf(1.3, size=n_links * 6) % n_nodes
-    arities = r.integers(2, 6, size=n_links)
-    target_lists = []
-    k = 0
-    for a in arities:
-        ts = popularity[k : k + a]
-        k += a
-        target_lists.append([int(node0 + t) for t in ts])
-    g.add_links_bulk(target_lists, values=list(range(n_links)))
+    nodes, _ = zipf_hypergraph(
+        g, n_nodes=n_nodes, n_links=n_links, max_arity=5, seed=seed
+    )
     return g, nodes
 
 

@@ -106,6 +106,8 @@ class HGEventManager:
         self._listeners.clear()
 
     def dispatch(self, graph: Any, event: HGEvent) -> int:
+        if not self._listeners:  # bulk-ingest fast path: no subscribers
+            return HGListener.CONTINUE
         for cls in type(event).__mro__:
             if not (isinstance(cls, type) and issubclass(cls, HGEvent)):
                 continue
@@ -113,3 +115,15 @@ class HGEventManager:
                 if l(graph, event) == HGListener.CANCEL:
                     return HGListener.CANCEL
         return HGListener.CONTINUE
+
+    def has_listeners_for(self, event_class: type) -> bool:
+        """True if any listener would see an event of this class — lets hot
+        paths skip constructing per-atom events entirely."""
+        if not self._listeners:
+            return False
+        # dispatch walks event_class.__mro__, so a listener sees the event
+        # iff it subscribed to event_class or one of its superclasses
+        return any(
+            issubclass(event_class, cls) and self._listeners[cls]
+            for cls in self._listeners
+        )

@@ -416,12 +416,22 @@ class HyperGraph:
             else keep_incident_links
         )
 
+        removed: set[int] = set()
+
         def run() -> None:
-            self._remove_rec(h, keep, set())
+            removed.clear()  # retry-safe
+            self._remove_rec(h, keep, removed)
 
         self.txman.ensure_transaction(run)
-        self._after_commit(lambda: self._committed_mutation(
-            ev.HGAtomRemovedEvent(h)))
+
+        def fire() -> None:
+            # one event per removed atom (cascade included) — delta overlays
+            # and replication listeners need to see every tombstone
+            self._committed_mutation(ev.HGAtomRemovedEvent(h))
+            for other in removed - {h}:
+                self._committed_mutation(ev.HGAtomRemovedEvent(other))
+
+        self._after_commit(fire)
         return True
 
     def _remove_rec(self, h: int, keep: bool, seen: set[int],
@@ -571,7 +581,16 @@ class HyperGraph:
             return r
 
         r = self.txman.ensure_transaction(run)
-        self._mutations += len(values)
+
+        def fire() -> None:
+            if self.events.has_listeners_for(ev.HGAtomAddedEvent):
+                for h, v in zip(r, values):
+                    self._committed_mutation(ev.HGAtomAddedEvent(h, v))
+            else:  # bulk fast path: one counter bump, no per-atom events
+                self._mutations += len(values)
+                self.metrics.incr("graph.mutations", len(values))
+
+        self._after_commit(fire)
         return r
 
     def add_links_bulk(
@@ -589,7 +608,17 @@ class HyperGraph:
             return r
 
         r = self.txman.ensure_transaction(run)
-        self._mutations += len(target_lists)
+
+        def fire() -> None:
+            if self.events.has_listeners_for(ev.HGAtomAddedEvent):
+                for i, h in enumerate(r):
+                    v = values[i] if values is not None else None
+                    self._committed_mutation(ev.HGAtomAddedEvent(h, v))
+            else:  # bulk fast path: one counter bump, no per-atom events
+                self._mutations += len(target_lists)
+                self.metrics.incr("graph.mutations", len(target_lists))
+
+        self._after_commit(fire)
         return r
 
     # ------------------------------------------------------------------ device snapshot

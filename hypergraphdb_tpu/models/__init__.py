@@ -1,0 +1,19 @@
+"""Graph model families: generators for the workload shapes the framework
+is benchmarked on (lexical/WordNet-like, encyclopedic/DBpedia-like,
+zipf-skewed synthetic hypergraphs) — BASELINE configs 1-5."""
+
+from hypergraphdb_tpu.models.generators import (
+    Entity,
+    Synset,
+    dbpedia_like,
+    wordnet_like,
+    zipf_hypergraph,
+)
+
+__all__ = [
+    "Entity",
+    "Synset",
+    "dbpedia_like",
+    "wordnet_like",
+    "zipf_hypergraph",
+]

@@ -1,0 +1,114 @@
+"""Benchmark / example graph model families.
+
+The reference validates against lexical (WordNet-style) and encyclopedic
+(DBpedia-style) hypergraphs (BASELINE configs 1-5). These generators build
+synthetic graphs with the same shape characteristics — zipf-skewed hub
+degrees, mostly-binary relations with a higher-arity tail — entirely
+through the public ingest API, so they double as ingest benchmarks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class Synset:
+    """WordNet-style node payload."""
+
+    lemma: str = ""
+    pos: str = "n"
+
+
+@dataclass(frozen=True)
+class Entity:
+    """DBpedia-style node payload."""
+
+    uri: str = ""
+
+
+def zipf_hypergraph(graph, n_nodes: int = 10_000, n_links: int = 5_000,
+                    max_arity: int = 5, zipf_a: float = 1.3, seed: int = 7,
+                    values: bool = True):
+    """Skewed-degree hypergraph (the shape of lexical graphs): returns
+    (node_handles, link_handles)."""
+    r = np.random.default_rng(seed)
+    nodes = graph.add_nodes_bulk(np.arange(n_nodes).tolist())
+    node0 = int(nodes[0])
+    popularity = r.zipf(zipf_a, size=n_links * (max_arity + 1)) % n_nodes
+    arities = r.integers(2, max_arity + 1, size=n_links)
+    target_lists = []
+    k = 0
+    for a in arities:
+        ts = popularity[k : k + a]
+        k += a
+        target_lists.append([node0 + int(t) for t in ts])
+    links = graph.add_links_bulk(
+        target_lists, values=list(range(n_links)) if values else None
+    )
+    return nodes, links
+
+
+#: WordNet relation inventory (name, approximate share of links)
+WORDNET_RELS = (
+    ("hypernym", 0.40),
+    ("hyponym", 0.25),
+    ("meronym", 0.12),
+    ("holonym", 0.08),
+    ("antonym", 0.05),
+    ("entailment", 0.05),
+    ("similar-to", 0.05),
+)
+
+
+def wordnet_like(graph, n_synsets: int = 20_000, n_relations: int = 40_000,
+                 seed: int = 11):
+    """WordNet-shaped typed graph: ``Synset`` nodes + binary relation links
+    whose VALUE is the relation name (so typed-incident queries exercise
+    the by-value/by-type paths). Returns (synset_handles, rel_handles)."""
+    r = np.random.default_rng(seed)
+    poses = np.array(["n", "v", "a", "r"])
+    synsets = graph.add_nodes_bulk([
+        Synset(f"lemma{i}", str(poses[i % 4])) for i in range(n_synsets)
+    ])
+    s0 = int(synsets[0])
+    names = [n for n, _ in WORDNET_RELS]
+    probs = np.array([p for _, p in WORDNET_RELS])
+    probs = probs / probs.sum()
+    rel_names = r.choice(names, size=n_relations, p=probs)
+    # hypernym chains give depth; the rest are zipf-skewed
+    src = r.zipf(1.2, size=n_relations) % n_synsets
+    dst = (src + r.integers(1, max(2, n_synsets // 10),
+                            size=n_relations)) % n_synsets
+    targets = [[s0 + int(a), s0 + int(b)] for a, b in zip(src, dst)]
+    rels = graph.add_links_bulk(targets, values=[str(n) for n in rel_names])
+    return synsets, rels
+
+
+def dbpedia_like(graph, n_entities: int = 100_000, n_triples: int = 500_000,
+                 n_properties: int = 64, seed: int = 13, batch: int = 100_000):
+    """DBpedia-shaped graph at configurable scale: ``Entity`` nodes and
+    property links (value = property id). Ingests in batches so 10M-atom
+    builds stream. Returns (entity_handles, first_link_handle)."""
+    r = np.random.default_rng(seed)
+    entities = graph.add_nodes_bulk(
+        [Entity(f"e/{i}") for i in range(n_entities)]
+    )
+    e0 = int(entities[0])
+    first_link = None
+    remaining = n_triples
+    while remaining > 0:
+        m = min(batch, remaining)
+        remaining -= m
+        subj = r.zipf(1.1, size=m) % n_entities
+        obj = r.integers(0, n_entities, size=m)
+        props = r.integers(0, n_properties, size=m)
+        links = graph.add_links_bulk(
+            [[e0 + int(a), e0 + int(b)] for a, b in zip(subj, obj)],
+            values=[int(p) for p in props],
+        )
+        if first_link is None:
+            first_link = int(links[0])
+    return entities, first_link

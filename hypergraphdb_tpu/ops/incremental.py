@@ -1,0 +1,234 @@
+"""Incremental CSR re-pack: delta overlays over a base snapshot.
+
+SURVEY §7 hard part 2 / BASELINE config 5: concurrent ingest must not stall
+queries. A full CSR re-pack is O(graph); here mutation deltas accumulate in
+fixed-shape **overlay buffers** that compose with the base snapshot inside
+the kernels:
+
+- the base is packed with id-space **headroom** (``capacity``), so new
+  atoms keep fitting the existing frontier bitmap width — no recompiles;
+- added edges collect into COO delta arrays, padded to power-of-two
+  buckets (bounded recompile count as the delta grows);
+- removals set a **tombstone mask**; base edges into dead atoms are
+  neutralized by clearing dead bits after every hop;
+- when the delta outgrows ``compact_ratio`` × base (or headroom runs out),
+  ``refresh`` performs a full re-pack — the periodic compaction.
+
+The reference's analogue is MVCC read snapshots over B-trees (readers never
+stall, ``transaction/``); here a device snapshot is the long-lived read
+transaction and the delta keeps it fresh between compactions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hypergraphdb_tpu.core import events as ev
+from hypergraphdb_tpu.ops.frontier import expand_frontier
+from hypergraphdb_tpu.ops.setops import _bucket
+from hypergraphdb_tpu.ops.snapshot import CSRSnapshot, DeviceSnapshot, _pad_to
+
+
+@dataclass
+class DeviceDelta:
+    """Fixed-shape overlay: COO edge additions + tombstone mask. Padded
+    entries point at the dummy row (base.num_atoms)."""
+
+    inc_links: jax.Array  # (D_inc,)
+    inc_src: jax.Array    # (D_inc,)
+    tgt_flat: jax.Array   # (D_tgt,)
+    tgt_src: jax.Array    # (D_tgt,)
+    dead: jax.Array       # (N+1,) bool tombstones
+
+
+def _register_pytree() -> None:
+    jax.tree_util.register_pytree_node(
+        DeviceDelta,
+        lambda d: ((d.inc_links, d.inc_src, d.tgt_flat, d.tgt_src, d.dead),
+                   None),
+        lambda aux, ch: DeviceDelta(*ch),
+    )
+
+
+_register_pytree()
+
+
+def expand_frontier_delta(
+    dev: DeviceSnapshot, delta: DeviceDelta, frontier: jax.Array
+) -> jax.Array:
+    """One hop over base ∪ delta, minus tombstoned atoms."""
+
+    def one(f):
+        # base relation
+        la = jnp.zeros_like(f).at[dev.inc_links].max(f[dev.inc_src])
+        # delta atom→link edges
+        la = la.at[delta.inc_links].max(f[delta.inc_src])
+        la = la & ~delta.dead  # dead links emit nothing
+        nb = jnp.zeros_like(f).at[dev.tgt_flat].max(la[dev.tgt_src])
+        nb = nb.at[delta.tgt_flat].max(la[delta.tgt_src])
+        nb = nb & ~delta.dead
+        return nb.at[dev.num_atoms].set(False)
+
+    if frontier.ndim == 1:
+        return one(frontier)
+    return jax.vmap(one)(frontier)
+
+
+@partial(jax.jit, static_argnames=("max_hops",))
+def bfs_levels_delta(
+    dev: DeviceSnapshot, delta: DeviceDelta, seeds: jax.Array, max_hops: int
+) -> tuple[jax.Array, jax.Array]:
+    """Batched BFS over base ∪ delta (same contract as ``bfs_levels``)."""
+    K = seeds.shape[0]
+    n1 = dev.type_of.shape[0]
+    frontier = (
+        jnp.zeros((K, n1), dtype=bool).at[jnp.arange(K), seeds].set(True)
+        & ~delta.dead[None, :]
+    )
+    visited = frontier
+    levels = jnp.where(frontier, 0, -1).astype(jnp.int32)
+
+    def body(i, state):
+        frontier, visited, levels = state
+        nxt = expand_frontier_delta(dev, delta, frontier) & ~visited
+        levels = jnp.where(nxt, i + 1, levels)
+        return nxt, visited | nxt, levels
+
+    frontier, visited, levels = jax.lax.fori_loop(
+        0, max_hops, body, (frontier, visited, levels)
+    )
+    return levels, visited
+
+
+class SnapshotManager:
+    """Owns the (base, delta) pair for one graph: listens to mutation
+    events, accumulates host-side delta buffers, re-uploads the (bucketed)
+    device delta when asked, and compacts when the delta outgrows the base.
+
+    Usage::
+
+        mgr = SnapshotManager(graph, headroom=2.0)
+        dev, delta = mgr.device()         # always-fresh pair for kernels
+        levels, visited = bfs_levels_delta(dev, delta, seeds, 3)
+    """
+
+    def __init__(self, graph, headroom: float = 2.0, compact_ratio: float = 0.5):
+        self.graph = graph
+        self.headroom = headroom
+        self.compact_ratio = compact_ratio
+        self.base: Optional[CSRSnapshot] = None
+        self._capacity = 0
+        # host delta buffers
+        self._inc_links: list[int] = []
+        self._inc_src: list[int] = []
+        self._tgt_flat: list[int] = []
+        self._tgt_src: list[int] = []
+        self._dead: set[int] = set()
+        self._delta_dirty = True
+        self._device_delta: Optional[DeviceDelta] = None
+        self.compactions = 0
+        self._pack_highwater = 0
+        graph.events.add_listener(ev.HGAtomAddedEvent, self._on_added)
+        graph.events.add_listener(ev.HGAtomRemovedEvent, self._on_removed)
+        self._compact()
+
+    def close(self) -> None:
+        """Detach from the graph's event stream (managers are long-lived;
+        an undetached manager would keep accumulating deltas forever)."""
+        self.graph.events.remove_listener(ev.HGAtomAddedEvent, self._on_added)
+        self.graph.events.remove_listener(
+            ev.HGAtomRemovedEvent, self._on_removed
+        )
+
+    # -- event intake ---------------------------------------------------------
+    def _on_added(self, g, event) -> None:
+        h = int(event.handle)
+        if h < self._pack_highwater:
+            # already inside the base: a mid-batch compaction packed the
+            # whole committed batch, the remaining events are echoes
+            return
+        if h >= self._capacity:
+            self._compact()
+            return
+        rec = g.store.get_link(h)
+        if rec is None:
+            return
+        targets = rec[3:]
+        for t in targets:
+            if t >= self._capacity:
+                self._compact()
+                return
+        for t in targets:
+            # incidence edge (t ← h) + target edge (h → t)
+            self._inc_links.append(h)
+            self._inc_src.append(int(t))
+            self._tgt_flat.append(int(t))
+            self._tgt_src.append(h)
+        self._dead.discard(h)
+        self._delta_dirty = True
+
+    def _on_removed(self, g, event) -> None:
+        h = int(event.handle)
+        if h < self._capacity:
+            self._dead.add(h)
+            self._delta_dirty = True
+        else:
+            self._compact()
+
+    # -- compaction -----------------------------------------------------------
+    def _compact(self) -> None:
+        g = self.graph
+        cap = max(int(g.handles.peek * self.headroom), 1024)
+        self._pack_highwater = int(g.handles.peek)
+        self.base = CSRSnapshot.pack(g, version=g._mutations, capacity=cap)
+        self._capacity = self.base.num_atoms
+        self._inc_links.clear()
+        self._inc_src.clear()
+        self._tgt_flat.clear()
+        self._tgt_src.clear()
+        self._dead.clear()
+        self._delta_dirty = True
+        self.compactions += 1
+
+    def _maybe_compact(self) -> None:
+        base_edges = max(self.base.n_edges_inc, 1)
+        if len(self._inc_links) > self.compact_ratio * base_edges + 4096:
+            self._compact()
+
+    # -- device views ----------------------------------------------------------
+    def device(self) -> tuple[DeviceSnapshot, DeviceDelta]:
+        """The current (base, delta) device pair; cheap when unchanged."""
+        self._maybe_compact()
+        dev = self.base.device
+        if self._delta_dirty or self._device_delta is None:
+            N = self.base.num_atoms
+            n1 = N + 1
+
+            def up(xs, fill):
+                a = np.asarray(xs, dtype=np.int32)
+                return jnp.asarray(
+                    _pad_to(a, _bucket(max(len(a), 1)), fill)
+                )
+
+            dead = np.zeros(n1, dtype=bool)
+            if self._dead:
+                dead[np.fromiter(self._dead, dtype=np.int64)] = True
+            self._device_delta = DeviceDelta(
+                inc_links=up(self._inc_links, N),
+                inc_src=up(self._inc_src, N),
+                tgt_flat=up(self._tgt_flat, N),
+                tgt_src=up(self._tgt_src, N),
+                dead=jnp.asarray(dead),
+            )
+            self._delta_dirty = False
+        return dev, self._device_delta
+
+    @property
+    def delta_edges(self) -> int:
+        return len(self._inc_links)

@@ -92,16 +92,23 @@ class CSRSnapshot:
 
     # ------------------------------------------------------------------ pack
     @staticmethod
-    def pack(graph, version: Optional[int] = None, pad_multiple: int = 128
-             ) -> "CSRSnapshot":
+    def pack(graph, version: Optional[int] = None, pad_multiple: int = 128,
+             capacity: Optional[int] = None) -> "CSRSnapshot":
         """Pack the committed store into CSR arrays (the ``storage/tpu-jax``
-        snapshot step from BASELINE.json's north star)."""
+        snapshot step from BASELINE.json's north star).
+
+        ``capacity`` over-allocates the id space so atoms added AFTER the
+        pack still fit in this snapshot's bitmap width — the prerequisite
+        for delta overlays (``ops/incremental.py``): base and delta share
+        one frontier shape, so no recompilation on ingest."""
         backend = graph.backend
         ids, offsets, flat = backend.bulk_links()
         n = int(graph.handles.peek) if hasattr(graph.handles, "peek") else (
             int(ids.max()) + 1 if len(ids) else 0
         )
         n = max(n, int(backend.max_handle()))
+        if capacity is not None:
+            n = max(n, int(capacity))
         N = n  # id space; dummy row is N
 
         type_of = np.full(N + 1, -1, dtype=np.int32)

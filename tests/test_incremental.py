@@ -1,0 +1,132 @@
+"""Incremental CSR re-pack (delta overlays): BASELINE config 5 semantics.
+
+Differential tests: BFS over (base ∪ delta) must equal BFS over a full
+re-pack at every point in a streaming ingest/remove workload."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from hypergraphdb_tpu.ops.frontier import bfs_levels
+from hypergraphdb_tpu.ops.incremental import SnapshotManager, bfs_levels_delta
+from hypergraphdb_tpu.ops.snapshot import CSRSnapshot
+
+from conftest import make_random_hypergraph
+
+
+def _bfs_sets(dev, delta, snap_full, seeds, hops):
+    """(delta-path visited, full-repack visited) as numpy bool arrays,
+    trimmed of padding differences."""
+    lv_d, vis_d = bfs_levels_delta(dev, delta, jnp.asarray(seeds), hops)
+    lv_f, vis_f = bfs_levels(snap_full.device, jnp.asarray(seeds), hops)
+    vd = np.asarray(vis_d)
+    vf = np.asarray(vis_f)
+    out_d, out_f = [], []
+    for i in range(len(seeds)):
+        out_d.append(set(np.nonzero(vd[i])[0].tolist()) - {dev.num_atoms})
+        out_f.append(set(np.nonzero(vf[i])[0].tolist()) - {snap_full.num_atoms})
+    return out_d, out_f
+
+
+def test_delta_matches_full_repack_on_ingest(graph):
+    nodes, links = make_random_hypergraph(graph, n_nodes=80, n_links=120, seed=9)
+    mgr = SnapshotManager(graph, headroom=3.0)
+    base_version = mgr.base.version
+
+    # stream in new structure AFTER the base pack
+    new_nodes = list(graph.add_nodes_bulk([f"x{i}" for i in range(30)]))
+    r = np.random.default_rng(1)
+    for i in range(60):
+        a = int(r.choice(nodes))
+        b = int(r.choice(new_nodes))
+        graph.add_link([a, b], value=1000 + i)
+
+    dev, delta = mgr.device()
+    assert mgr.base.version == base_version, "ingest must NOT force a repack"
+    assert mgr.delta_edges > 0
+
+    seeds = np.asarray([int(nodes[0]), int(new_nodes[0])], dtype=np.int32)
+    snap_full = CSRSnapshot.pack(graph, capacity=dev.num_atoms)
+    got, want = _bfs_sets(dev, delta, snap_full, seeds, hops=3)
+    assert got == want
+
+
+def test_delta_handles_removals(graph):
+    a = graph.add("a")
+    b = graph.add("b")
+    c = graph.add("c")
+    l1 = graph.add_link((a, b))
+    l2 = graph.add_link((b, c))
+    mgr = SnapshotManager(graph, headroom=3.0)
+
+    graph.remove(int(l2))  # now a--b only
+    dev, delta = mgr.device()
+    seeds = np.asarray([int(a)], dtype=np.int32)
+    snap_full = CSRSnapshot.pack(graph, capacity=dev.num_atoms)
+    got, want = _bfs_sets(dev, delta, snap_full, seeds, hops=4)
+    assert got == want
+    assert int(c) not in got[0]
+
+
+def test_cascade_removal_tombstones_links(graph):
+    """Removing an atom cascade-removes incident links; the delta must
+    tombstone those links too (they get their own removed events)."""
+    a = graph.add("a")
+    b = graph.add("b")
+    c = graph.add("c")
+    graph.add_link((a, b))
+    lbc = graph.add_link((b, c))
+    mgr = SnapshotManager(graph, headroom=3.0)
+
+    graph.remove(int(b))  # cascades to both links
+    dev, delta = mgr.device()
+    assert bool(np.asarray(delta.dead)[int(lbc)])
+    seeds = np.asarray([int(a)], dtype=np.int32)
+    snap_full = CSRSnapshot.pack(graph, capacity=dev.num_atoms)
+    got, want = _bfs_sets(dev, delta, snap_full, seeds, hops=4)
+    assert got == want
+    assert got[0] == {int(a)}  # nothing reachable anymore
+
+
+def test_compaction_on_headroom_exhaustion(graph):
+    graph.add("seed")
+    mgr = SnapshotManager(graph, headroom=1.05)
+    before = mgr.compactions
+    # overflow the tiny headroom
+    graph.add_nodes_bulk([f"n{i}" for i in range(5000)])
+    dev, delta = mgr.device()
+    assert mgr.compactions > before
+    # post-compaction the delta is empty and the base covers everything
+    assert mgr.delta_edges == 0
+    assert dev.num_atoms >= 5000
+
+
+def test_compaction_on_delta_ratio(graph):
+    nodes, _ = make_random_hypergraph(graph, n_nodes=50, n_links=20, seed=2)
+    mgr = SnapshotManager(graph, headroom=50.0, compact_ratio=0.0)
+    mgr._maybe_compact()
+    before = mgr.compactions
+    r = np.random.default_rng(3)
+    for i in range(5000):
+        ts = r.choice(nodes, size=2, replace=False)
+        graph.add_link([int(t) for t in ts], value=i)
+    mgr.device()
+    assert mgr.compactions > before
+
+
+# ---------------------------------------------------------------- model families
+
+
+def test_model_generators(graph):
+    from hypergraphdb_tpu.models import Synset, wordnet_like, zipf_hypergraph
+    from hypergraphdb_tpu.query import dsl as q
+
+    nodes, links = zipf_hypergraph(graph, n_nodes=200, n_links=100, seed=1)
+    assert len(nodes) == 200 and len(links) == 100
+    assert graph.arity(int(links[0])) >= 2
+
+    syn, rels = wordnet_like(graph, n_synsets=100, n_relations=150, seed=2)
+    st = graph.typesystem.infer(Synset()).name
+    assert len(q.find_all(graph, q.type_(st))) == 100
+    # relations are value-typed links: typed-value queries work
+    hyper = q.find_all(graph, q.value("hypernym"))
+    assert all(graph.is_link(h) for h in hyper)
