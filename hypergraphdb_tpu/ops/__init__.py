@@ -1,0 +1,27 @@
+"""Device plane: CSR snapshots, frontier/set kernels, incremental overlays,
+Pallas kernels, and snapshot checkpointing (SURVEY §7 device design)."""
+
+from hypergraphdb_tpu.ops.snapshot import CSRSnapshot, DeviceSnapshot
+from hypergraphdb_tpu.ops.frontier import bfs_levels, expand_frontier
+from hypergraphdb_tpu.ops.incremental import SnapshotManager, bfs_levels_delta
+from hypergraphdb_tpu.ops.checkpoint import (
+    copy_subgraph,
+    export_graph,
+    import_graph,
+    load_snapshot,
+    save_snapshot,
+)
+
+__all__ = [
+    "CSRSnapshot",
+    "DeviceSnapshot",
+    "SnapshotManager",
+    "bfs_levels",
+    "bfs_levels_delta",
+    "copy_subgraph",
+    "expand_frontier",
+    "export_graph",
+    "import_graph",
+    "load_snapshot",
+    "save_snapshot",
+]

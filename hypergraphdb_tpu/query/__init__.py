@@ -1,0 +1,17 @@
+"""Query engine: condition vocabulary, compiler, DSL, serialization,
+parameterized queries (SURVEY §2.1 "Query conditions/compiler/executors")."""
+
+from hypergraphdb_tpu.query import conditions, dsl
+from hypergraphdb_tpu.query.compiler import CompiledQuery, compile_query
+from hypergraphdb_tpu.query.variables import PreparedQuery, Var, prepare, var
+
+__all__ = [
+    "CompiledQuery",
+    "PreparedQuery",
+    "Var",
+    "compile_query",
+    "conditions",
+    "dsl",
+    "prepare",
+    "var",
+]

@@ -34,6 +34,15 @@ _OPS = {
 }
 
 
+def _coerce_handle(t):
+    """int-coerce a target handle, letting non-integer placeholders (query
+    Vars, bound later by ``variables.substitute``) pass through."""
+    try:
+        return int(t)
+    except (TypeError, ValueError):
+        return t
+
+
 class HGQueryCondition:
     """Base class; every condition is also an atom predicate."""
 
@@ -261,7 +270,7 @@ class Link(HGQueryCondition):
     targets: tuple[HGHandle, ...]
 
     def __init__(self, *targets: HGHandle):
-        object.__setattr__(self, "targets", tuple(int(t) for t in targets))
+        object.__setattr__(self, "targets", tuple(_coerce_handle(t) for t in targets))
 
     def satisfies(self, graph, h):
         try:
@@ -279,7 +288,7 @@ class OrderedLink(HGQueryCondition):
     targets: tuple[HGHandle, ...]
 
     def __init__(self, *targets: HGHandle):
-        object.__setattr__(self, "targets", tuple(int(t) for t in targets))
+        object.__setattr__(self, "targets", tuple(_coerce_handle(t) for t in targets))
 
     def satisfies(self, graph, h):
         try:

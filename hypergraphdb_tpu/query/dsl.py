@@ -32,8 +32,16 @@ def not_(clause) -> c.Not:
     return c.Not(clause)
 
 
+def _h(x):
+    """Handle coercion that lets Var placeholders pass through (bound later
+    by query.variables.substitute)."""
+    from hypergraphdb_tpu.query.variables import Var
+
+    return x if isinstance(x, Var) else int(x)
+
+
 def is_(handle) -> c.Is:
-    return c.Is(int(handle))
+    return c.Is(_h(handle))
 
 
 def type_(t) -> c.AtomType:
@@ -78,11 +86,11 @@ def part(path: str, v, op: str = "eq") -> c.AtomPart:
 
 
 def incident(target) -> c.Incident:
-    return c.Incident(int(target))
+    return c.Incident(_h(target))
 
 
 def incident_at(target, position: int) -> c.PositionedIncident:
-    return c.PositionedIncident(int(target), position)
+    return c.PositionedIncident(_h(target), position)
 
 
 def link(*targets) -> c.Link:
@@ -94,7 +102,7 @@ def ordered_link(*targets) -> c.OrderedLink:
 
 
 def target(link_handle) -> c.Target:
-    return c.Target(int(link_handle))
+    return c.Target(_h(link_handle))
 
 
 def arity(n: int, op: str = "eq") -> c.Arity:

@@ -1,0 +1,136 @@
+"""Checkpoint/export/import, subgraph copy, and parameterized queries."""
+
+import numpy as np
+import pytest
+
+import hypergraphdb_tpu as hg
+from hypergraphdb_tpu.ops.checkpoint import (
+    copy_subgraph,
+    export_graph,
+    import_graph,
+    load_snapshot,
+    save_snapshot,
+)
+from hypergraphdb_tpu.query import dsl as q
+from hypergraphdb_tpu.query.variables import prepare, substitute, var
+from hypergraphdb_tpu.core.errors import QueryError
+
+from conftest import make_random_hypergraph
+
+
+# ---------------------------------------------------------------- snapshot ckpt
+
+
+def test_snapshot_save_load_roundtrip(graph, tmp_path):
+    make_random_hypergraph(graph, n_nodes=60, n_links=90, seed=5)
+    snap = graph.snapshot()
+    p = str(tmp_path / "snap.npz")
+    save_snapshot(snap, p)
+    back = load_snapshot(p)
+    assert back.num_atoms == snap.num_atoms
+    np.testing.assert_array_equal(back.inc_offsets, snap.inc_offsets)
+    np.testing.assert_array_equal(back.inc_links, snap.inc_links)
+    np.testing.assert_array_equal(back.value_rank, snap.value_rank)
+    for k, v in snap.by_type.items():
+        np.testing.assert_array_equal(back.by_type[k], v)
+    # the reloaded snapshot serves kernels without a graph
+    from hypergraphdb_tpu.ops.frontier import bfs_levels
+    import jax.numpy as jnp
+
+    seeds = jnp.asarray([0], dtype=jnp.int32)
+    lv1, _ = bfs_levels(snap.device, seeds, 2)
+    lv2, _ = bfs_levels(back.device, seeds, 2)
+    np.testing.assert_array_equal(np.asarray(lv1), np.asarray(lv2))
+
+
+# ---------------------------------------------------------------- logical dump
+
+
+def test_export_import_roundtrip(graph, tmp_path):
+    a = graph.add("alpha")
+    b = graph.add(42)
+    l = graph.add_link((a, b), value="edge")
+    meta = graph.add_link((l,), value="meta")
+    p = str(tmp_path / "dump.jsonl")
+    n = export_graph(graph, p)
+    assert n >= 4
+
+    g2 = hg.HyperGraph()
+    mapping = import_graph(g2, p)
+    na, nb, nl = mapping[int(a)], mapping[int(b)], mapping[int(l)]
+    assert g2.get(na) == "alpha"
+    assert g2.get(nb) == 42
+    assert g2.get(nl).targets == (na, nb)
+    assert g2.get(mapping[int(meta)]).targets == (nl,)
+    # queries work on the imported graph
+    assert q.find_all(g2, q.value("edge")) == [nl]
+    g2.close()
+
+
+def test_copy_subgraph_closure(graph):
+    a = graph.add("root")
+    b = graph.add("reach")
+    c = graph.add("unreached")
+    lab = graph.add_link((a, b), value="ab")
+    graph.add_link((c,), value="lonely")
+
+    g2 = hg.HyperGraph()
+    mapping = copy_subgraph(graph, g2, [int(a)])
+    assert mapping[int(a)] is not None
+    assert g2.get(mapping[int(b)]) == "reach"
+    assert g2.get(mapping[int(lab)]).targets == (
+        mapping[int(a)], mapping[int(b)]
+    )
+    assert int(c) not in mapping  # not reachable from a
+    g2.close()
+
+
+# ---------------------------------------------------------------- variables
+
+
+def test_prepared_query_rebinds(graph):
+    graph.add("hello")
+    graph.add("world")
+    pq = prepare(graph, q.and_(q.type_("string"), q.value(var("v"))))
+    assert pq.variables == {"v"}
+    r1 = pq.execute(v="hello")
+    r2 = pq.execute(v="world")
+    assert len(r1) == 1 and len(r2) == 1 and r1 != r2
+
+
+def test_unbound_variable_raises(graph):
+    pq = prepare(graph, q.value(var("x")))
+    with pytest.raises(QueryError, match="unbound"):
+        pq.execute()
+
+
+def test_substitute_nested(graph):
+    cond = q.or_(q.incident(var("t")), q.and_(q.value(var("v")), q.arity(2)))
+    out = substitute(cond, {"t": 7, "v": "z"})
+    assert out == q.or_(q.incident(7), q.and_(q.value("z"), q.arity(2)))
+
+
+# ------------------------------------------- review regressions (round 4)
+
+
+def test_var_in_link_targets(graph):
+    a = graph.add("a")
+    b = graph.add("b")
+    l = graph.add_link((a, b))
+    pq = prepare(graph, q.link(var("t"), int(b)))
+    assert pq.execute(t=int(a)) == [int(l)]
+
+
+def test_substitute_tree_with_link_and_var(graph):
+    cond = q.and_(q.link(1, 2), q.value(var("v")))
+    out = substitute(cond, {"v": "x"})
+    assert out == q.and_(q.link(1, 2), q.value("x"))
+
+
+def test_snapshot_path_without_extension(graph, tmp_path):
+    graph.add("p")
+    snap = graph.snapshot()
+    p = str(tmp_path / "noext")
+    save_snapshot(snap, p)
+    back = load_snapshot(p)  # both sides normalize to .npz
+    assert back.num_atoms == snap.num_atoms
