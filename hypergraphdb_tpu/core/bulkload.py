@@ -1,0 +1,117 @@
+"""Bulk loader: the high-throughput ingest path for dataset-scale imports.
+
+The per-atom add path buffers every write in the transaction overlay, then
+replays it at commit — correct, but ~30 Python-level calls per atom. At the
+benchmark scales (BASELINE configs 3-4: 10M atoms) that tax dominates
+ingest. ``bulk_import`` is the loader the reference would call a batch
+load: one type resolution, one commit batch, direct backend writes, bulk
+index appends.
+
+Semantics and caveats (documented, deliberate):
+
+- atomicity/durability: all writes go through ONE backend commit batch, so
+  a crash mid-load replays nothing (all-or-nothing on durable backends);
+- isolation: the loader requires that no transaction is active on the
+  calling thread and takes the commit lock for its whole run — concurrent
+  committers queue behind it exactly like behind a large commit;
+- events fire per atom only if someone is listening (same rule as the
+  bulk add APIs); user indexers run through the normal ``maybe_index``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+from hypergraphdb_tpu.core.errors import HGException
+
+
+def bulk_import(
+    graph,
+    values: Optional[Sequence[Any]] = None,
+    target_lists: Optional[Sequence[Sequence[int]]] = None,
+    type: Optional[Any] = None,  # noqa: A002 - mirrors kernel naming
+) -> range:
+    """Load ``values[i]`` (and, for links, ``target_lists[i]``) in one batch.
+
+    All atoms must share one type (pass ``type`` or let the first value
+    infer it). Returns the contiguous handle range. Falls back to the
+    normal bulk APIs when a transaction is open on this thread."""
+    from hypergraphdb_tpu.core import events as ev
+    from hypergraphdb_tpu.core.graph import (
+        _FLAG_LINK,
+        IDX_BY_TYPE,
+        IDX_BY_VALUE,
+        _type_key,
+    )
+    from hypergraphdb_tpu.indexing.manager import indexers_of, maybe_index
+
+    n = len(target_lists) if target_lists is not None else len(values)
+    if n == 0:
+        return range(0, 0)
+    if values is not None and target_lists is not None \
+            and len(values) != len(target_lists):
+        raise HGException("values and target_lists length mismatch")
+
+    if graph.txman.current() is not None:
+        # inside a transaction the overlay semantics must hold — use the
+        # buffered path
+        if target_lists is None:
+            return graph.add_nodes_bulk(values, type=type)
+        return graph.add_links_bulk(target_lists, values=values, type=type)
+
+    graph._check_open()
+    sample = values[0] if values is not None else None
+    type_handle = int(graph._resolve_type_handle(sample, type))
+    atype = graph.typesystem.get_type(type_handle)
+    backend = graph.backend
+    has_indexers = bool(indexers_of(graph, type_handle))
+
+    with graph.txman._commit_lock:
+        r = graph.handles.make_many(n)
+        backend.commit_batch_begin()
+        try:
+            by_type = backend.get_index(IDX_BY_TYPE)
+            by_value = backend.get_index(IDX_BY_VALUE)
+            tkey = _type_key(type_handle)
+            flags = _FLAG_LINK if target_lists is not None else 0
+            for i, h in enumerate(r):
+                v = values[i] if values is not None else None
+                vkey = atype.to_key(v)
+                if v is None and atype.name == "null":
+                    value_handle = -1
+                else:
+                    value_handle = graph.handles.make()
+                    backend.store_data(value_handle, atype.store(v))
+                if target_lists is not None:
+                    targets = tuple(int(t) for t in target_lists[i])
+                else:
+                    targets = ()
+                backend.store_link(h, (type_handle, value_handle, flags)
+                                   + targets)
+                by_type.add_entry(tkey, h)
+                by_value.add_entry(vkey, h)
+                for t in targets:
+                    backend.add_incidence_link(t, h)
+                if has_indexers:
+                    maybe_index(graph, h, type_handle, v, targets or None)
+        except BaseException:
+            backend.commit_batch_abort()
+            raise
+        else:
+            backend.commit_batch_end()
+        # one clock tick for the whole batch: later transactions see a
+        # version bump on the by-type cell they are most likely to re-read
+        graph.txman._clock += 1
+        graph.txman._versions[("idx", IDX_BY_TYPE, tkey)] = graph.txman._clock
+
+    def fire() -> None:
+        if graph.events.has_listeners_for(ev.HGAtomAddedEvent):
+            for i, h in enumerate(r):
+                v = values[i] if values is not None else None
+                graph._committed_mutation(ev.HGAtomAddedEvent(h, v))
+        else:
+            graph._mutations += n
+            graph.metrics.incr("graph.mutations", n)
+
+    fire()
+    return r

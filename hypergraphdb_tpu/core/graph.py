@@ -621,6 +621,13 @@ class HyperGraph:
         self._after_commit(fire)
         return r
 
+    def bulk_import(self, values=None, target_lists=None, type=None):  # noqa: A002
+        """High-throughput single-type batch ingest (see ``core/bulkload``)."""
+        from hypergraphdb_tpu.core.bulkload import bulk_import
+
+        return bulk_import(self, values=values, target_lists=target_lists,
+                           type=type)
+
     # ------------------------------------------------------------------ device snapshot
     def snapshot(self, refresh: bool = False):
         """Pack (or return the cached) immutable device CSR snapshot — a

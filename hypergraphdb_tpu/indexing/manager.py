@@ -129,12 +129,17 @@ class TargetToTargetIndexer(HGIndexer):
 
 # -- registration + hooks ------------------------------------------------------
 
+def _bump_registry_version(graph) -> None:
+    graph._indexer_reg_version = getattr(graph, "_indexer_reg_version", 0) + 1
+
+
 def register(graph, indexer: HGIndexer, populate: bool = True) -> None:
     """Register and (optionally) build the index over existing atoms — the
     online equivalent of the reference's offline ``ApplyNewIndexer``
     maintenance op (``maintenance/ApplyNewIndexer.java:36``)."""
     reg = _registry(graph)
     reg.setdefault(int(indexer.type_handle), []).append(indexer)
+    _bump_registry_version(graph)
     if populate:
         rebuild(graph, indexer)
 
@@ -145,16 +150,35 @@ def unregister(graph, indexer_name: str) -> None:
         reg[th] = [ix for ix in idxs if ix.name != indexer_name]
         if not reg[th]:
             del reg[th]
+    _bump_registry_version(graph)
     graph.store.remove_index(_storage_name(indexer_name))
 
 
 def indexers_of(graph, type_handle: HGHandle) -> list[HGIndexer]:
-    """All indexers applying to a type, including via supertype registration."""
+    """All indexers applying to a type, including via supertype registration.
+
+    Called from the per-atom write path, so the empty-registry case (the
+    common one) exits before any supertype walk, and non-empty lookups are
+    memoized until the registry or the type hierarchy changes."""
     reg = _registry(graph)
-    out = list(reg.get(int(type_handle), ()))
+    if not reg:
+        return []
+    version = (getattr(graph, "_indexer_reg_version", 0),
+               getattr(graph.typesystem, "hierarchy_version", 0))
+    cache = getattr(graph, "_indexers_of_cache", None)
+    if cache is None or cache[0] != version:
+        cache = (version, {})
+        graph._indexers_of_cache = cache
+    memo = cache[1]
+    th = int(type_handle)
+    hit = memo.get(th)
+    if hit is not None:
+        return hit
+    out = list(reg.get(th, ()))
     try:
         name = graph.typesystem.name_of(type_handle)
     except KeyError:
+        memo[th] = out
         return out
     for sup in graph.typesystem.supertypes_of(name):
         try:
@@ -162,6 +186,7 @@ def indexers_of(graph, type_handle: HGHandle) -> list[HGIndexer]:
         except Exception:
             continue
         out.extend(reg.get(int(sh), ()))
+    memo[th] = out
     return out
 
 

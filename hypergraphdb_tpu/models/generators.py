@@ -35,7 +35,7 @@ def zipf_hypergraph(graph, n_nodes: int = 10_000, n_links: int = 5_000,
     """Skewed-degree hypergraph (the shape of lexical graphs): returns
     (node_handles, link_handles)."""
     r = np.random.default_rng(seed)
-    nodes = graph.add_nodes_bulk(np.arange(n_nodes).tolist())
+    nodes = graph.bulk_import(values=np.arange(n_nodes).tolist())
     node0 = int(nodes[0])
     popularity = r.zipf(zipf_a, size=n_links * (max_arity + 1)) % n_nodes
     arities = r.integers(2, max_arity + 1, size=n_links)
@@ -45,8 +45,9 @@ def zipf_hypergraph(graph, n_nodes: int = 10_000, n_links: int = 5_000,
         ts = popularity[k : k + a]
         k += a
         target_lists.append([node0 + int(t) for t in ts])
-    links = graph.add_links_bulk(
-        target_lists, values=list(range(n_links)) if values else None
+    links = graph.bulk_import(
+        values=list(range(n_links)) if values else [None] * n_links,
+        target_lists=target_lists,
     )
     return nodes, links
 
@@ -93,8 +94,8 @@ def dbpedia_like(graph, n_entities: int = 100_000, n_triples: int = 500_000,
     property links (value = property id). Ingests in batches so 10M-atom
     builds stream. Returns (entity_handles, first_link_handle)."""
     r = np.random.default_rng(seed)
-    entities = graph.add_nodes_bulk(
-        [Entity(f"e/{i}") for i in range(n_entities)]
+    entities = graph.bulk_import(
+        values=[Entity(f"e/{i}") for i in range(n_entities)]
     )
     e0 = int(entities[0])
     first_link = None
@@ -105,9 +106,10 @@ def dbpedia_like(graph, n_entities: int = 100_000, n_triples: int = 500_000,
         subj = r.zipf(1.1, size=m) % n_entities
         obj = r.integers(0, n_entities, size=m)
         props = r.integers(0, n_properties, size=m)
-        links = graph.add_links_bulk(
-            [[e0 + int(a), e0 + int(b)] for a, b in zip(subj, obj)],
+        links = graph.bulk_import(
             values=[int(p) for p in props],
+            target_lists=[[e0 + int(a), e0 + int(b)]
+                          for a, b in zip(subj, obj)],
         )
         if first_link is None:
             first_link = int(links[0])

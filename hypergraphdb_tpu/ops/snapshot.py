@@ -93,7 +93,8 @@ class CSRSnapshot:
     # ------------------------------------------------------------------ pack
     @staticmethod
     def pack(graph, version: Optional[int] = None, pad_multiple: int = 128,
-             capacity: Optional[int] = None) -> "CSRSnapshot":
+             capacity: Optional[int] = None, value_ranks: bool = True
+             ) -> "CSRSnapshot":
         """Pack the committed store into CSR arrays (the ``storage/tpu-jax``
         snapshot step from BASELINE.json's north star).
 
@@ -103,6 +104,9 @@ class CSRSnapshot:
         one frontier shape, so no recompilation on ingest."""
         backend = graph.backend
         ids, offsets, flat = backend.bulk_links()
+        ids = np.asarray(ids, dtype=np.int64)
+        offsets = np.asarray(offsets, dtype=np.int64)
+        flat = np.asarray(flat, dtype=np.int64)
         n = int(graph.handles.peek) if hasattr(graph.handles, "peek") else (
             int(ids.max()) + 1 if len(ids) else 0
         )
@@ -116,63 +120,73 @@ class CSRSnapshot:
         arity = np.zeros(N + 1, dtype=np.int32)
         value_rank = np.zeros(N + 1, dtype=np.uint64)
 
-        # target CSR rows only exist for link atoms; record layout is
-        # (type, value, flags, *targets) — see core/graph.py
-        tgt_counts = np.zeros(N + 1, dtype=np.int64)
-        links_list = ids.tolist()
-        offs = offsets.tolist()
-        flat_l = flat.tolist()
-        tgt_rows: dict[int, list[int]] = {}
-        for j, h in enumerate(links_list):
-            rec = flat_l[offs[j] : offs[j + 1]]
-            if len(rec) < 3:
-                continue
-            type_of[h] = rec[0]
-            linkflag = rec[2] & 1
-            is_link[h] = bool(linkflag)
-            targets = rec[3:]
-            arity[h] = len(targets)
-            if targets:
-                tgt_rows[h] = targets
-                tgt_counts[h] = len(targets)
-            if rec[1] >= 0:
-                data = backend.get_data(rec[1])
-                if data is not None:
-                    try:
-                        atype = graph.typesystem.get_type(rec[0])
-                        # rank of the order-preserving index key: ordered for
-                        # primitives, equality-only for records (msgpack keys)
-                        value_rank[h] = rank64(atype.to_key(atype.make(data)))
-                    except Exception:
-                        pass
+        # fully vectorized record decode (the 10M-atom scale path — no
+        # per-atom Python): record layout is (type, value, flags, *targets),
+        # see core/graph.py
+        starts = offsets[:-1]
+        lens = offsets[1:] - starts
+        ok = lens >= 3
+        vids = ids[ok]
+        vstarts = starts[ok]
+        vlens = lens[ok]
+        type_of[vids] = flat[vstarts].astype(np.int32)
+        value_handles = flat[vstarts + 1]
+        is_link[vids] = (flat[vstarts + 2].astype(np.int64) & 1).astype(bool)
+        arities = (vlens - 3).astype(np.int32)
+        arity[vids] = arities
 
+        # target COO: for record j, positions vstarts[j]+3 .. end
+        rec_of = np.repeat(np.arange(len(vids)), vlens)
+        pos_in_rec = np.arange(len(rec_of)) - np.repeat(
+            np.cumsum(vlens) - vlens, vlens
+        )
+        tmask = pos_in_rec >= 3
+        rec_sel = rec_of[tmask]
+        tgt_flat_coo = flat[
+            np.repeat(vstarts, vlens)[tmask] + pos_in_rec[tmask]
+        ].astype(np.int32)
+        tgt_src_coo = vids[rec_sel].astype(np.int32)
+
+        # target CSR grouped by source link (records already id-ascending)
+        tgt_counts = np.zeros(N + 1, dtype=np.int64)
+        tgt_counts[vids] = arities
         tgt_offsets = np.zeros(N + 2, dtype=np.int32)
         np.cumsum(tgt_counts, out=tgt_offsets[1 : N + 2])
-        e_tgt = int(tgt_offsets[N + 1])
-        tgt_flat_arr = np.empty(e_tgt, dtype=np.int32)
-        tgt_src_arr = np.empty(e_tgt, dtype=np.int32)
-        for h, ts in tgt_rows.items():
-            s = tgt_offsets[h]
-            tgt_flat_arr[s : s + len(ts)] = ts
-            tgt_src_arr[s : s + len(ts)] = h
+        e_tgt = len(tgt_flat_coo)
+        tgt_flat_arr = tgt_flat_coo
+        tgt_src_arr = tgt_src_coo
 
-        # incidence CSR from backend sorted sets
-        inc_counts = np.zeros(N + 1, dtype=np.int64)
-        inc_rows: dict[int, np.ndarray] = {}
-        for h in links_list:
-            rs = backend.get_incidence_set(h).array()
-            if len(rs):
-                inc_rows[h] = rs
-                inc_counts[h] = len(rs)
+        # incidence CSR is the TRANSPOSE of the target relation — derived
+        # here instead of per-atom backend cursor reads: entry (t ← l) for
+        # every (l → t) edge, deduped, each row sorted by link id
+        if e_tgt:
+            pair_order = np.lexsort((tgt_src_coo, tgt_flat_coo))
+            pt = tgt_flat_coo[pair_order].astype(np.int64)
+            pl = tgt_src_coo[pair_order].astype(np.int64)
+            keep = np.ones(len(pt), dtype=bool)
+            keep[1:] = (pt[1:] != pt[:-1]) | (pl[1:] != pl[:-1])
+            pt, pl = pt[keep], pl[keep]
+        else:
+            pt = pl = np.empty(0, dtype=np.int64)
+        inc_counts = np.bincount(pt, minlength=N + 1)
         inc_offsets = np.zeros(N + 2, dtype=np.int32)
         np.cumsum(inc_counts, out=inc_offsets[1 : N + 2])
-        e_inc = int(inc_offsets[N + 1])
-        inc_links_arr = np.empty(e_inc, dtype=np.int32)
-        inc_src_arr = np.empty(e_inc, dtype=np.int32)
-        for h, rs in inc_rows.items():
-            s = inc_offsets[h]
-            inc_links_arr[s : s + len(rs)] = rs
-            inc_src_arr[s : s + len(rs)] = h
+        e_inc = len(pl)
+        inc_links_arr = pl.astype(np.int32)
+        inc_src_arr = pt.astype(np.int32)
+
+        # value ranks via the by-value system index: one rank64 per DISTINCT
+        # key (values repeat heavily in real graphs), scattered to handles
+        if value_ranks:
+            try:
+                from hypergraphdb_tpu.core.graph import IDX_BY_VALUE
+
+                idx = backend.get_index(IDX_BY_VALUE, create=False)
+                if idx is not None:
+                    for key, hs in idx.bulk_items():
+                        value_rank[hs[hs <= N]] = rank64(key)
+            except Exception:
+                pass
 
         # pad edge arrays to lane multiples; padded entries point at the
         # dummy row N (whose frontier/visited value is always False)

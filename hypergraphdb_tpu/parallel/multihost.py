@@ -1,0 +1,73 @@
+"""Multi-host initialization: the DCN control-plane glue.
+
+SURVEY §2.5/§5: scaling beyond one host uses ``jax.distributed`` for the
+device plane (XLA collectives ride ICI within a slice and DCN across
+hosts) and the ``peer`` package's TCP transport for the host-side service
+plane (replication, remote query). This module owns the boilerplate:
+
+    from hypergraphdb_tpu.parallel import multihost
+    multihost.initialize(coordinator="10.0.0.1:8476",
+                         num_processes=4, process_id=int(os.environ["RANK"]))
+    mesh = multihost.global_mesh()          # all devices across hosts
+    sdev = ShardedSnapshot.from_host(snap, mesh)
+
+Single-host (or test) environments skip ``initialize`` and
+``global_mesh()`` degrades to the local-device mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+_initialized = False
+
+
+def initialize(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join the multi-host JAX cluster (``jax.distributed.initialize``).
+
+    With no arguments, defers to environment auto-detection (TPU pods
+    populate the coordinator variables). Safe to call once per process,
+    BEFORE any device access."""
+    global _initialized
+    if _initialized:
+        return
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+
+
+def is_multihost() -> bool:
+    import jax
+
+    return jax.process_count() > 1
+
+
+def global_mesh(axis: str = "shard"):
+    """One-axis mesh over every device in the cluster (local ones when not
+    distributed) — the CSR shard axis used by ``parallel.sharded``."""
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()), (axis,))
+
+
+def local_process_info() -> dict:
+    import jax
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }

@@ -110,6 +110,12 @@ class HGIndex:
         for k in self.scan_keys():
             yield from self.find(k)
 
+    def bulk_items(self):
+        """Iterate (key, sorted int64 ndarray) pairs — the CSR-pack fast
+        path. Backends override with direct container access."""
+        for k in self.scan_keys():
+            yield k, self.find(k).array()
+
     # range queries (HGSortIndex semantics)
     def find_range(
         self,

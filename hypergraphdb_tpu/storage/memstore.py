@@ -126,6 +126,11 @@ class MemIndex(HGBidirectionalIndex):
     def find_by_value(self, value: HGHandle) -> list[bytes]:
         return sorted(self._vk.get(value, ()))
 
+    def bulk_items(self):
+        # direct container access: no result-set wrappers on the pack path
+        for k, s in self._kv.items():
+            yield k, s.snapshot()
+
 
 class MemStorage(StorageBackend):
     def __init__(self) -> None:

@@ -120,6 +120,8 @@ class HGTypeSystem:
         self._inference: list[Callable[[Any], Optional[HGAtomType]]] = []
         #: direct supertype edges: type name -> parent type names
         self._supertypes: dict[str, set[str]] = {}
+        #: bumped on every hierarchy change; consumed by lookup caches
+        self.hierarchy_version = 0
         self.top = TopType()
         self.null = NullType()
 
@@ -156,6 +158,7 @@ class HGTypeSystem:
             self._by_class[c] = atype.name
         if supertypes:
             self._supertypes[atype.name] = set(supertypes)
+            self.hierarchy_version += 1
         return h
 
     def add_inference(self, fn: Callable[[Any], Optional[HGAtomType]]) -> None:
@@ -255,6 +258,7 @@ class HGTypeSystem:
     # -- subsumption (type-level) ---------------------------------------------
     def declare_subtype(self, sub: str, sup: str) -> None:
         self._supertypes.setdefault(sub, set()).add(sup)
+        self.hierarchy_version += 1
 
     def subtypes_closure(self, name: str) -> set[str]:
         """All type names subsumed by `name` (including itself) — powers
