@@ -3,6 +3,11 @@ Pallas kernels, and snapshot checkpointing (SURVEY §7 device design)."""
 
 from hypergraphdb_tpu.ops.snapshot import CSRSnapshot, DeviceSnapshot
 from hypergraphdb_tpu.ops.frontier import bfs_levels, expand_frontier
+from hypergraphdb_tpu.ops.bitfrontier import (
+    bfs_memory_bytes,
+    bfs_packed,
+    unpack_visited,
+)
 from hypergraphdb_tpu.ops.incremental import SnapshotManager, bfs_levels_delta
 from hypergraphdb_tpu.ops.checkpoint import (
     copy_subgraph,
@@ -17,6 +22,9 @@ __all__ = [
     "DeviceSnapshot",
     "SnapshotManager",
     "bfs_levels",
+    "bfs_memory_bytes",
+    "bfs_packed",
+    "unpack_visited",
     "bfs_levels_delta",
     "copy_subgraph",
     "expand_frontier",

@@ -10,6 +10,7 @@ from hypergraphdb_tpu.parallel.sharded import (
     ShardedSnapshot,
     and_incident_pattern_sharded,
     bfs_levels_sharded,
+    bfs_packed_sharded,
     make_mesh,
     match_candidates_sharded,
 )
@@ -19,6 +20,7 @@ __all__ = [
     "ShardedSnapshot",
     "and_incident_pattern_sharded",
     "bfs_levels_sharded",
+    "bfs_packed_sharded",
     "make_mesh",
     "match_candidates_sharded",
 ]
