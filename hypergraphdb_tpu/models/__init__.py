@@ -6,6 +6,7 @@ from hypergraphdb_tpu.models.generators import (
     Entity,
     Synset,
     dbpedia_like,
+    dbpedia_snapshot,
     wordnet_like,
     zipf_hypergraph,
 )
@@ -14,6 +15,7 @@ __all__ = [
     "Entity",
     "Synset",
     "dbpedia_like",
+    "dbpedia_snapshot",
     "wordnet_like",
     "zipf_hypergraph",
 ]
