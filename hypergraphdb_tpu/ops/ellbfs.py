@@ -1,0 +1,500 @@
+"""Pull-mode, seed-transposed BFS — the fast path for config-4 scale.
+
+Round 2's ``ops/bitfrontier.py`` made 10M-atom frontiers *fit* (bit-packed
+``(K, W)`` bitmaps) but not *fast*: its push scan does a ``test_bits``
+gather plus an ``.at[:, d].max`` scatter **per (seed, edge)** — K×E scalar
+probes per hop. Measured on v5e, XLA lowers both to a latency-bound unit
+running ~10⁸ indices/s, which is why BENCH_r02 saw 324 s/run and <1% of
+HBM (VERDICT r2 Weak #2).
+
+This module keeps the same BFS semantics (``SimpleALGenerator`` neighbor
+rule: frontier atom → incident links → their targets, reference
+``HGBreadthFirstTraversal.java:49-66``) but re-lays the computation so the
+expensive primitive is **one row gather per edge**, not K probes per edge:
+
+- the frontier is stored **transposed**: ``F[(N+1, Kw)] uint32`` — bit k of
+  word ``F[v, k>>5]`` says "seed k has reached atom v". One 128-byte row
+  per atom carries ALL 1024 seeds at once.
+- a hop is two *pull* reductions with NO scatters:
+  stage 1: ``link_live[l] = OR_{t ∈ targets(l)} F[t]``
+  stage 2: ``reach[v]    = OR_{l ∈ incident(v)} link_live[l]``
+  Each is a gather of edge-many rows followed by a fixed-width tree
+  reduction over host-precomputed padded index plans (:class:`ReducePlan`):
+  every CSR row is padded to a multiple of ``w`` and aligned, so the
+  segment-OR is a plain ``reshape(-1, w, Kw) → OR(axis=1)`` — XLA's fused
+  streaming path, no segment ids, no conflicts, hub rows handled by
+  recursion (level ℓ reduces rows of up to ``w^(ℓ+1)`` entries).
+- levels compose: stage 2's level-0 indices are pre-composed with stage
+  1's output map on host, so link-space results are consumed directly
+  without materializing a per-link destination array.
+- per-seed edge counts (the benchmark numerator) are a bit-unpack +
+  degree matmul per hop — MXU work, not gathers.
+
+Geometry note: each gather row is ``Kw = K/32`` uint32 words (32 lanes for
+K=1024). Gathers remain the dominant cost and are latency-bound, but the
+total index count per hop drops from ``K × E`` to ``~1.3 × E × (1 + 1/w)``
+— three orders of magnitude at K=1024.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hypergraphdb_tpu.ops.snapshot import CSRSnapshot
+
+WORD = 32
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+# ------------------------------------------------------------------ host plans
+
+
+@dataclass(frozen=True)
+class ReducePlan:
+    """Padded-gather tree reduction over one CSR relation.
+
+    ``levels[0]`` indexes caller-provided value rows (with ``zero_row``
+    pointing at a guaranteed-all-zero row) and covers every row; level
+    ``ℓ>0`` covers ONLY rows still unfinished (more than one chunk) —
+    single-chunk rows would otherwise pay a ``w_upper×`` pass-through pad
+    per level, a 16× index blowup at hypergraph scale. Upper-level indices
+    are local to the previous level's chunk array, with index
+    ``len(prev_chunks)`` meaning the per-level appended zero row.
+
+    A row's final chunk therefore lives in the chunk array of whichever
+    level it finished at; ``out_map[r]`` addresses the **concatenation** of
+    all level chunk arrays (in order) with one global zero row at the very
+    end (``concat_size``). Empty rows map to the zero row. All index
+    arrays are int32; every level's length is a multiple of its width.
+    """
+
+    levels: tuple[np.ndarray, ...]
+    widths: tuple[int, ...]
+    out_map: np.ndarray  # (R,) int32 into concat space; empty rows → zero row
+    n_rows: int
+    concat_size: int     # total chunks across levels; zero row lives here
+
+    @property
+    def total_indices(self) -> int:
+        return int(sum(len(l) for l in self.levels))
+
+
+def build_reduce_plan(
+    offsets: np.ndarray,
+    flat: np.ndarray,
+    n_rows: int,
+    zero_row: int,
+    w: int = 8,
+    w_upper: int = 8,
+) -> ReducePlan:
+    """Build the padded index pyramid for ``reduce_or`` over CSR rows.
+
+    ``offsets``/``flat`` describe rows ``0..n_rows``; ``zero_row`` indexes
+    an all-zero value row used for level-0 padding. Level 0 width is ``w``;
+    upper levels use ``w_upper``.
+    """
+    offsets = np.asarray(offsets, dtype=np.int64)
+    deg = offsets[1 : n_rows + 1] - offsets[:n_rows]
+    nchunk = -(-deg // w)  # ceil; 0 for empty rows
+
+    total = int(nchunk.sum()) * w
+    idx0 = np.full(total, zero_row, dtype=np.int32)
+    row_pad_starts = np.zeros(n_rows + 1, dtype=np.int64)
+    np.cumsum(nchunk * w, out=row_pad_starts[1:])
+    nz = np.nonzero(deg)[0]
+    if len(nz):
+        reps = deg[nz]
+        dst = np.repeat(row_pad_starts[nz], reps) + _intra(reps)
+        src = np.repeat(offsets[nz], reps) + _intra(reps)
+        idx0[dst] = np.asarray(flat, dtype=np.int32)[src]
+    levels = [idx0]
+    widths = [w]
+
+    # out_map in concat space; level offsets accumulate as levels are added
+    out_map = np.full(n_rows, -1, dtype=np.int64)
+    level_offset = 0
+    n_prev = int(nchunk.sum())  # chunks in the previous (current last) level
+    # rows' chunk spans start contiguously in the previous level's array
+    cur_counts = nchunk
+    cur_starts = np.zeros(n_rows + 1, dtype=np.int64)
+    np.cumsum(cur_counts, out=cur_starts[1:])
+
+    done = cur_counts == 1
+    out_map[done] = level_offset + cur_starts[:n_rows][done]
+
+    while int(cur_counts.max(initial=0)) > 1:
+        wu = w_upper
+        live = np.nonzero(cur_counts > 1)[0]
+        live_counts = cur_counts[live]
+        nxt_counts_live = -(-live_counts // wu)
+        tot = int(nxt_counts_live.sum()) * wu
+        idx = np.full(tot, n_prev, dtype=np.int32)  # pad → prev zero row
+        pad_starts = np.zeros(len(live) + 1, dtype=np.int64)
+        np.cumsum(nxt_counts_live * wu, out=pad_starts[1:])
+        reps = live_counts
+        dst = np.repeat(pad_starts[:-1], reps) + _intra(reps)
+        src = np.repeat(cur_starts[live], reps) + _intra(reps)
+        idx[dst] = src.astype(np.int32)
+        levels.append(idx)
+        widths.append(wu)
+
+        level_offset += n_prev
+        n_prev = int(nxt_counts_live.sum())
+        cur_counts = np.zeros(n_rows, dtype=np.int64)
+        cur_counts[live] = nxt_counts_live
+        cur_starts = np.zeros(n_rows + 1, dtype=np.int64)
+        np.cumsum(cur_counts, out=cur_starts[1:])
+        done = cur_counts == 1
+        out_map[done] = level_offset + cur_starts[:n_rows][done]
+
+    concat_size = level_offset + n_prev
+    out_map = np.where(out_map >= 0, out_map, concat_size)
+    return ReducePlan(
+        tuple(levels), tuple(widths), out_map.astype(np.int32),
+        n_rows, concat_size,
+    )
+
+
+def _intra(reps: np.ndarray) -> np.ndarray:
+    """Concatenated ``arange(r)`` for each r in reps (vectorized)."""
+    total = int(reps.sum())
+    if total == 0:
+        return np.empty(0, dtype=np.int64)
+    ends = np.cumsum(reps)
+    return np.arange(total, dtype=np.int64) - np.repeat(ends - reps, reps)
+
+
+# ------------------------------------------------------------------ device ops
+
+
+def _reduce_level(
+    values: jax.Array,  # (S, Kw) uint32 value rows; padding rows index S-1.. caller
+    idx: jax.Array,     # (E,) int32, multiple of w
+    w: int,
+    chunk: int,
+) -> jax.Array:
+    """gather + fixed-width OR-reduce, streamed in ``chunk``-row slices to
+    bound the gather transient: returns (E//w, Kw) uint32."""
+    E = idx.shape[0]
+    Kw = values.shape[1]
+    n_out = E // w
+    if E <= chunk * w:
+        g = values[idx]
+        return _or_fold(g.reshape(n_out, w, Kw))
+    # pad out rows to a multiple of chunk for the scan
+    n_blocks = -(-n_out // chunk)
+    pad_rows = n_blocks * chunk - n_out
+    if pad_rows:
+        idx = jnp.concatenate(
+            [idx, jnp.zeros((pad_rows * w,), dtype=idx.dtype)]
+        )
+    idx_b = idx.reshape(n_blocks, chunk * w)
+
+    def body(_, ib):
+        g = values[ib]
+        return None, _or_fold(g.reshape(chunk, w, Kw))
+
+    _, out = jax.lax.scan(body, None, idx_b)
+    out = out.reshape(n_blocks * chunk, Kw)
+    return out[:n_out] if pad_rows else out
+
+
+def _or_fold(x: jax.Array) -> jax.Array:
+    """(R, w, Kw) → (R, Kw) OR over axis 1 as a log-depth fold."""
+    w = x.shape[1]
+    while w > 1:
+        if w % 2:
+            x = jnp.concatenate(
+                [x, jnp.zeros_like(x[:, :1])], axis=1
+            )
+            w += 1
+        x = x[:, 0::2] | x[:, 1::2]
+        w //= 2
+    return x[:, 0]
+
+
+def _apply_plan(
+    values: jax.Array,            # (S, Kw) uint32 — level-0 value rows
+    levels: Sequence[jax.Array],
+    widths: Sequence[int],
+    chunk: int,
+) -> jax.Array:
+    """Run the reduction pyramid; returns the CONCATENATION of every
+    level's chunk array plus one global zero row at the end — the address
+    space ``ReducePlan.out_map`` (and composed downstream level-0 indices)
+    point into."""
+    Kw = values.shape[1]
+    parts = []
+    cur = values
+    for i, (idx, w) in enumerate(zip(levels, widths)):
+        if i > 0:
+            # upper-level padding references index len(prev) = its zero row
+            cur = jnp.concatenate([cur, jnp.zeros((1, Kw), dtype=cur.dtype)])
+        cur = _reduce_level(cur, idx, w, chunk)
+        parts.append(cur)
+    parts.append(jnp.zeros((1, Kw), dtype=values.dtype))
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+
+class PullBFSResult(NamedTuple):
+    visited_t: jax.Array     # (N_pad, Kw) uint32 — TRANSPOSED packed bitmaps
+    edges_touched: jax.Array  # (K,) int32
+    reach_counts: jax.Array   # (K,) int32 — |visited| per seed (incl. seed)
+
+
+@dataclass
+class PullBFSPlans:
+    """Host-side precompute for :func:`bfs_pull` over one snapshot.
+
+    Expensive to build (two padded index pyramids + a composed link map)
+    but reusable across every BFS on the snapshot; cached on the snapshot
+    object by :func:`plans_for`.
+    """
+
+    n_atoms: int
+    n_pad: int
+    stage1: ReducePlan  # tgt relation: link rows ← atom value rows
+    stage2_levels: tuple[np.ndarray, ...]  # level0 composed into stage1 chunks
+    stage2_widths: tuple[int, ...]
+    out_map: np.ndarray
+    inc_deg: np.ndarray  # (N_pad,) int32 — incidence degree (edge counting)
+
+    @property
+    def total_indices(self) -> int:
+        return (
+            self.stage1.total_indices
+            + int(sum(len(l) for l in self.stage2_levels))
+            + len(self.out_map)
+        )
+
+
+def build_pull_plans(
+    snap: CSRSnapshot, w1: int = 8, w2: int = 8, w_upper: int = 8
+) -> PullBFSPlans:
+    N = snap.num_atoms
+    n_pad = _ceil_to(N + 1, 8)
+    e_tgt = snap.n_edges_tgt
+    e_inc = snap.n_edges_inc
+    # stage 1: link_live = OR of F over target rows (tgt CSR, rows=atoms)
+    s1 = build_reduce_plan(
+        snap.tgt_offsets[: N + 2], snap.tgt_flat[:e_tgt], N + 1,
+        zero_row=N, w=w1, w_upper=w_upper,
+    )
+    # stage 2 runs over the incidence CSR; its level-0 entries are LINK ids.
+    # Compose them through stage-1's concat-space out_map on host, so the
+    # hop consumes stage-1 chunks directly — no per-link destination array
+    # is ever materialized.
+    s2 = build_reduce_plan(
+        snap.inc_offsets[: N + 2], snap.inc_links[:e_inc], N + 1,
+        zero_row=N, w=w2, w_upper=w_upper,
+    )
+    # level-0 padding used zero_row=N (an atom id); atom N has no targets →
+    # its out_map entry is stage-1's zero row. Non-link atoms likewise.
+    lvl0 = s1.out_map[s2.levels[0]]
+    s2_levels = (lvl0,) + s2.levels[1:]
+
+    out_map = np.full(n_pad, s2.concat_size, dtype=np.int32)
+    out_map[: N + 1] = s2.out_map
+    out_map[N] = s2.concat_size  # dummy row must stay empty
+    inc_deg = np.zeros(n_pad, dtype=np.int32)
+    inc_deg[: N + 1] = (
+        snap.inc_offsets[1 : N + 2].astype(np.int64)
+        - snap.inc_offsets[: N + 1]
+    ).astype(np.int32)
+    inc_deg[N] = 0
+    return PullBFSPlans(
+        n_atoms=N,
+        n_pad=n_pad,
+        stage1=s1,
+        stage2_levels=s2_levels,
+        stage2_widths=s2.widths,
+        out_map=out_map,
+        inc_deg=inc_deg,
+    )
+
+
+def plans_for(snap: CSRSnapshot) -> PullBFSPlans:
+    plans = getattr(snap, "_pull_plans", None)
+    if plans is None:
+        plans = build_pull_plans(snap)
+        object.__setattr__(snap, "_pull_plans", plans)
+    return plans
+
+
+# ------------------------------------------------------------------ kernel
+
+
+def _bitdot(packed_t: jax.Array, vec: jax.Array, block_rows: int) -> jax.Array:
+    """Σ_v vec[v] · bit(v, k) for every seed column k.
+
+    ``packed_t (R, Kw) uint32``, ``vec (R,) float32`` → ``(K,) int32``.
+    Bit-unpack + matvec in row blocks so the unpack transient stays
+    ~``block_rows × K`` floats (MXU work, not gathers). Values are exact
+    while each block's partial sum stays below 2^24 (always true in the
+    test-scale graphs; at benchmark scale the relative error is ≤1e-7 of a
+    throughput counter).
+    """
+    R, Kw = packed_t.shape
+    K = Kw * WORD
+    n_blocks = -(-R // block_rows)
+    pad = n_blocks * block_rows - R
+    if pad:
+        packed_t = jnp.concatenate(
+            [packed_t, jnp.zeros((pad, Kw), jnp.uint32)]
+        )
+        vec = jnp.concatenate([vec, jnp.zeros((pad,), vec.dtype)])
+    pb = packed_t.reshape(n_blocks, block_rows, Kw)
+    vb = vec.reshape(n_blocks, block_rows)
+    shifts = jnp.arange(WORD, dtype=jnp.uint32)
+
+    def body(carry, sv):
+        sl, dg = sv
+        bits = ((sl[:, :, None] >> shifts) & 1).astype(jnp.float32)
+        part = jnp.einsum(
+            "rk,r->k", bits.reshape(block_rows, K), dg,
+            preferred_element_type=jnp.float32,
+        )
+        return carry + part.astype(jnp.int32), None
+
+    total, _ = jax.lax.scan(body, jnp.zeros((K,), jnp.int32), (pb, vb))
+    return total
+
+
+@partial(
+    jax.jit,
+    static_argnames=("max_hops", "widths1", "widths2", "chunk", "count_edges"),
+)
+def _bfs_pull_device(
+    levels1: tuple[jax.Array, ...],
+    widths1: tuple[int, ...],
+    levels2: tuple[jax.Array, ...],
+    widths2: tuple[int, ...],
+    out_map: jax.Array,      # (N_pad,) int32
+    inc_deg: jax.Array,      # (N_pad,) int32
+    seeds: jax.Array,        # (K,) int32 — K % 32 == 0
+    n_atoms: jax.Array,      # scalar int32 — dummy row id
+    max_hops: int,
+    chunk: int = 1 << 19,
+    count_edges: bool = True,
+) -> PullBFSResult:
+    K = seeds.shape[0]
+    Kw = K // WORD
+    n_pad = out_map.shape[0]
+    block_rows = max(1024, min(131072, _ceil_to(n_pad, 8) // 8))
+
+    # transposed seed bitmap: bit k of F[seeds[k]] — per-k bits are distinct,
+    # so scatter-add over (possibly duplicate) seed rows equals bitwise OR
+    k = jnp.arange(K, dtype=jnp.int32)
+    bit = jnp.left_shift(jnp.uint32(1), (k & 31).astype(jnp.uint32))
+    onehot = jnp.zeros((K, Kw), dtype=jnp.uint32).at[k, k >> 5].set(bit)
+    F = jnp.zeros((n_pad, Kw), dtype=jnp.uint32).at[seeds].add(onehot)
+    F = F.at[n_atoms].set(jnp.uint32(0))  # dummy row stays all-zero
+    visited = F
+
+    deg_f = inc_deg.astype(jnp.float32)
+
+    def hop(state, _):
+        F, visited, counts = state
+        if count_edges:
+            counts = counts + _bitdot(F, deg_f, block_rows)
+        live = _apply_plan(F, levels1, widths1, chunk)
+        reach_chunks = _apply_plan(live, levels2, widths2, chunk)
+        raw = reach_chunks[out_map]
+        nxt = raw & ~visited
+        nxt = nxt.at[n_atoms].set(jnp.uint32(0))
+        return (nxt, visited | nxt, counts), None
+
+    init = (F, visited, jnp.zeros((K,), dtype=jnp.int32))
+    (F, visited, counts), _ = jax.lax.scan(hop, init, None, length=max_hops)
+
+    reach = _bitdot(visited, jnp.ones((n_pad,), jnp.float32), block_rows)
+    return PullBFSResult(visited, counts, reach)
+
+
+# ------------------------------------------------------------------ host API
+
+
+def bfs_pull(
+    snap: CSRSnapshot,
+    seeds: np.ndarray,
+    max_hops: int,
+    chunk: int = 1 << 19,
+    k_block: int = 1024,
+    count_edges: bool = True,
+) -> PullBFSResult:
+    """Pull-mode multi-hop BFS over all seeds at once (blocked past
+    ``k_block`` so the (N_pad, K/32) state stays ~1.3 GB at 10M atoms).
+
+    Returns device arrays: (visited transposed (N_pad, K/32) uint32,
+    edges_touched (K,) int32, reach_counts (K,) int32). Use
+    :func:`visited_rows` to extract per-seed reachable sets on host.
+    """
+    plans = plans_for(snap)
+    seeds = np.asarray(seeds, dtype=np.int32)
+    K = len(seeds)
+    K_pad = _ceil_to(max(K, WORD), WORD)
+    if K_pad != K:
+        seeds = np.concatenate(
+            [seeds, np.full(K_pad - K, snap.num_atoms, dtype=np.int32)]
+        )
+    dev = _device_plans(snap, plans)
+    n_atoms = jnp.int32(plans.n_atoms)
+    blocks = []
+    for s in range(0, K_pad, k_block):
+        block = seeds[s : s + k_block]
+        blocks.append(
+            _bfs_pull_device(
+                dev["levels1"], plans.stage1.widths,
+                dev["levels2"], plans.stage2_widths,
+                dev["out_map"], dev["inc_deg"],
+                jnp.asarray(block), n_atoms, max_hops,
+                chunk=chunk, count_edges=count_edges,
+            )
+        )
+    if len(blocks) == 1:
+        res = blocks[0]
+    else:
+        res = PullBFSResult(
+            jnp.concatenate([b.visited_t for b in blocks], axis=1),
+            jnp.concatenate([b.edges_touched for b in blocks]),
+            jnp.concatenate([b.reach_counts for b in blocks]),
+        )
+    if K_pad != K:
+        res = PullBFSResult(
+            res.visited_t, res.edges_touched[:K], res.reach_counts[:K]
+        )
+    return res
+
+
+def _device_plans(snap: CSRSnapshot, plans: PullBFSPlans) -> dict:
+    cache = getattr(snap, "_pull_device", None)
+    if cache is None:
+        cache = {
+            "levels1": tuple(jnp.asarray(l) for l in plans.stage1.levels),
+            "levels2": tuple(jnp.asarray(l) for l in plans.stage2_levels),
+            "out_map": jnp.asarray(plans.out_map),
+            "inc_deg": jnp.asarray(plans.inc_deg),
+        }
+        object.__setattr__(snap, "_pull_device", cache)
+    return cache
+
+
+def visited_rows(res: PullBFSResult, n_atoms: int) -> list[np.ndarray]:
+    """Per-seed sorted reachable-atom arrays from the transposed bitmap."""
+    vt = np.asarray(res.visited_t)[: n_atoms]  # drop dummy+pad rows
+    K = vt.shape[1] * WORD
+    out = []
+    for k in range(K):
+        word = vt[:, k >> 5]
+        hit = (word >> np.uint32(k & 31)) & np.uint32(1)
+        out.append(np.nonzero(hit)[0].astype(np.int64))
+    return out

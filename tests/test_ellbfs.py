@@ -1,0 +1,109 @@
+"""Differential tests: pull-mode ELL BFS vs the r2 push-scan kernel and a
+pure-numpy host BFS, on random hypergraphs (the correctness oracle pattern
+from SURVEY §7 M4)."""
+
+import numpy as np
+import pytest
+
+from hypergraphdb_tpu.ops.snapshot import CSRSnapshot
+from hypergraphdb_tpu.ops.ellbfs import (
+    bfs_pull,
+    build_reduce_plan,
+    plans_for,
+    visited_rows,
+)
+
+
+def random_snapshot(n_nodes, n_links, max_arity, seed, zipf=False):
+    r = np.random.default_rng(seed)
+    N = n_nodes + n_links
+    type_of = np.zeros(N, dtype=np.int32)
+    is_link = np.zeros(N, dtype=bool)
+    is_link[n_nodes:] = True
+    arities = r.integers(2, max_arity + 1, size=n_links)
+    offsets = np.zeros(N + 1, dtype=np.int64)
+    offsets[n_nodes + 1 :] = np.cumsum(arities)
+    if zipf:
+        flat = (r.zipf(1.3, size=int(arities.sum())) % n_nodes).astype(np.int64)
+    else:
+        flat = r.integers(0, n_nodes, size=int(arities.sum()))
+    return CSRSnapshot.from_tables(type_of, is_link, offsets, flat)
+
+
+def host_bfs(snap, seed_atom, hops):
+    """Reference semantics: atom → incident links → targets."""
+    visited = {int(seed_atom)}
+    frontier = {int(seed_atom)}
+    edges = 0
+    for _ in range(hops):
+        nxt = set()
+        for a in frontier:
+            row = snap.incidence_row(a)
+            edges += len(row)
+            for l in row.tolist():
+                for t in snap.targets_row(int(l)).tolist():
+                    if t not in visited:
+                        nxt.add(int(t))
+        visited |= nxt
+        frontier = nxt
+    return visited, edges
+
+
+@pytest.mark.parametrize("zipf", [False, True])
+@pytest.mark.parametrize("hops", [1, 2, 3])
+def test_pull_matches_host(zipf, hops):
+    snap = random_snapshot(400, 300, 4, seed=11 + hops, zipf=zipf)
+    r = np.random.default_rng(5)
+    seeds = r.integers(0, 400, size=48).astype(np.int32)
+    res = bfs_pull(snap, seeds, hops)
+    rows = visited_rows(res, snap.num_atoms)
+    counts = np.asarray(res.edges_touched)
+    reach = np.asarray(res.reach_counts)
+    for k, s in enumerate(seeds.tolist()):
+        want, edges = host_bfs(snap, s, hops)
+        got = set(rows[k].tolist())
+        assert got == want, f"seed {s}: {got ^ want}"
+        assert counts[k] == edges
+        assert reach[k] == len(want)
+
+
+def test_pull_matches_bitfrontier():
+    from hypergraphdb_tpu.ops.bitfrontier import bfs_packed, unpack_visited
+
+    snap = random_snapshot(600, 500, 5, seed=3)
+    seeds = np.arange(0, 64, dtype=np.int32) * 7 % 600
+    res = bfs_pull(snap, seeds, 2)
+    vis_old, cnt_old, _ = bfs_packed(snap, seeds, 2, k_block=64)
+    old_bool = unpack_visited(vis_old, snap.num_atoms)
+    rows = visited_rows(res, snap.num_atoms)
+    for k in range(len(seeds)):
+        assert set(rows[k].tolist()) == set(np.nonzero(old_bool[k])[0].tolist())
+    assert np.array_equal(np.asarray(res.edges_touched), cnt_old.astype(np.int32))
+
+
+def test_duplicate_and_padded_seeds():
+    snap = random_snapshot(100, 80, 3, seed=9)
+    seeds = np.asarray([5, 5, 17], dtype=np.int32)  # dupes + K%32 != 0
+    res = bfs_pull(snap, seeds, 2)
+    rows = visited_rows(res, snap.num_atoms)
+    assert set(rows[0].tolist()) == set(rows[1].tolist())
+    w0, _ = host_bfs(snap, 5, 2)
+    assert set(rows[0].tolist()) == w0
+    assert res.edges_touched.shape == (3,)
+
+
+def test_reduce_plan_shapes():
+    offsets = np.asarray([0, 0, 3, 3, 20])  # empty, 3-row, empty, 17-row
+    flat = np.arange(20, dtype=np.int64) % 7
+    plan = build_reduce_plan(offsets, flat, 4, zero_row=7, w=4, w_upper=4)
+    # empty rows address the global zero row at concat_size
+    assert plan.out_map[0] == plan.concat_size
+    assert plan.out_map[2] == plan.concat_size
+    assert all(len(l) % w == 0 for l, w in zip(plan.levels, plan.widths))
+    # row 3 has 17 entries → 5 chunks at w=4 → needs 2 levels above level 0
+    assert len(plan.levels) >= 3
+
+
+def test_plans_cached():
+    snap = random_snapshot(50, 40, 3, seed=1)
+    assert plans_for(snap) is plans_for(snap)
