@@ -8,6 +8,7 @@ from hypergraphdb_tpu.ops.bitfrontier import (
     bfs_packed,
     unpack_visited,
 )
+from hypergraphdb_tpu.ops.ellbfs import PullBFSResult, bfs_pull, visited_rows
 from hypergraphdb_tpu.ops.incremental import SnapshotManager, bfs_levels_delta
 from hypergraphdb_tpu.ops.checkpoint import (
     copy_subgraph,
@@ -20,8 +21,11 @@ from hypergraphdb_tpu.ops.checkpoint import (
 __all__ = [
     "CSRSnapshot",
     "DeviceSnapshot",
+    "PullBFSResult",
     "SnapshotManager",
     "bfs_levels",
+    "bfs_pull",
+    "visited_rows",
     "bfs_memory_bytes",
     "bfs_packed",
     "unpack_visited",

@@ -40,7 +40,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
-from typing import NamedTuple, Optional, Sequence
+from typing import NamedTuple, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -246,8 +246,8 @@ def _apply_plan(
 
 
 class PullBFSResult(NamedTuple):
-    visited_t: jax.Array     # (N_pad, Kw) uint32 — TRANSPOSED packed bitmaps
-    edges_touched: jax.Array  # (K,) int32
+    visited_t: jax.Array      # (N_pad, Kw) uint32 — TRANSPOSED packed bitmaps
+    edges_touched: np.ndarray  # (K,) int64 — summed over hops on host
     reach_counts: jax.Array   # (K,) int32 — |visited| per seed (incl. seed)
 
 
@@ -385,7 +385,7 @@ def _bfs_pull_device(
     max_hops: int,
     chunk: int = 1 << 19,
     count_edges: bool = True,
-) -> PullBFSResult:
+) -> tuple[jax.Array, jax.Array, jax.Array]:
     K = seeds.shape[0]
     Kw = K // WORD
     n_pad = out_map.shape[0]
@@ -403,24 +403,40 @@ def _bfs_pull_device(
     deg_f = inc_deg.astype(jnp.float32)
 
     def hop(state, _):
-        F, visited, counts = state
+        F, visited = state
+        # a single hop's per-seed count is bounded by E_inc < 2^31, so the
+        # int32 carrier cannot wrap within a hop (bit-exactness is still
+        # subject to _bitdot's float32 accumulation, see its docstring);
+        # totals over MANY hops can exceed int32, so hops are summed on
+        # host in int64
         if count_edges:
-            counts = counts + _bitdot(F, deg_f, block_rows)
+            hop_counts = _bitdot(F, deg_f, block_rows)
+        else:
+            hop_counts = jnp.zeros((K,), dtype=jnp.int32)
         live = _apply_plan(F, levels1, widths1, chunk)
         reach_chunks = _apply_plan(live, levels2, widths2, chunk)
         raw = reach_chunks[out_map]
         nxt = raw & ~visited
         nxt = nxt.at[n_atoms].set(jnp.uint32(0))
-        return (nxt, visited | nxt, counts), None
+        return (nxt, visited | nxt), hop_counts
 
-    init = (F, visited, jnp.zeros((K,), dtype=jnp.int32))
-    (F, visited, counts), _ = jax.lax.scan(hop, init, None, length=max_hops)
+    init = (F, visited)
+    (F, visited), hop_counts = jax.lax.scan(hop, init, None, length=max_hops)
 
     reach = _bitdot(visited, jnp.ones((n_pad,), jnp.float32), block_rows)
-    return PullBFSResult(visited, counts, reach)
+    return visited, hop_counts, reach
 
 
 # ------------------------------------------------------------------ host API
+
+
+def block_layout(K: int, k_block: int) -> list[int]:
+    """The real seed-block widths :func:`bfs_pull` runs for (K, k_block):
+    K is padded to a multiple of WORD (floor WORD), then split into
+    k_block-wide blocks with a possibly-ragged tail. Exposed so traffic
+    models (bench.py) stay tied to the kernel's actual layout."""
+    K_pad = _ceil_to(max(K, WORD), WORD)
+    return [min(k_block, K_pad - s) for s in range(0, K_pad, k_block)]
 
 
 def bfs_pull(
@@ -434,10 +450,18 @@ def bfs_pull(
     """Pull-mode multi-hop BFS over all seeds at once (blocked past
     ``k_block`` so the (N_pad, K/32) state stays ~1.3 GB at 10M atoms).
 
-    Returns device arrays: (visited transposed (N_pad, K/32) uint32,
-    edges_touched (K,) int32, reach_counts (K,) int32). Use
-    :func:`visited_rows` to extract per-seed reachable sets on host.
+    Returns ``PullBFSResult(visited_t, edges_touched, reach_counts)``:
+    ``visited_t`` is a device (N_pad, K/32) uint32 transposed bitmap,
+    ``edges_touched`` a HOST (K,) int64 ndarray (per-hop int32 device
+    partials summed on host so deep traversals cannot wrap), and
+    ``reach_counts`` a device (K,) int32. Use :func:`visited_rows` to
+    extract per-seed reachable sets on host.
     """
+    if k_block <= 0 or k_block % WORD:
+        raise ValueError(
+            f"k_block must be a positive multiple of {WORD} (device words "
+            f"pack {WORD} seeds); got {k_block}"
+        )
     plans = plans_for(snap)
     seeds = np.asarray(seeds, dtype=np.int32)
     K = len(seeds)
@@ -460,13 +484,22 @@ def bfs_pull(
                 chunk=chunk, count_edges=count_edges,
             )
         )
+    # host int64 hop-sum AFTER all blocks are dispatched, so multi-block
+    # calls keep JAX's async-dispatch overlap
     if len(blocks) == 1:
-        res = blocks[0]
+        visited_t, hop_counts, reach = blocks[0]
+        res = PullBFSResult(
+            visited_t,
+            np.asarray(hop_counts).astype(np.int64).sum(axis=0),
+            reach,
+        )
     else:
         res = PullBFSResult(
-            jnp.concatenate([b.visited_t for b in blocks], axis=1),
-            jnp.concatenate([b.edges_touched for b in blocks]),
-            jnp.concatenate([b.reach_counts for b in blocks]),
+            jnp.concatenate([b[0] for b in blocks], axis=1),
+            np.concatenate(
+                [np.asarray(b[1]).astype(np.int64).sum(axis=0) for b in blocks]
+            ),
+            jnp.concatenate([b[2] for b in blocks]),
         )
     if K_pad != K:
         res = PullBFSResult(

@@ -92,6 +92,31 @@ def test_duplicate_and_padded_seeds():
     assert res.edges_touched.shape == (3,)
 
 
+def test_chunked_scan_and_multiblock():
+    """Exercise the chunk-streamed _reduce_level scan path (E > chunk*w) and
+    the multi-block k_block driver — the two paths that otherwise only
+    activate at benchmark scale."""
+    snap = random_snapshot(500, 400, 5, seed=21, zipf=True)
+    r = np.random.default_rng(17)
+    seeds = r.integers(0, 500, size=96).astype(np.int32)
+    res = bfs_pull(snap, seeds, 2, chunk=4, k_block=32)
+    rows = visited_rows(res, snap.num_atoms)
+    counts = np.asarray(res.edges_touched)
+    assert counts.dtype == np.int64
+    for k in (0, 31, 32, 63, 64, 95):  # spans all three k-blocks
+        want, edges = host_bfs(snap, int(seeds[k]), 2)
+        assert set(rows[k].tolist()) == want
+        assert counts[k] == edges
+
+
+def test_k_block_validation():
+    snap = random_snapshot(50, 40, 3, seed=2)
+    with pytest.raises(ValueError, match="k_block"):
+        bfs_pull(snap, np.arange(8, dtype=np.int32), 1, k_block=48)
+    with pytest.raises(ValueError, match="k_block"):
+        bfs_pull(snap, np.arange(8, dtype=np.int32), 1, k_block=0)
+
+
 def test_reduce_plan_shapes():
     offsets = np.asarray([0, 0, 3, 3, 20])  # empty, 3-row, empty, 17-row
     flat = np.arange(20, dtype=np.int64) % 7
