@@ -155,6 +155,38 @@ def test_and_incident_pattern_with_type(random_db):
         assert got.tolist() == expected
 
 
+def test_pattern_plan_execute_collect_roundtrip(random_db):
+    """plan/execute/collect (the steady-state serving path) must agree with
+    the one-shot wrapper, including when top_r=1 forces the overflow
+    re-materialization branch in collect_pattern."""
+    g, nodes, links, snap = random_db
+    pairs = []
+    for l in links[:40]:
+        ts = g.get_targets(l)
+        if len(ts) >= 2:
+            pairs.append((int(ts[0]), int(ts[1])))
+    want = S.and_incident_pattern(snap, pairs)
+    plan = S.plan_pattern(snap, pairs)
+    got = S.collect_pattern(plan, S.execute_pattern(plan))
+    got_overflow = S.collect_pattern(plan, S.execute_pattern(plan, top_r=1))
+    for w, a, b in zip(want, got, got_overflow):
+        assert a.tolist() == w.tolist()
+        assert b.tolist() == w.tolist()
+
+
+def test_ell_targets_width_cap(graph):
+    """Snapshots with a link wider than the ELL cap fall back (None) and
+    the pattern kernel still answers via the zigzag path."""
+    g = graph
+    ids = [g.add(i) for i in range(80)]
+    wide = g.add_link(tuple(ids), value="wide")  # arity 80 > default cap 64
+    l1 = g.add_link((ids[0], ids[1]), value="x")
+    snap = g.snapshot()
+    assert S.ell_targets(snap) is None
+    out = S.and_incident_pattern(snap, [(int(ids[0]), int(ids[1]))])
+    assert out[0].tolist() == sorted([int(wide), int(l1)])
+
+
 def test_member_mask_edges():
     import jax.numpy as jnp
 
