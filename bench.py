@@ -34,6 +34,25 @@ import numpy as np
 V5E_HBM_PEAK = 819e9  # bytes/s, v5e per-chip HBM bandwidth
 
 
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache: first-ever compile of the 10M-scale
+    kernels costs minutes over the axon tunnel; every later bench run reuses
+    the cached executables."""
+    try:
+        import jax
+
+        cache = os.environ.get(
+            "JAX_COMPILE_CACHE", os.path.join(os.path.dirname(__file__), ".jax_cache")
+        )
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+
+_enable_compile_cache()
+
+
 # ---------------------------------------------------------------- host engines
 
 
@@ -103,6 +122,24 @@ def host_bfs_python(g, seeds, max_hops):
             frontier = nxt
     dt = time.perf_counter() - t0
     return edges / dt if dt else 0.0, edges
+
+
+def host_value_pattern_vectorized(snap, queries, lo, hi):
+    """Vectorized numpy host engine for And(incident(a), incident(b),
+    value_rank in [lo, hi)): sorted intersection + rank-window filter per
+    query — the same job as the device value-pushdown kernel. Returns q/s."""
+    inc_off = snap.inc_offsets.astype(np.int64)
+    inc = snap.inc_links
+    rank = snap.value_rank
+    t0 = time.perf_counter()
+    for a, b in queries:
+        ra = inc[inc_off[a] : inc_off[a + 1]]
+        rb = inc[inc_off[b] : inc_off[b + 1]]
+        common = np.intersect1d(ra, rb, assume_unique=True)
+        r = rank[common]
+        _ = common[(r >= lo) & (r < hi)]
+    dt = time.perf_counter() - t0
+    return len(queries) / dt if dt else 0.0
 
 
 def host_pattern_vectorized(snap, queries, type_handle):
@@ -211,7 +248,7 @@ def bench_c3(snap, info):
     # download every rep; batches pipeline so dispatch latency amortizes
     plan = plan_pattern(snap, pairs, th)
     out = collect_pattern(plan, execute_pattern(plan))  # warmup + results
-    reps = int(os.environ.get("BENCH_C3_REPS", 32))
+    reps = int(os.environ.get("BENCH_C3_REPS", 64))
     t0 = time.perf_counter()
     all_pending = [execute_pattern(plan) for _ in range(reps)]
     jax.device_get([(c, f) for p in all_pending for _, c, f in p])
@@ -222,6 +259,47 @@ def bench_c3(snap, info):
     host_qps = host_pattern_vectorized(
         snap, pairs[:host_n].tolist(), th
     )
+
+    # value-predicate pushdown leg (VERDICT r2 item 3): the SAME anchor
+    # pairs constrained by property rank in [16, 48) — the device rank
+    # window rides the plan's bucketing (one bucket at this scale, so two
+    # dispatches per rep), vs the host doing intersection + rank filter
+    import jax.numpy as jnp
+
+    from hypergraphdb_tpu.ops.setops import (
+        ell_targets,
+        incident_value_pattern,
+    )
+
+    ell = ell_targets(snap)
+    lo, hi = 16, 48
+
+    def value_exec():
+        # [16, 48) == gte lo AND lt hi: two exact rank probes per bucket
+        outs = []
+        for _, anchors_dev, pad in plan.buckets:
+            _, keep_lo, _ = incident_value_pattern(
+                snap.device, ell, anchors_dev, pad,
+                jnp.uint8(0), jnp.uint32(0), jnp.uint32(lo), "gte", True, None,
+            )
+            _, keep_hi, _ = incident_value_pattern(
+                snap.device, ell, anchors_dev, pad,
+                jnp.uint8(0), jnp.uint32(0), jnp.uint32(hi), "lt", True, None,
+            )
+            outs.append((keep_lo & keep_hi).sum(axis=1))  # per-query counts
+        return outs
+
+    jax.block_until_ready(value_exec()[0])  # warmup
+    vreps = reps
+    t0 = time.perf_counter()
+    pend = [value_exec() for _ in range(vreps)]
+    jax.device_get(pend)
+    vdt = (time.perf_counter() - t0) / vreps
+    value_qps = K / vdt
+    host_value_qps = host_value_pattern_vectorized(
+        snap, pairs[:host_n].tolist(), lo, hi
+    )
+
     return {
         "queries_per_sec": round(device_qps, 1),
         "vs_vectorized_host": round(device_qps / host_qps, 2) if host_qps else None,
@@ -229,6 +307,10 @@ def bench_c3(snap, info):
         "nonempty_results": int(sum(len(o) > 0 for o in out)),
         "device_ms_per_batch": round(dt * 1e3, 2),
         "pipelined_reps": reps,
+        "value_queries_per_sec": round(value_qps, 1),
+        "value_vs_vectorized_host": (
+            round(value_qps / host_value_qps, 2) if host_value_qps else None
+        ),
     }
 
 
@@ -317,11 +399,140 @@ def bench_c4(snap, info, budget_s=240.0):
     }
 
 
+def bench_c5():
+    """BASELINE config 5: streaming ingest through the REAL store path
+    (core/bulkload — not array synthesis) with CONCURRENT device traversal
+    over the incremental (base, delta) pair. Reports ingest atoms/s while
+    queries run, query batches/s, staleness (delta edges pending at query
+    time), and proof of freshness (every probe batch must see a link added
+    after the base pack)."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from hypergraphdb_tpu import HyperGraph
+    from hypergraphdb_tpu.ops.incremental import bfs_levels_delta
+
+    n_entities = int(os.environ.get("BENCH_C5_ENTITIES", 200_000))
+    n_links = int(os.environ.get("BENCH_C5_LINKS", 400_000))
+    stream_batches = int(os.environ.get("BENCH_C5_BATCHES", 20))
+    batch_links = int(os.environ.get("BENCH_C5_BATCH_LINKS", 10_000))
+
+    g = HyperGraph()
+    r = np.random.default_rng(11)
+    t0 = time.perf_counter()
+    entities = g.bulk_import(values=np.arange(n_entities).tolist())
+    e0 = int(entities[0])
+    for s in range(0, n_links, 100_000):
+        m = min(100_000, n_links - s)
+        subj = r.integers(0, n_entities, size=m)
+        obj = r.integers(0, n_entities, size=m)
+        g.bulk_import(
+            values=[int(x) for x in range(s, s + m)],
+            target_lists=[[e0 + int(a), e0 + int(b)]
+                          for a, b in zip(subj, obj)],
+        )
+    build_s = time.perf_counter() - t0
+    base_atoms = n_entities + n_links
+
+    mgr = g.enable_incremental(
+        headroom=1.8, background=True, delta_bucket_min=1 << 18
+    )
+    base_version = mgr.base.version
+
+    ingested = {"atoms": 0, "done": False, "s": 0.0}
+
+    def writer():
+        t0 = time.perf_counter()
+        for b in range(stream_batches):
+            subj = r.integers(0, n_entities, size=batch_links)
+            obj = r.integers(0, n_entities, size=batch_links)
+            g.bulk_import(
+                values=[int(x) for x in range(batch_links)],
+                target_lists=[[e0 + int(a), e0 + int(b)]
+                              for a, b in zip(subj, obj)],
+            )
+            ingested["atoms"] += batch_links
+        ingested["s"] = time.perf_counter() - t0
+        ingested["done"] = True
+
+    K, HOPS = 256, 2
+    seeds = (e0 + r.integers(0, n_entities, size=K)).astype(np.int32)
+    # warmup compile (kernel AND the scalar probe ops) before the clock
+    dev, delta = mgr.device()
+    _, vis_w = bfs_levels_delta(dev, delta, jnp.asarray(seeds), HOPS)
+    bool(jnp.take(vis_w[0], jnp.int32(0)))
+
+    staleness = []
+    fresh_seen = 0
+    fresh_probes = 0
+    qbatches = 0
+    wt = threading.Thread(target=writer)
+    t0 = time.perf_counter()
+    wt.start()
+    while not ingested["done"]:
+        staleness.append(mgr.delta_edges)
+        dev, delta = mgr.device(max_lag_edges=batch_links)
+        # freshness probe: seed the batch at one endpoint of a link added
+        # AFTER the base pack; the other endpoint must come back visited —
+        # i.e. the traversal really flows through the delta overlay. Probe
+        # only atoms whose edges are inside the bounded-lag upload window
+        # (newer ones are legitimately not device-visible yet).
+        probe_target = None
+        for h in mgr.device_visible_new_atoms():
+            rec = g.store.get_link(h)
+            if rec is not None and len(rec) >= 5:
+                a, b = int(rec[3]), int(rec[4])
+                if a != b and a < dev.num_atoms and b < dev.num_atoms:
+                    seeds[0] = a
+                    probe_target = b
+                    break
+        levels, visited = bfs_levels_delta(
+            dev, delta, jnp.asarray(seeds), HOPS
+        )
+        # scalar download only — shipping the whole visited bitmap off the
+        # device every batch would measure the transfer link, not the DB.
+        # NB: the index must be a DEVICE value: a varying python int would
+        # bake into the executable and recompile every batch
+        hit = bool(jnp.take(visited[0], jnp.int32(probe_target or 0)))
+        qbatches += 1
+        if probe_target is not None:
+            fresh_probes += 1
+            if hit:
+                fresh_seen += 1
+    wt.join()
+    wall = time.perf_counter() - t0
+    compactions = mgr.compactions
+    final_version = mgr.base.version
+    g.close()
+
+    return {
+        "base_atoms": base_atoms,
+        "build_through_store_s": round(build_s, 1),
+        "build_atoms_per_sec": round(base_atoms / build_s, 1),
+        "concurrent_ingest_atoms_per_sec": round(
+            ingested["atoms"] / ingested["s"], 1
+        ) if ingested["s"] else None,
+        "query_batches_per_sec": round(qbatches / wall, 2),
+        "query_K": K,
+        "hops": HOPS,
+        "staleness_delta_edges_mean": int(np.mean(staleness)) if staleness else 0,
+        "staleness_delta_edges_max": int(np.max(staleness)) if staleness else 0,
+        "fresh_probes_passed": fresh_seen,
+        "fresh_probes": fresh_probes,
+        "query_batches": qbatches,
+        "compactions": compactions,
+        "base_advanced": final_version > base_version,
+    }
+
+
 def main() -> None:
     c2 = bench_c2()
     snap, info, build_s = _build_10m()
     c3 = bench_c3(snap, info)
     c4 = bench_c4(snap, info)
+    c5 = bench_c5()
     print(json.dumps({
         "metric": "bfs_3hop_1kseed_10m_edges_per_sec",
         "value": c4["edges_per_sec"],
@@ -331,6 +542,7 @@ def main() -> None:
             "c2_bfs_2hop_120k": c2,
             "c3_pattern_10m": c3,
             "c4_bfs_3hop_10m": c4,
+            "c5_streaming": c5,
         },
         "graph": {
             "n_atoms": info["n_atoms"],

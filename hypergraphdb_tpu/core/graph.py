@@ -101,6 +101,7 @@ class HyperGraph:
 
         self.metrics = Metrics()
         self._snapshot_cache = None
+        self._snapshot_mgr = None  # incremental mode (enable_incremental)
         self._mutations = 0  # bumped on every committed structural change
         self.events.dispatch(self, ev.HGOpenedEvent(graph=self))
         self._open = True
@@ -125,6 +126,9 @@ class HyperGraph:
         if not getattr(self, "_open", False):
             return
         self.events.dispatch(self, ev.HGClosingEvent(graph=self))
+        if self._snapshot_mgr is not None:
+            self._snapshot_mgr.close()
+            self._snapshot_mgr = None
         self.backend.shutdown()
         self._open = False
 
@@ -629,13 +633,43 @@ class HyperGraph:
                            type=type)
 
     # ------------------------------------------------------------------ device snapshot
+    def enable_incremental(self, headroom: float = 2.0,
+                           compact_ratio: float = 0.5,
+                           background: bool = True, **kw):
+        """Switch to incremental snapshot mode (BASELINE config 5): from
+        now on ``snapshot()`` returns the current immutable BASE of an
+        (base, delta) pair maintained by a :class:`SnapshotManager` — no
+        full repack on mutation. Device query plans merge the delta at
+        read time (LSM model), so query answers stay exact while ingest
+        runs. Returns the manager."""
+        if self._snapshot_mgr is None:
+            from hypergraphdb_tpu.ops.incremental import SnapshotManager
+
+            self._snapshot_mgr = SnapshotManager(
+                self, headroom=headroom, compact_ratio=compact_ratio,
+                background=background, **kw,
+            )
+        return self._snapshot_mgr
+
+    @property
+    def incremental(self):
+        """The active SnapshotManager, or None (exact-snapshot mode)."""
+        return self._snapshot_mgr
+
     def snapshot(self, refresh: bool = False):
         """Pack (or return the cached) immutable device CSR snapshot — a
-        long-lived read transaction living in HBM (SURVEY §7)."""
+        long-lived read transaction living in HBM (SURVEY §7). In
+        incremental mode the current base is returned (bounded-stale;
+        pair with ``graph.incremental.correction()`` for exact reads —
+        the query planner's device plans do this automatically)."""
         try:
             from hypergraphdb_tpu.ops.snapshot import CSRSnapshot
         except ImportError as e:  # pragma: no cover - build gating
             raise HGException("device snapshots not available in this build") from e
+
+        if self._snapshot_mgr is not None and not refresh:
+            self.metrics.incr("snapshot.cache_hits")
+            return self._snapshot_mgr.base
 
         snap = self._snapshot_cache
         if snap is not None and not refresh and snap.version == self._mutations:

@@ -51,6 +51,7 @@ def save_snapshot(snap: CSRSnapshot, path: str) -> None:
         "is_link": snap.is_link,
         "arity": snap.arity,
         "value_rank": snap.value_rank,
+        "value_kind": snap.value_kind,
         "by_type_keys": by_type_keys,
     }
     for k in by_type_keys.tolist():
@@ -80,6 +81,11 @@ def _snapshot_from_npz(z) -> CSRSnapshot:
         is_link=z["is_link"],
         arity=z["arity"],
         value_rank=z["value_rank"],
+        # absent in pre-r4 checkpoints: default to zeros (kind "unknown")
+        value_kind=(
+            z["value_kind"] if "value_kind" in z
+            else np.zeros(len(z["value_rank"]), dtype=np.uint8)
+        ),
         by_type=by_type,
         n_edges_inc=int(z["n_edges"][0]),
         n_edges_tgt=int(z["n_edges"][1]),

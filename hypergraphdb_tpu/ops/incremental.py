@@ -111,6 +111,15 @@ class SnapshotManager:
     events, accumulates host-side delta buffers, re-uploads the (bucketed)
     device delta when asked, and compacts when the delta outgrows the base.
 
+    This is the LSM read model (SURVEY §7 hard part 2, BASELINE config 5):
+    an immutable device-resident base (the long-lived read transaction) + a
+    small host memtable (delta), merged at read time. Readers NEVER stall
+    on ingest: with ``background=True`` compaction extracts the store
+    tables under the commit lock only (milliseconds) and assembles the new
+    base in a worker thread while readers keep the old epoch's
+    (base, delta) view — the analogue of the reference's BDB env serving
+    reads during checkpoints (``BJEConfig.java:27-35``).
+
     Usage::
 
         mgr = SnapshotManager(graph, headroom=2.0)
@@ -118,117 +127,301 @@ class SnapshotManager:
         levels, visited = bfs_levels_delta(dev, delta, seeds, 3)
     """
 
-    def __init__(self, graph, headroom: float = 2.0, compact_ratio: float = 0.5):
+    def __init__(self, graph, headroom: float = 2.0,
+                 compact_ratio: float = 0.5, background: bool = False,
+                 delta_bucket_min: int = 128):
+        import threading
+
         self.graph = graph
         self.headroom = headroom
         self.compact_ratio = compact_ratio
+        self.background = background
+        # floor for delta buffer padding: a large floor keeps ONE device
+        # shape for a whole streaming run (no recompiles as the delta grows)
+        self.delta_bucket_min = delta_bucket_min
         self.base: Optional[CSRSnapshot] = None
         self._capacity = 0
-        # host delta buffers
+        self._lock = threading.RLock()
+        self._compacting = False
+        self._compact_thread = None
+        # host delta buffers (the memtable)
         self._inc_links: list[int] = []
         self._inc_src: list[int] = []
         self._tgt_flat: list[int] = []
         self._tgt_src: list[int] = []
         self._dead: set[int] = set()
+        self._new_atoms: list[int] = []   # handles added since base pack
+        self._revalued: set[int] = set()  # values replaced since base pack
         self._delta_dirty = True
         self._device_delta: Optional[DeviceDelta] = None
         self.compactions = 0
         self._pack_highwater = 0
+        self._needs_recompact = False
+        self._uploaded_marker = (-1, -1, -1)
+        self._uploaded_atoms = 0
         graph.events.add_listener(ev.HGAtomAddedEvent, self._on_added)
         graph.events.add_listener(ev.HGAtomRemovedEvent, self._on_removed)
-        self._compact()
+        graph.events.add_listener(ev.HGAtomReplacedEvent, self._on_replaced)
+        self._compact_sync()
 
     def close(self) -> None:
         """Detach from the graph's event stream (managers are long-lived;
         an undetached manager would keep accumulating deltas forever)."""
+        t = self._compact_thread
+        if t is not None and t.is_alive():
+            t.join()
         self.graph.events.remove_listener(ev.HGAtomAddedEvent, self._on_added)
         self.graph.events.remove_listener(
             ev.HGAtomRemovedEvent, self._on_removed
         )
+        self.graph.events.remove_listener(
+            ev.HGAtomReplacedEvent, self._on_replaced
+        )
 
     # -- event intake ---------------------------------------------------------
+    # Lock order everywhere: commit lock → manager lock. Event handlers run
+    # with the commit lock potentially held by the committing thread, so
+    # they may take ONLY the manager lock and must never start a compaction
+    # (a sync compaction takes the commit lock — inversion → deadlock).
     def _on_added(self, g, event) -> None:
-        h = int(event.handle)
-        if h < self._pack_highwater:
-            # already inside the base: a mid-batch compaction packed the
-            # whole committed batch, the remaining events are echoes
-            return
-        if h >= self._capacity:
-            self._compact()
-            return
+        with self._lock:
+            h = int(event.handle)
+            if h < self._pack_highwater:
+                # already inside the base: a mid-batch compaction packed the
+                # whole committed batch, the remaining events are echoes
+                return
+            self._new_atoms.append(h)
+            if h >= self._capacity:
+                # beyond the bitmap width: device kernels cannot see it
+                # until the next compaction; host correction covers reads.
+                # The flag (not a direct compact call) keeps lock order.
+                self._needs_recompact = True
+                return
+            if self._buffer_edges(g, h):
+                self._dead.discard(h)
+                self._delta_dirty = True
+
+    def _buffer_edges(self, g, h: int) -> bool:
+        """Append atom h's incidence/target edge pairs to the memtable edge
+        buffers (caller holds the mgr lock). Returns False — and flags a
+        recompaction — when h or a target falls outside the bitmap."""
         rec = g.store.get_link(h)
         if rec is None:
-            return
+            return False
         targets = rec[3:]
-        for t in targets:
-            if t >= self._capacity:
-                self._compact()
-                return
+        if h >= self._capacity or any(t >= self._capacity for t in targets):
+            self._needs_recompact = True
+            return False
         for t in targets:
             # incidence edge (t ← h) + target edge (h → t)
             self._inc_links.append(h)
             self._inc_src.append(int(t))
             self._tgt_flat.append(int(t))
             self._tgt_src.append(h)
-        self._dead.discard(h)
-        self._delta_dirty = True
+        return True
 
     def _on_removed(self, g, event) -> None:
-        h = int(event.handle)
-        if h < self._capacity:
-            self._dead.add(h)
-            self._delta_dirty = True
-        else:
-            self._compact()
+        with self._lock:
+            h = int(event.handle)
+            if h < self._capacity:
+                self._dead.add(h)
+                self._delta_dirty = True
+
+    def _on_replaced(self, g, event) -> None:
+        # value changed in place: device value ranks for this atom are
+        # stale; value-predicate reads re-check it host-side
+        with self._lock:
+            self._revalued.add(int(event.handle))
 
     # -- compaction -----------------------------------------------------------
-    def _compact(self) -> None:
+    def _extract_locked(self) -> dict:
+        """Consistent store extraction + epoch bookkeeping snapshot. Caller
+        sequence: commit lock → mgr lock → this."""
         g = self.graph
-        cap = max(int(g.handles.peek * self.headroom), 1024)
-        self._pack_highwater = int(g.handles.peek)
-        self.base = CSRSnapshot.pack(g, version=g._mutations, capacity=cap)
-        self._capacity = self.base.num_atoms
-        self._inc_links.clear()
-        self._inc_src.clear()
-        self._tgt_flat.clear()
-        self._tgt_src.clear()
-        self._dead.clear()
-        self._delta_dirty = True
-        self.compactions += 1
+        tables = CSRSnapshot.extract_tables(g)
+        return {
+            "tables": tables,
+            "highwater": tables["peek"],
+            "dead_at_extract": set(self._dead),
+            "revalued_at_extract": set(self._revalued),
+            "version": g._mutations,
+        }
+
+    def _assemble_and_swap(self, ext: dict) -> None:
+        """CSR assembly (lock-free) + epoch swap (under mgr lock). The delta
+        edge buffers are REBUILT from the memtable at swap, so atoms that
+        committed while assembly ran — including ones beyond the old
+        capacity whose edges could never be buffered — are re-derived from
+        the store instead of lost."""
+        g = self.graph
+        hw = ext["highwater"]
+        cap = max(int(hw * self.headroom), 1024)
+        base = CSRSnapshot.pack(
+            g, version=ext["version"], capacity=cap, tables=ext["tables"]
+        )
+        with self._lock:
+            self.base = base
+            self._capacity = base.num_atoms
+            self._pack_highwater = hw
+            self._new_atoms = [h for h in self._new_atoms if h >= hw]
+            self._inc_links = []
+            self._inc_src = []
+            self._tgt_flat = []
+            self._tgt_src = []
+            self._needs_recompact = False
+            for h in self._new_atoms:
+                self._buffer_edges(g, h)
+            # removals/replaces recorded BEFORE extraction are baked into
+            # the new base; later ones must survive the swap
+            self._dead -= ext["dead_at_extract"]
+            self._revalued -= ext["revalued_at_extract"]
+            self._delta_dirty = True
+            self._uploaded_atoms = 0  # new epoch: nothing uploaded yet
+            self.compactions += 1
+
+    def _compact_sync(self) -> None:
+        with self.graph.txman._commit_lock:
+            with self._lock:
+                ext = self._extract_locked()
+        self._assemble_and_swap(ext)
+
+    def _request_compact(self) -> None:
+        if not self.background:
+            self._compact_sync()
+            return
+        with self._lock:
+            if self._compacting:
+                return
+            self._compacting = True
+        import threading
+
+        def work():
+            # _compacting is owned by THIS function alone: cleared in the
+            # finally after re-checking whether another pass is already due
+            # (a request that arrived mid-assembly was coalesced into the
+            # flag, so it must not be dropped)
+            try:
+                for _ in range(4):  # bounded catch-up, no livelock
+                    self._compact_sync()
+                    with self._lock:
+                        if not self._needs_recompact:
+                            break
+            finally:
+                with self._lock:
+                    self._compacting = False
+
+        self._compact_thread = threading.Thread(
+            target=work, name="hgdb-compact", daemon=True
+        )
+        self._compact_thread.start()
 
     def _maybe_compact(self) -> None:
-        base_edges = max(self.base.n_edges_inc, 1)
-        if len(self._inc_links) > self.compact_ratio * base_edges + 4096:
-            self._compact()
-
-    # -- device views ----------------------------------------------------------
-    def device(self) -> tuple[DeviceSnapshot, DeviceDelta]:
-        """The current (base, delta) device pair; cheap when unchanged."""
-        self._maybe_compact()
-        dev = self.base.device
-        if self._delta_dirty or self._device_delta is None:
-            N = self.base.num_atoms
-            n1 = N + 1
-
-            def up(xs, fill):
-                a = np.asarray(xs, dtype=np.int32)
-                return jnp.asarray(
-                    _pad_to(a, _bucket(max(len(a), 1)), fill)
-                )
-
-            dead = np.zeros(n1, dtype=bool)
-            if self._dead:
-                dead[np.fromiter(self._dead, dtype=np.int64)] = True
-            self._device_delta = DeviceDelta(
-                inc_links=up(self._inc_links, N),
-                inc_src=up(self._inc_src, N),
-                tgt_flat=up(self._tgt_flat, N),
-                tgt_src=up(self._tgt_src, N),
-                dead=jnp.asarray(dead),
+        with self._lock:
+            base_edges = max(self.base.n_edges_inc, 1)
+            # memtable growth counts EVERY host-corrected set, not just
+            # edges: a stream of node adds / replaces / removes would
+            # otherwise grow new_atoms/revalued/dead forever and turn
+            # value-query correction into a full host scan
+            memtable = (
+                len(self._new_atoms) + len(self._revalued) + len(self._dead)
             )
-            self._delta_dirty = False
-        return dev, self._device_delta
+            need = (
+                self._needs_recompact
+                or len(self._inc_links) > (
+                    self.compact_ratio * base_edges + 4096
+                )
+                or memtable > (
+                    self.compact_ratio * max(self.base.num_atoms, 1) + 4096
+                )
+            )
+        if need:
+            self._request_compact()
+
+    # -- read views ------------------------------------------------------------
+    def device(self, max_lag_edges: int = 0) -> tuple[DeviceSnapshot, DeviceDelta]:
+        """The current (base, delta) device pair; cheap when unchanged.
+
+        ``max_lag_edges`` > 0 bounds staleness instead of forcing an upload
+        per mutation: the device delta is re-uploaded only when the host
+        memtable has drifted more than that many entries from what is
+        already on device — the freshness/throughput dial of BASELINE
+        config 5 (readers tolerate a bounded lag; a mutation-rate-paced
+        uploader would otherwise serialize queries behind host→HBM
+        transfers)."""
+        self._maybe_compact()
+        with self._lock:
+            base = self.base
+            # epoch keyed on the monotonic compaction counter — id(base)
+            # could be REUSED by CPython after the old base is collected,
+            # silently pairing an old device delta with a new base
+            marker = (self.compactions, len(self._inc_links), len(self._dead))
+            stale = self._device_delta is None or marker[0] != self._uploaded_marker[0]
+            if not stale and self._delta_dirty:
+                drift = (
+                    marker[1] - self._uploaded_marker[1]
+                    + marker[2] - self._uploaded_marker[2]
+                )
+                stale = drift > max_lag_edges
+            if stale:
+                N = base.num_atoms
+
+                def up(xs, fill):
+                    a = np.asarray(xs, dtype=np.int32)
+                    b = _bucket(max(len(a), 1), minimum=self.delta_bucket_min)
+                    return jnp.asarray(_pad_to(a, b, fill))
+
+                dead = np.zeros(N + 1, dtype=bool)
+                if self._dead:
+                    dd = np.fromiter(self._dead, dtype=np.int64)
+                    dead[dd[dd <= N]] = True
+                self._device_delta = DeviceDelta(
+                    inc_links=up(self._inc_links, N),
+                    inc_src=up(self._inc_src, N),
+                    tgt_flat=up(self._tgt_flat, N),
+                    tgt_src=up(self._tgt_src, N),
+                    dead=jnp.asarray(dead),
+                )
+                self._delta_dirty = False
+                self._uploaded_marker = marker
+                self._uploaded_atoms = len(self._new_atoms)
+            return base.device, self._device_delta
+
+    def device_visible_new_atoms(self) -> list[int]:
+        """New atoms whose delta edges are ALREADY uploaded to the device
+        (edge buffers append in commit order, so the first
+        ``_uploaded_atoms`` entries of the memtable are on device) — what a
+        bounded-lag reader is entitled to see (bench c5's probe set)."""
+        with self._lock:
+            cap = self._capacity
+            return [
+                h for h in self._new_atoms[: self._uploaded_atoms]
+                if h < cap
+            ]
+
+    def correction(self) -> tuple[set, list, set]:
+        """Host-side read correction for device results computed on the
+        base: (dead, new_atoms, revalued). A reader drops dead ∪ revalued
+        from the device result, then host-evaluates its condition over
+        new_atoms ∪ revalued — the LSM memtable merge."""
+        with self._lock:
+            return set(self._dead), list(self._new_atoms), set(self._revalued)
+
+    def read_view(self) -> tuple[CSRSnapshot, set, list, set]:
+        """(base, dead, new_atoms, revalued) captured under ONE lock — the
+        snapshot-isolation read unit. A reader that takes base and
+        correction separately can straddle a background swap: the new
+        epoch's trimmed memtable would no longer compensate for the OLD
+        base it is about to query."""
+        self._maybe_compact()
+        with self._lock:
+            return (
+                self.base,
+                set(self._dead),
+                list(self._new_atoms),
+                set(self._revalued),
+            )
 
     @property
     def delta_edges(self) -> int:
-        return len(self._inc_links)
+        with self._lock:
+            return len(self._inc_links)

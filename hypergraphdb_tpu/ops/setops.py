@@ -244,6 +244,55 @@ def incident_intersection(
     return rows0, mask
 
 
+@partial(jax.jit, static_argnames=("pad_len", "op", "exact"))
+def incident_value_pattern(
+    dev: DeviceSnapshot,
+    tgt_ell: jax.Array,    # (N+1, W) int32
+    anchors: jax.Array,    # (K, P) int32 — anchors[:, 0] is the base
+    pad_len: int,
+    kind: jax.Array,       # scalar uint8 — the value kind byte
+    rank_hi: jax.Array,    # scalar uint32 — query rank, high word
+    rank_lo: jax.Array,    # scalar uint32 — low word
+    op: str,               # eq | lt | lte | gt | gte
+    exact: bool,           # fixed-width kind: rank order == value order, no ties
+    type_handle: Optional[jax.Array] = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Conjunctive incident pattern with a device-side VALUE predicate —
+    the pushdown the reference gets from value-indexed conjunctions
+    (``cond2qry/AndToQuery.java:102-306``). Value order is compared via the
+    order-preserving 64-bit payload ranks (``ops/snapshot.py`` value_rank):
+    for fixed-width kinds (``exact=True``) the comparison is the value
+    comparison; otherwise rank-ties return in ``tie_mask`` for host
+    verification. Returns (candidate rows, definite mask, tie mask)."""
+    rows0, mask = incident_intersection_ell(
+        dev, tgt_ell, anchors, pad_len, type_handle
+    )
+    safe = jnp.where(mask, rows0, dev.type_of.shape[0] - 1)
+    vh = dev.value_rank_hi[safe]
+    vl = dev.value_rank_lo[safe]
+    vk = dev.value_kind[safe]
+    mask = mask & (vk == kind)
+    gt = (vh > rank_hi) | ((vh == rank_hi) & (vl > rank_lo))
+    eq = (vh == rank_hi) & (vl == rank_lo)
+    if exact:
+        keep = {
+            "eq": eq,
+            "lt": ~gt & ~eq,
+            "lte": ~gt,
+            "gt": gt,
+            "gte": gt | eq,
+        }[op]
+        return rows0, mask & keep, jnp.zeros_like(mask)
+    strict = {
+        "eq": jnp.zeros_like(eq),
+        "lt": ~gt & ~eq,
+        "lte": ~gt & ~eq,
+        "gt": gt,
+        "gte": gt,
+    }[op]
+    return rows0, mask & strict, mask & eq
+
+
 @partial(jax.jit, static_argnames=("pad_len", "top_r"))
 def _pattern_compact(
     dev: DeviceSnapshot,

@@ -22,8 +22,14 @@ dump for padding):
 - ``is_link[N+1]`` — link flag per atom.
 - ``arity[N+1]`` — target count per atom.
 - ``value_rank[N+1]`` (uint64) — order-preserving 64-bit rank of each atom's
-  value key (``utils/ordered_bytes.rank64``), enabling device-side value
-  comparisons without host payloads (SURVEY §7 hard part 3).
+  value key PAYLOAD (``utils/ordered_bytes.rank64`` over the key minus its
+  kind byte), enabling device-side value comparisons without host payloads
+  (SURVEY §7 hard part 3). For fixed-width kinds (int/float/bool/time the
+  payload is ≤ 8 bytes) the rank is EXACT — device eq/range filters need no
+  host verification; variable-width kinds (str/bytes) tie on rank equality.
+- ``value_kind[N+1]`` (uint8) — the kind byte of each atom's value key, so
+  rank comparisons never cross kinds (ranks of different kinds are
+  incomparable once the kind prefix is stripped).
 - ``by_type``: type handle → sorted array of atom ids (the device form of
   the by-type system index).
 """
@@ -54,7 +60,7 @@ def _register_device_snapshot_pytree() -> None:
                 s.inc_offsets, s.inc_links, s.inc_src,
                 s.tgt_offsets, s.tgt_flat, s.tgt_src,
                 s.type_of, s.is_link, s.arity,
-                s.value_rank_hi, s.value_rank_lo,
+                s.value_rank_hi, s.value_rank_lo, s.value_kind,
             ),
             s.num_atoms,
         ),
@@ -123,6 +129,7 @@ class CSRSnapshot:
     is_link: np.ndarray
     arity: np.ndarray
     value_rank: np.ndarray
+    value_kind: np.ndarray = field(default_factory=lambda: np.empty(0, np.uint8))
     by_type: dict[int, np.ndarray] = field(default_factory=dict)
     n_edges_inc: int = 0    # real (unpadded) incidence entries
     n_edges_tgt: int = 0    # real (unpadded) target entries
@@ -133,7 +140,8 @@ class CSRSnapshot:
         is_link: np.ndarray,      # (N,) bool
         tgt_offsets: np.ndarray,  # (N+1,) int — target CSR offsets
         tgt_flat: np.ndarray,     # (E,) int — ordered targets per link
-        value_rank: Optional[np.ndarray] = None,  # (N,) uint64
+        value_rank: Optional[np.ndarray] = None,  # (N,) uint64 payload ranks
+        value_kind: Optional[np.ndarray] = None,  # (N,) uint8 kind bytes
         version: int = 0,
         pad_multiple: int = 128,
     ) -> "CSRSnapshot":
@@ -154,6 +162,9 @@ class CSRSnapshot:
         rank_col = np.zeros(N + 1, dtype=np.uint64)
         if value_rank is not None:
             rank_col[:N] = value_rank
+        kind_col = np.zeros(N + 1, dtype=np.uint8)
+        if value_kind is not None:
+            kind_col[:N] = value_kind
         off = np.zeros(N + 2, dtype=np.int32)
         off[1 : N + 1] = np.asarray(tgt_offsets[1:], dtype=np.int32)
         off[N + 1] = off[N]
@@ -178,6 +189,7 @@ class CSRSnapshot:
             is_link=link_col,
             arity=arity,
             value_rank=rank_col,
+            value_kind=kind_col,
             by_type=_group_by_type(type_col[:N]),
             n_edges_inc=e_inc,
             n_edges_tgt=e_tgt,
@@ -185,8 +197,37 @@ class CSRSnapshot:
 
     # ------------------------------------------------------------------ pack
     @staticmethod
+    def extract_tables(graph, value_ranks: bool = True) -> dict:
+        """Read the committed store into raw host tables — the ONLY part of
+        packing that must see a consistent store state. Background
+        compaction (``ops/incremental.SnapshotManager``) holds the commit
+        lock just for this extraction and runs the expensive CSR assembly
+        (``pack(tables=...)``) lock-free."""
+        backend = graph.backend
+        ids, offsets, flat = backend.bulk_links()
+        value_items = None
+        if value_ranks:
+            try:
+                from hypergraphdb_tpu.core.graph import IDX_BY_VALUE
+
+                idx = backend.get_index(IDX_BY_VALUE, create=False)
+                if idx is not None:
+                    value_items = list(idx.bulk_items())
+            except Exception:
+                value_items = None
+        peek = int(graph.handles.peek) if hasattr(graph.handles, "peek") else 0
+        return {
+            "ids": np.asarray(ids, dtype=np.int64),
+            "offsets": np.asarray(offsets, dtype=np.int64),
+            "flat": np.asarray(flat, dtype=np.int64),
+            "peek": max(peek, int(backend.max_handle())),
+            "value_items": value_items,
+        }
+
+    @staticmethod
     def pack(graph, version: Optional[int] = None, pad_multiple: int = 128,
-             capacity: Optional[int] = None, value_ranks: bool = True
+             capacity: Optional[int] = None, value_ranks: bool = True,
+             tables: Optional[dict] = None,
              ) -> "CSRSnapshot":
         """Pack the committed store into CSR arrays (the ``storage/tpu-jax``
         snapshot step from BASELINE.json's north star).
@@ -194,16 +235,15 @@ class CSRSnapshot:
         ``capacity`` over-allocates the id space so atoms added AFTER the
         pack still fit in this snapshot's bitmap width — the prerequisite
         for delta overlays (``ops/incremental.py``): base and delta share
-        one frontier shape, so no recompilation on ingest."""
-        backend = graph.backend
-        ids, offsets, flat = backend.bulk_links()
-        ids = np.asarray(ids, dtype=np.int64)
-        offsets = np.asarray(offsets, dtype=np.int64)
-        flat = np.asarray(flat, dtype=np.int64)
-        n = int(graph.handles.peek) if hasattr(graph.handles, "peek") else (
-            int(ids.max()) + 1 if len(ids) else 0
-        )
-        n = max(n, int(backend.max_handle()))
+        one frontier shape, so no recompilation on ingest. ``tables`` (from
+        :meth:`extract_tables`) lets callers separate the store read from
+        the assembly."""
+        if tables is None:
+            tables = CSRSnapshot.extract_tables(graph, value_ranks)
+        ids = tables["ids"]
+        offsets = tables["offsets"]
+        flat = tables["flat"]
+        n = tables["peek"]
         if capacity is not None:
             n = max(n, int(capacity))
         N = n  # id space; dummy row is N
@@ -212,6 +252,7 @@ class CSRSnapshot:
         is_link = np.zeros(N + 1, dtype=bool)
         arity = np.zeros(N + 1, dtype=np.int32)
         value_rank = np.zeros(N + 1, dtype=np.uint64)
+        value_kind = np.zeros(N + 1, dtype=np.uint8)
 
         # fully vectorized record decode (the 10M-atom scale path — no
         # per-atom Python): record layout is (type, value, flags, *targets),
@@ -256,17 +297,14 @@ class CSRSnapshot:
         e_inc = len(inc_links_arr)
 
         # value ranks via the by-value system index: one rank64 per DISTINCT
-        # key (values repeat heavily in real graphs), scattered to handles
-        if value_ranks:
-            try:
-                from hypergraphdb_tpu.core.graph import IDX_BY_VALUE
-
-                idx = backend.get_index(IDX_BY_VALUE, create=False)
-                if idx is not None:
-                    for key, hs in idx.bulk_items():
-                        value_rank[hs[hs <= N]] = rank64(key)
-            except Exception:
-                pass
+        # key (values repeat heavily in real graphs), scattered to handles.
+        # The kind byte is stripped into its own column so the 8 rank bytes
+        # all carry payload — exact (tie-free) for fixed-width kinds.
+        if tables["value_items"] is not None:
+            for key, hs in tables["value_items"]:
+                sel = hs[hs <= N]
+                value_rank[sel] = rank64(key[1:])
+                value_kind[sel] = key[0] if key else 0
 
         # pad edge arrays to lane multiples; padded entries point at the
         # dummy row N (whose frontier/visited value is always False)
@@ -293,6 +331,7 @@ class CSRSnapshot:
             is_link=is_link,
             arity=arity,
             value_rank=value_rank,
+            value_kind=value_kind,
             by_type=by_type,
             n_edges_inc=e_inc,
             n_edges_tgt=e_tgt,
@@ -337,6 +376,7 @@ class DeviceSnapshot:
     # destroying the ordering
     value_rank_hi: "jax.Array"  # noqa: F821
     value_rank_lo: "jax.Array"  # noqa: F821
+    value_kind: "jax.Array"  # noqa: F821 — uint8 kind byte per atom
 
     @staticmethod
     def from_host(snap: CSRSnapshot) -> "DeviceSnapshot":
@@ -358,6 +398,11 @@ class DeviceSnapshot:
             ),
             value_rank_lo=jnp.asarray(
                 (snap.value_rank & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+            ),
+            value_kind=jnp.asarray(
+                snap.value_kind
+                if len(snap.value_kind) == snap.num_atoms + 1
+                else np.zeros(snap.num_atoms + 1, dtype=np.uint8)
             ),
         )
 

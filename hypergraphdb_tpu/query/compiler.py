@@ -310,6 +310,126 @@ class IntersectPlan(Plan):
         return f"({inner})"
 
 
+#: value kinds whose key payload is fixed-width ≤ 8 bytes — their 64-bit
+#: payload rank IS the value order (device compares are exact, no ties)
+_FIXED_WIDTH_KINDS = frozenset(b"ifbt")
+
+
+@dataclass
+class DeviceValueConjPlan(Plan):
+    """``And(Incident..., AtomValue[range], [AtomType])`` pushed down to one
+    device kernel that range-compares the snapshot's order-preserving value
+    ranks (``ops/setops.incident_value_pattern``) — the TPU analogue of the
+    reference's value-indexed conjunctions (``cond2qry/AndToQuery.java:
+    102-306``). Fixed-width kinds run tie-free on device; variable-width
+    kinds host-verify only rank ties. Falls back to the classic plan when
+    the snapshot has no ELL targets (over-wide links) or the value type is
+    not device-encodable."""
+
+    targets: list[int]
+    value: Any
+    op: str
+    type_handle: Optional[int]
+    fallback: Plan
+
+    def run(self, graph):
+        from hypergraphdb_tpu.ops.setops import (
+            _bucket,
+            ell_targets,
+            incident_value_pattern,
+        )
+        from hypergraphdb_tpu.utils.ordered_bytes import rank64
+
+        cfg = graph.config.query
+        if self.estimate(graph) < cfg.device_min_batch:
+            return self.fallback.run(graph)  # planner duality: small → host
+        vt = graph.typesystem.infer(self.value)
+        if vt is None:
+            return self.fallback.run(graph)
+        mgr = graph.incremental
+        if mgr is not None:
+            # ONE-lock read view: base + memtable captured together, so a
+            # background compaction swapping mid-query cannot desync them
+            snap, dead, new_atoms, revalued = mgr.read_view()
+        else:
+            snap = graph.snapshot()
+            dead = new_atoms = revalued = None
+        if any(t >= snap.num_atoms for t in self.targets):
+            # anchor beyond the (stale) base's id space — host plan is fresh
+            return self.fallback.run(graph)
+        ell = ell_targets(snap)
+        if ell is None:
+            return self.fallback.run(graph)
+        import jax.numpy as jnp
+
+        key = vt.to_key(self.value)
+        kind, payload = key[0], key[1:]
+        exact = kind in _FIXED_WIDTH_KINDS
+        rank = rank64(payload)
+        # smallest incidence row is the gathered base (hub-proof)
+        anchors = np.asarray(self.targets, dtype=np.int32)
+        lens = snap.inc_offsets[anchors + 1] - snap.inc_offsets[anchors]
+        anchors = anchors[np.argsort(lens, kind="stable")]
+        pad = _bucket(int(lens.min()) if len(lens) else 1)
+        th = None if self.type_handle is None else jnp.int32(self.type_handle)
+        rows, keep, tie = incident_value_pattern(
+            snap.device, ell, jnp.asarray(anchors[None, :]), pad,
+            jnp.uint8(kind),
+            jnp.uint32(rank >> 32), jnp.uint32(rank & 0xFFFFFFFF),
+            self.op, exact, th,
+        )
+        rows = np.asarray(rows[0])
+        arr = rows[np.asarray(keep[0])].astype(np.int64)
+        ties = rows[np.asarray(tie[0])]
+        if len(ties):
+            vc = c.AtomValue(self.value, self.op)
+            verified = [
+                int(h) for h in ties.tolist() if vc.satisfies(graph, h)
+            ]
+            if verified:
+                arr = np.union1d(arr, np.asarray(verified, dtype=np.int64))
+        if new_atoms is not None:
+            # LSM read merge: the device result was computed on the BASE;
+            # drop tombstoned/revalued handles and host-evaluate the
+            # conjunction over the (small) memtable
+            drop = dead | revalued
+            if drop and len(arr):
+                arr = arr[~np.isin(arr, np.fromiter(drop, dtype=np.int64))]
+            cands = (set(new_atoms) | revalued) - dead
+            fresh = [h for h in cands if self._matches_host(graph, h)]
+            if fresh:
+                arr = np.union1d(arr, np.asarray(fresh, dtype=np.int64))
+        return arr
+
+    def _matches_host(self, graph, h: int) -> bool:
+        if not graph.contains(h):
+            return False
+        try:
+            ts = {int(t) for t in graph.get_targets(h)}
+        except Exception:
+            return False
+        if any(t not in ts for t in self.targets):
+            return False
+        if self.type_handle is not None and int(
+            graph.get_type_handle_of(h)
+        ) != self.type_handle:
+            return False
+        return c.AtomValue(self.value, self.op).satisfies(graph, h)
+
+    def estimate(self, graph):
+        return float(
+            min(graph.store.incidence_count(t) for t in self.targets)
+        )
+
+    def describe(self):
+        t = f", type({self.type_handle})" if self.type_handle is not None else ""
+        return (
+            f"device(value[{self.op}] ∩ "
+            + " ∩ ".join(f"incident({x})" for x in self.targets)
+            + t + ")"
+        )
+
+
 @dataclass
 class UnionPlan(Plan):
     children: list[Plan]
@@ -628,6 +748,56 @@ def _residual_predicate(cond: c.HGQueryCondition) -> Optional[c.HGQueryCondition
     return None
 
 
+def _translate_and(graph, clauses: Sequence[c.HGQueryCondition]) -> Plan:
+    sets: list[Plan] = []
+    preds: list[c.HGQueryCondition] = []
+    for cl in clauses:
+        p = _leaf_plan(graph, cl)
+        if p is None:
+            preds.append(cl)
+        else:
+            sets.append(p)
+            extra = _residual_predicate(cl)
+            if extra is not None:
+                preds.append(extra)
+    if not sets:
+        return FilterScanPlan(preds)
+    if len(sets) == 1 and not preds:
+        return sets[0]
+    return IntersectPlan(sets, preds)
+
+
+def _try_value_pushdown(graph, clauses: Sequence[c.HGQueryCondition]
+                        ) -> Optional[Plan]:
+    """Recognize ``And(Incident+, AtomValue, [AtomType])`` — exactly the
+    conjunction shape the device value kernel serves. Any other clause
+    present → None (the generic planner handles it)."""
+    if not graph.config.query.prefer_device:
+        return None
+    incs: list[int] = []
+    vals: list[c.AtomValue] = []
+    types: list[c.AtomType] = []
+    for cl in clauses:
+        if isinstance(cl, c.Incident):
+            incs.append(int(cl.target))
+        elif isinstance(cl, c.AtomValue):
+            vals.append(cl)
+        elif isinstance(cl, c.AtomType):
+            types.append(cl)
+        else:
+            return None
+    if len(vals) != 1 or not incs or len(types) > 1:
+        return None
+    th = types[0].type_handle(graph) if types else None
+    return DeviceValueConjPlan(
+        targets=incs,
+        value=vals[0].value,
+        op=vals[0].op,
+        type_handle=None if th is None else int(th),
+        fallback=_translate_and(graph, clauses),
+    )
+
+
 def translate(graph, cond: c.HGQueryCondition, parallel_or: bool = False) -> Plan:
     """Translate a simplified DNF condition into a physical plan
     (``QueryCompile.translate`` → ``ToQueryMap`` dispatch)."""
@@ -637,22 +807,10 @@ def translate(graph, cond: c.HGQueryCondition, parallel_or: bool = False) -> Pla
             parallel=parallel_or,
         )
     if isinstance(cond, c.And):
-        sets: list[Plan] = []
-        preds: list[c.HGQueryCondition] = []
-        for cl in cond.clauses:
-            p = _leaf_plan(graph, cl)
-            if p is None:
-                preds.append(cl)
-            else:
-                sets.append(p)
-                extra = _residual_predicate(cl)
-                if extra is not None:
-                    preds.append(extra)
-        if not sets:
-            return FilterScanPlan(preds)
-        if len(sets) == 1 and not preds:
-            return sets[0]
-        return IntersectPlan(sets, preds)
+        pushed = _try_value_pushdown(graph, cond.clauses)
+        if pushed is not None:
+            return pushed
+        return _translate_and(graph, cond.clauses)
     # single leaf
     p = _leaf_plan(graph, cond)
     if p is not None:

@@ -1,0 +1,123 @@
+"""Device value-predicate pushdown (VERDICT r2 item 3): conjunctions of
+``Incident + AtomValue[range] (+ AtomType)`` must run on the device value
+ranks, never through per-handle host ``satisfies`` for fixed-width kinds
+(the reference's value-indexed conjunctions, ``cond2qry/AndToQuery.java:
+102-306``)."""
+
+import numpy as np
+import pytest
+
+from hypergraphdb_tpu import HyperGraph
+from hypergraphdb_tpu.query import dsl as hg
+from hypergraphdb_tpu.query import conditions as c
+from hypergraphdb_tpu.query.compiler import (
+    DeviceValueConjPlan,
+    compile_query,
+)
+
+
+@pytest.fixture()
+def valued_db():
+    g = HyperGraph()
+    g.config.query.device_min_batch = 0  # force the device path at test scale
+    nodes = [g.add(f"n{i}") for i in range(24)]
+    rels = []
+    rng = np.random.default_rng(5)
+    for i in range(200):
+        a, b = rng.choice(24, size=2, replace=False)
+        rels.append(
+            g.add_link((nodes[a], nodes[b]), value=int(rng.integers(0, 50)))
+        )
+    yield g, nodes, rels
+    g.close()
+
+
+def _brute(g, rels, anchor, pred):
+    out = []
+    for l in rels:
+        atom = g.get(l)
+        if int(anchor) in [int(t) for t in atom.targets] and pred(atom.value):
+            out.append(int(l))
+    return sorted(out)
+
+
+OPS = {
+    "eq": lambda v, k: v == k,
+    "lt": lambda v, k: v < k,
+    "lte": lambda v, k: v <= k,
+    "gt": lambda v, k: v > k,
+    "gte": lambda v, k: v >= k,
+}
+
+
+@pytest.mark.parametrize("op", list(OPS))
+def test_int_value_pushdown_differential(valued_db, op):
+    g, nodes, rels = valued_db
+    for anchor in nodes[:6]:
+        cond = hg.and_(
+            hg.type_("int"), hg.value(25, op), hg.incident(anchor)
+        )
+        q = compile_query(g, cond)
+        assert isinstance(q.plan, DeviceValueConjPlan), q.analyze()
+        got = sorted(g.find_all(cond))
+        want = _brute(g, rels, anchor, lambda v: OPS[op](v, 25))
+        assert got == want, (op, int(anchor))
+
+
+def test_int_pushdown_never_calls_satisfies(valued_db, monkeypatch):
+    """Fixed-width kinds are tie-free on device: zero host satisfies()."""
+    g, nodes, rels = valued_db
+    calls = []
+    orig = c.AtomValue.satisfies
+    monkeypatch.setattr(
+        c.AtomValue, "satisfies",
+        lambda self, graph, h: calls.append(h) or orig(self, graph, h),
+    )
+    cond = hg.and_(hg.value(25, "lt"), hg.incident(nodes[0]))
+    got = sorted(g.find_all(cond))
+    assert calls == []
+    want = _brute(g, rels, nodes[0], lambda v: v < 25)
+    assert got == want
+
+
+def test_string_value_ties_verified_host_side():
+    """Variable-width kinds: rank ties (shared 8-byte prefix) must be
+    resolved exactly by host verification."""
+    g = HyperGraph()
+    g.config.query.device_min_batch = 0
+    n = g.add("anchor")
+    # all values share an 8-byte prefix → every rank comparison ties
+    vals = ["prefix__a", "prefix__b", "prefix__c", "prefix__"]
+    links = {v: g.add_link((n,), value=v) for v in vals}
+    got = sorted(g.find_all(hg.and_(hg.value("prefix__b", "lte"), hg.incident(n))))
+    want = sorted(int(links[v]) for v in vals if v <= "prefix__b")
+    assert got == want
+    got_eq = sorted(g.find_all(hg.and_(hg.value("prefix__b", "eq"), hg.incident(n))))
+    assert got_eq == [int(links["prefix__b"])]
+    g.close()
+
+
+def test_pushdown_shape_rejected_with_extra_clauses(valued_db):
+    """A conjunction with clauses outside the pushdown shape must take the
+    generic planner (correctness first)."""
+    g, nodes, rels = valued_db
+    cond = hg.and_(
+        hg.value(25, "lt"), hg.incident(nodes[0]), c.Arity(2, "eq")
+    )
+    q = compile_query(g, cond)
+    assert not isinstance(q.plan, DeviceValueConjPlan)
+    got = sorted(g.find_all(cond))
+    want = _brute(g, rels, nodes[0], lambda v: v < 25)  # all rels arity 2
+    assert got == want
+
+
+def test_typed_value_expands_into_pushdown(valued_db):
+    g, nodes, rels = valued_db
+    cond = hg.and_(
+        c.TypedValue(25, "int", "gte"), hg.incident(nodes[1])
+    )
+    q = compile_query(g, cond)
+    assert isinstance(q.plan, DeviceValueConjPlan), q.analyze()
+    got = sorted(g.find_all(cond))
+    want = _brute(g, rels, nodes[1], lambda v: v >= 25)
+    assert got == want
