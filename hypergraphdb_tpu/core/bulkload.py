@@ -74,6 +74,9 @@ def bulk_import(
             by_value = backend.get_index(IDX_BY_VALUE)
             tkey = _type_key(type_handle)
             flags = _FLAG_LINK if target_lists is not None else 0
+            value_keys: set = set()
+            touched_targets: set = set()
+            touched_user_idx: set = set()
             for i, h in enumerate(r):
                 v = values[i] if values is not None else None
                 vkey = atype.to_key(v)
@@ -90,19 +93,33 @@ def bulk_import(
                                    + targets)
                 by_type.add_entry(tkey, h)
                 by_value.add_entry(vkey, h)
+                value_keys.add(vkey)
                 for t in targets:
                     backend.add_incidence_link(t, h)
+                    touched_targets.add(t)
                 if has_indexers:
-                    maybe_index(graph, h, type_handle, v, targets or None)
+                    maybe_index(graph, h, type_handle, v, targets or None,
+                                touched=touched_user_idx)
         except BaseException:
             backend.commit_batch_abort()
             raise
         else:
             backend.commit_batch_end()
-        # one clock tick for the whole batch: later transactions see a
-        # version bump on the by-type cell they are most likely to re-read
+        # one clock tick for the whole batch, but EVERY cell the batch
+        # touched gets the version bump — an open transaction that read any
+        # of these (a value key it expects absent, a target's incidence
+        # set) must fail commit-time validation, not silently miss the
+        # bulk write (ADVICE r2: bulk_import isolation gap)
         graph.txman._clock += 1
-        graph.txman._versions[("idx", IDX_BY_TYPE, tkey)] = graph.txman._clock
+        clock = graph.txman._clock
+        versions = graph.txman._versions
+        versions[("idx", IDX_BY_TYPE, tkey)] = clock
+        for vk in value_keys:
+            versions[("idx", IDX_BY_VALUE, vk)] = clock
+        for name, key in touched_user_idx:
+            versions[("idx", name, key)] = clock
+        for t in touched_targets:
+            versions[("inc", t)] = clock
 
     def fire() -> None:
         if graph.events.has_listeners_for(ev.HGAtomAddedEvent):

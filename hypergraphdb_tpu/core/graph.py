@@ -409,11 +409,6 @@ class HyperGraph:
                 f"handle {h} is a registered type atom; types in use cannot "
                 "be removed"
             )
-        if (
-            self.events.dispatch(self, ev.HGAtomRemoveRequestEvent(h))
-            == ev.HGListener.CANCEL
-        ):
-            return False
         keep = (
             self.config.keep_incident_links_on_removal
             if keep_incident_links is None
@@ -421,12 +416,29 @@ class HyperGraph:
         )
 
         removed: set[int] = set()
+        rewritten: set[int] = set()
+        vetoed: list[bool] = []
 
         def run() -> None:
             removed.clear()  # retry-safe
-            self._remove_rec(h, keep, removed)
+            rewritten.clear()
+            vetoed.clear()
+            # the remove-request veto runs INSIDE the removal transaction:
+            # a listener guarding an atom (e.g. HGAtomRef pin counting,
+            # atom/utilities.py) must see transactionally-consistent state,
+            # and no commit may interleave between its verdict and the
+            # removal itself (ADVICE r2: pin-release invariant break)
+            if (
+                self.events.dispatch(self, ev.HGAtomRemoveRequestEvent(h))
+                == ev.HGListener.CANCEL
+            ):
+                vetoed.append(True)
+                return
+            self._remove_rec(h, keep, removed, rewritten)
 
         self.txman.ensure_transaction(run)
+        if vetoed:
+            return False
 
         def fire() -> None:
             # one event per removed atom (cascade included) — delta overlays
@@ -434,12 +446,19 @@ class HyperGraph:
             self._committed_mutation(ev.HGAtomRemovedEvent(h))
             for other in removed - {h}:
                 self._committed_mutation(ev.HGAtomRemovedEvent(other))
+            # keep_incident_links rewrote these links' target tuples in
+            # place: snapshot overlays must learn their columns are stale
+            for link in rewritten - removed:
+                self._committed_mutation(ev.HGAtomReplacedEvent(link))
 
         self._after_commit(fire)
         return True
 
     def _remove_rec(self, h: int, keep: bool, seen: set[int],
+                    rewritten: Optional[set[int]] = None,
                     root: bool = True) -> None:
+        if rewritten is None:
+            rewritten = set()
         if h in seen:
             return
         seen.add(h)
@@ -462,7 +481,7 @@ class HyperGraph:
         incident = self.store.get_incidence_set(h).array().tolist()
         for link in incident:
             if not keep:
-                self._remove_rec(int(link), keep, seen, root=False)
+                self._remove_rec(int(link), keep, seen, rewritten, root=False)
             else:
                 link = int(link)
                 lrec = self.store.get_link(link)
@@ -481,6 +500,7 @@ class HyperGraph:
                 self.store.store_link(link, lrec[:3] + newt)
                 maybe_index(self, link, lrec[0], lvalue, newt)
                 self._atom_cache.invalidate(link)
+                rewritten.add(link)
         # de-index
         atype = self.typesystem.get_type(type_handle)
         if value_handle != NULL_HANDLE:

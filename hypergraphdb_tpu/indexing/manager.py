@@ -231,13 +231,19 @@ def maybe_index(
     type_handle: HGHandle,
     value: Any,
     targets: Optional[Sequence[HGHandle]],
+    touched: Optional[set] = None,
 ) -> None:
-    """Called from the kernel's add path (``HyperGraph.java:1618``)."""
+    """Called from the kernel's add path (``HyperGraph.java:1618``).
+    ``touched`` (if given) collects the ``(index_name, key)`` cells written
+    — bulk loaders bump their transaction versions so open readers fail
+    validation instead of committing on stale index reads."""
     for indexer in indexers_of(graph, type_handle):
         idx = get_index(graph, indexer.name)
         for key in indexer.keys(graph, h, value, targets):
             for v in indexer.values(graph, h, value, targets):
                 idx.add_entry(key, v)
+            if touched is not None:
+                touched.add((indexer.name, key))
 
 
 def maybe_unindex(

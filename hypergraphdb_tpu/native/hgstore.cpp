@@ -21,9 +21,15 @@
 // hypergraphdb_tpu/storage/native.py. Single-writer, as the SPI specifies
 // (storage/api.py): the Python transaction manager serializes commits.
 //
-// WAL record framing: [u32 payload_len][u8 op][payload]. A torn tail
-// (partial record after a crash) is detected by length and truncated on
-// replay.
+// WAL record framing (v2, file starts with magic "HGW2"):
+//   [u32 len][u32 crc32][u32 seq][u8 op][payload]
+// len = 1 + payload bytes; crc32 covers (seq, op, payload); seq is a
+// per-log monotonically increasing record number (reset when a checkpoint
+// truncates the log). Replay verifies BOTH: a failed crc or a sequence
+// discontinuity marks the end of the valid prefix and the tail is
+// truncated — torn tails, bit rot, and interleaved/partial flushes are all
+// caught, not just short reads (the reference's BDB log is checksummed the
+// same way). Logs without the magic use the legacy length-only framing.
 
 #include <algorithm>
 #include <cstdint>
@@ -48,6 +54,33 @@ namespace {
 typedef int64_t i64;
 typedef uint32_t u32;
 typedef uint8_t u8;
+
+// WAL v2 file magic + CRC32 (IEEE 802.3 polynomial, table-driven)
+const char kWalMagic[4] = {'H', 'G', 'W', '2'};
+
+u32 crc32_update(u32 crc, const void* data, size_t n) {
+  static u32 table[256];
+  static bool init = false;
+  if (!init) {
+    for (u32 i = 0; i < 256; ++i) {
+      u32 c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init = true;
+  }
+  const u8* p = static_cast<const u8*>(data);
+  crc = ~crc;
+  for (size_t i = 0; i < n; ++i) crc = table[(crc ^ p[i]) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+u32 wal_record_crc(u32 seq, u8 op, const char* payload, size_t n) {
+  u32 c = crc32_update(0, &seq, 4);
+  c = crc32_update(c, &op, 1);
+  return crc32_update(c, payload, n);
+}
 
 enum Op : u8 {
   OP_STORE_LINK = 1,
@@ -119,6 +152,7 @@ struct Store {
   bool replaying = false;
   bool wal_ok = true;    // sticky: any WAL write failure latches false
   bool in_batch = false; // commit batch open: defer flush to batch commit
+  u32 wal_seq = 0;       // next record sequence number (v2 framing)
 
   std::unordered_map<i64, std::vector<i64>> links;
   std::unordered_map<i64, std::string> data;
@@ -187,7 +221,11 @@ void wal_append(Store* s, u8 op, const std::string& payload) {
     return;
   }
   u32 len = static_cast<u32>(payload.size()) + 1;
+  u32 seq = s->wal_seq++;
+  u32 crc = wal_record_crc(seq, op, payload.data(), payload.size());
   bool ok = fwrite(&len, 4, 1, s->wal) == 1 &&
+            fwrite(&crc, 4, 1, s->wal) == 1 &&
+            fwrite(&seq, 4, 1, s->wal) == 1 &&
             fwrite(&op, 1, 1, s->wal) == 1 &&
             fwrite(payload.data(), 1, payload.size(), s->wal) ==
                 payload.size();
@@ -456,6 +494,14 @@ bool replay_wal(Store* s) {
   const char* p = buf.data();
   const char* end = p + buf.size();
   long good = 0;
+  bool v2 = buf.size() >= 4 && memcmp(p, kWalMagic, 4) == 0;
+  if (v2) {
+    p += 4;
+    good = 4;
+  }
+  const size_t head = v2 ? 13 : 5;  // len+crc+seq+op | len+op
+  u32 expect_seq = 0;
+  u32 good_seq = 0;
   // Commit-batch replay: records between OP_BATCH_BEGIN and OP_BATCH_COMMIT
   // are buffered and applied atomically at the commit barrier; a crash
   // mid-commit leaves an unterminated batch, which is discarded — no
@@ -463,13 +509,24 @@ bool replay_wal(Store* s) {
   // ops, e.g. non-transactional mode) apply immediately.
   std::vector<std::pair<u8, std::pair<const char*, const char*>>> pending;
   bool batch = false;
-  while (end - p >= 5) {
+  while (static_cast<size_t>(end - p) >= head) {
     u32 len;
     memcpy(&len, p, 4);
-    if (static_cast<size_t>(end - (p + 4)) < len || len == 0) break;  // torn tail
-    u8 op = static_cast<u8>(p[4]);
-    const char* body = p + 5;
-    const char* body_end = p + 4 + len;
+    const char* rec = p + (head - 1);  // points at the op byte
+    if (static_cast<size_t>(end - rec) < len || len == 0) break;  // torn tail
+    u8 op = static_cast<u8>(rec[0]);
+    const char* body = rec + 1;
+    const char* body_end = rec + len;
+    if (v2) {
+      u32 crc, seq;
+      memcpy(&crc, p + 4, 4);
+      memcpy(&seq, p + 8, 4);
+      if (seq != expect_seq ||
+          crc != wal_record_crc(seq, op, body, body_end - body))
+        break;  // corruption: valid prefix ends here
+      ++expect_seq;
+    }
+    const long rec_total = static_cast<long>(head - 1) + len;
     if (op == OP_BATCH_BEGIN) {
       pending.clear();
       batch = true;
@@ -480,22 +537,29 @@ bool replay_wal(Store* s) {
       }
       pending.clear();
       batch = false;
-      good = (p + 4 + len) - buf.data();
+      good = (p + rec_total) - buf.data();
+      good_seq = expect_seq;
     } else if (op == OP_BATCH_ABORT) {
       pending.clear();
       batch = false;
-      good = (p + 4 + len) - buf.data();
+      good = (p + rec_total) - buf.data();
+      good_seq = expect_seq;
     } else if (batch) {
       pending.push_back(std::make_pair(
           op, std::make_pair(body, body_end)));
     } else {
       Reader r{body, body_end};
       apply_record(s, op, r);
-      good = (p + 4 + len) - buf.data();
+      good = (p + rec_total) - buf.data();
+      good_seq = expect_seq;
     }
-    p += 4 + len;
+    p += rec_total;
   }
   s->replaying = false;
+  // appends continue the sequence of the last KEPT record: everything past
+  // `good` (e.g. a verified-but-unterminated batch) is truncated below, so
+  // its sequence numbers are legitimately reused
+  if (v2) s->wal_seq = good_seq;
   if (good < sz) {
     // truncate the torn tail so the next append starts at a clean boundary
     if (truncate(s->wal_path().c_str(), good) != 0) return false;
@@ -526,6 +590,40 @@ Store* hgs_open(const char* path) {
     delete s;
     return nullptr;
   }
+  fseek(s->wal, 0, SEEK_END);
+  long wal_size = ftell(s->wal);
+  if (wal_size == 0) {
+    // fresh log: start with the v2 magic so every record is checksummed
+    if (fwrite(kWalMagic, 1, 4, s->wal) != 4 || fflush(s->wal) != 0)
+      s->wal_ok = false;
+    s->wal_seq = 0;
+  } else if (s->wal_seq == 0 && wal_size > 4) {
+    // non-empty log that replayed WITHOUT v2 sequencing = legacy framing.
+    // Its state is fully loaded, so convert once: checkpoint + truncate
+    // rewrites the log as v2 (appending unchecksummed frames forever
+    // would defeat the point of the CRC).
+    FILE* probe = fopen(s->wal_path().c_str(), "rb");
+    char m[4] = {0, 0, 0, 0};
+    bool is_v2 = probe && fread(m, 1, 4, probe) == 4 &&
+                 memcmp(m, kWalMagic, 4) == 0;
+    if (probe) fclose(probe);
+    if (!is_v2) {
+      fclose(s->wal);
+      s->wal = nullptr;
+      if (!save_checkpoint(s)) {
+        delete s;
+        return nullptr;
+      }
+      s->wal = fopen(s->wal_path().c_str(), "wb");
+      if (!s->wal) {
+        delete s;
+        return nullptr;
+      }
+      if (fwrite(kWalMagic, 1, 4, s->wal) != 4 || fflush(s->wal) != 0)
+        s->wal_ok = false;
+      s->wal_seq = 0;
+    }
+  }
   return s;
 }
 
@@ -544,6 +642,9 @@ int hgs_checkpoint(Store* s) {
     s->wal_ok = false;  // nothing can be logged until reopen
     return -1;
   }
+  if (fwrite(kWalMagic, 1, 4, s->wal) != 4 || fflush(s->wal) != 0)
+    s->wal_ok = false;
+  s->wal_seq = 0;  // a fresh log restarts the record sequence
   return 0;
 }
 

@@ -159,12 +159,21 @@ def _import_record(graph, w: dict, mapping: dict[int, int]) -> Optional[int]:
 
 
 def import_graph(graph, path: str) -> dict[int, int]:
-    """Load a JSONL dump; returns the old-handle → new-handle mapping."""
+    """Load a JSONL dump; returns the old-handle → new-handle mapping.
+
+    The whole import runs in ONE transaction: a mid-import failure (bad
+    record, unknown type, unresolvable target) rolls back every atom added
+    so far instead of leaving a partially imported graph (ADVICE r2)."""
     mapping: dict[int, int] = {}
-    with open(path, encoding="utf-8") as f:
-        for line in f:
-            if line.strip():
-                _import_record(graph, json.loads(line), mapping)
+
+    def run() -> None:
+        mapping.clear()  # retry-safe
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                if line.strip():
+                    _import_record(graph, json.loads(line), mapping)
+
+    graph.txman.transact(run)
     return mapping
 
 

@@ -98,7 +98,12 @@ class Replication:
         if self._applying:
             return
         h = int(event.handle)
-        gid = transfer.gid_of(self.peer.graph, h, self.peer.identity)
+        gid = transfer.existing_gid(self.peer.graph, h)
+        if gid is None:
+            # the atom never crossed the wire: no peer can hold a copy, so
+            # there is nothing to retract (and minting a gid for it would
+            # pollute the atom map — ADVICE r2)
+            return
         entry = {"gid": gid}
         self.log.append("remove", entry)
         for pid in list(self.peer_interests):

@@ -20,6 +20,16 @@ def global_id(origin_peer: str, origin_handle: int) -> str:
     return f"{origin_peer}:{int(origin_handle)}"
 
 
+def existing_gid(graph, h: int):
+    """The atom's global id IF it ever crossed the replication boundary,
+    else None — a pure lookup. Removal paths must use this: minting a
+    fresh gid for a never-replicated atom would announce the death of an
+    identity no peer has ever heard of AND permanently pollute the atom
+    map with an entry for a now-gone handle (ADVICE r2)."""
+    keys = _atom_map(graph).find_by_value(int(h))
+    return keys[0].decode("utf-8") if keys else None
+
+
 def gid_of(graph, h: int, origin_peer: str) -> str:
     """The atom's global id. Atoms that arrived FROM another peer (or were
     exported before) already have a mapping in the atom map — reuse it, so

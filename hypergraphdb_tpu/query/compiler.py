@@ -497,12 +497,86 @@ def intersect_sorted(graph, a: np.ndarray, b: np.ndarray) -> np.ndarray:
     return np.intersect1d(a, b, assume_unique=True)
 
 
+#: conditions decidable from snapshot columns alone (no payload access)
+_VECTOR_PREDICATES = (c.Arity, c.IsLink, c.IsNode, c.AtomType,
+                      c.PositionedIncident)
+
+_NP_OPS = {
+    "eq": np.equal, "lt": np.less, "lte": np.less_equal,
+    "gt": np.greater, "gte": np.greater_equal,
+}
+
+
+def _vector_predicate_mask(graph, snap, arr: np.ndarray,
+                           pred: c.HGQueryCondition) -> np.ndarray:
+    """Columnar evaluation of one residual predicate over handle array
+    ``arr`` — the batched replacement for per-handle ``satisfies`` calls
+    (VERDICT r2 item 7). ``arr`` values must be < snap.num_atoms."""
+    if isinstance(pred, c.Arity):
+        return _NP_OPS[pred.op](snap.arity[arr], pred.arity)
+    if isinstance(pred, c.IsLink):
+        return snap.is_link[arr].copy()
+    if isinstance(pred, c.IsNode):
+        return ~snap.is_link[arr]
+    if isinstance(pred, c.AtomType):
+        return snap.type_of[arr] == int(pred.type_handle(graph))
+    if isinstance(pred, c.PositionedIncident):
+        pos = int(pred.position)
+        ok = snap.arity[arr] > pos
+        off = snap.tgt_offsets[arr].astype(np.int64) + pos
+        vals = snap.tgt_flat[np.where(ok, off, 0)]
+        return ok & (vals == int(pred.target))
+    raise QueryError(f"not a vectorizable predicate: {pred!r}")
+
+
+def _columns_for_filter(graph, n_handles: int):
+    """A snapshot usable for columnar filtering + the memtable handle set
+    that must fall back to per-handle evaluation (exactness under
+    incremental mode). None → no cheap columns; use the Python loop."""
+    mgr = graph.incremental
+    if mgr is not None:
+        base, dead, new_atoms, revalued = mgr.read_view()
+        return base, set(new_atoms) | revalued | dead
+    snap = graph._snapshot_cache
+    if snap is not None and snap.version == graph._mutations:
+        return snap, set()
+    # no fresh columns: packing amortizes only over big filter batches
+    if n_handles >= 4096:
+        return graph.snapshot(), set()
+    return None
+
+
 def filter_predicates(
     graph, arr: np.ndarray, predicates: Sequence[c.HGQueryCondition]
 ) -> np.ndarray:
     if not predicates or len(arr) == 0:
         return arr
-    keep = [h for h in arr.tolist() if all(p.satisfies(graph, h) for p in predicates)]
+    vec = [p for p in predicates if isinstance(p, _VECTOR_PREDICATES)]
+    rest = [p for p in predicates if not isinstance(p, _VECTOR_PREDICATES)]
+    if vec:
+        cols = _columns_for_filter(graph, len(arr))
+        if cols is None:
+            rest = predicates  # no columns: everything via satisfies
+        else:
+            snap, memtable = cols
+            in_cols = arr < snap.num_atoms
+            if memtable and in_cols.any():
+                mt = np.fromiter(memtable, dtype=np.int64)
+                in_cols &= ~np.isin(arr, mt)
+            mask = in_cols.copy()
+            sel = arr[in_cols]
+            keep = np.ones(len(sel), dtype=bool)
+            for p in vec:
+                keep &= _vector_predicate_mask(graph, snap, sel, p)
+            mask[in_cols] = keep
+            # memtable / out-of-range handles: exact per-handle evaluation
+            outside = np.nonzero(~in_cols)[0]
+            for i in outside.tolist():
+                mask[i] = all(p.satisfies(graph, int(arr[i])) for p in vec)
+            arr = arr[mask]
+    if not rest or len(arr) == 0:
+        return arr
+    keep = [h for h in arr.tolist() if all(p.satisfies(graph, h) for p in rest)]
     return np.asarray(keep, dtype=np.int64)
 
 

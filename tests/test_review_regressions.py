@@ -60,3 +60,123 @@ def test_device_value_rank_not_truncated(graph):
     assert (ra < rb) == (
         (hi[int(a)], lo[int(a)]) < (hi[int(b)], lo[int(b)])
     )
+
+
+# -------------------------------------------------- round-2 ADVICE findings
+
+
+def test_remove_veto_runs_inside_tx(graph):
+    """The remove-request veto must execute inside the removal transaction
+    (a listener guarding pinned atoms needs transactional state)."""
+    from hypergraphdb_tpu.core import events as ev
+
+    a = graph.add("pinned")
+    seen_in_tx = []
+
+    def veto(g, event):
+        seen_in_tx.append(g.txman.current() is not None)
+        return ev.HGListener.CANCEL
+
+    graph.events.add_listener(ev.HGAtomRemoveRequestEvent, veto)
+    assert graph.remove(a) is False
+    assert graph.contains(a)
+    assert seen_in_tx == [True]
+
+
+def test_bulk_import_invalidates_readers(graph):
+    """A transaction that read 'value absent' before a bulk_import of that
+    value must FAIL validation, not commit on stale reads."""
+    from hypergraphdb_tpu.query import dsl as hg
+
+    import threading
+
+    tx = graph.txman.begin()
+    assert hg.find_all(graph, hg.value(123456)) == []  # read: absent
+    # the bulk load happens on ANOTHER thread (same-thread bulk_import
+    # correctly joins the open transaction instead)
+    t = threading.Thread(
+        target=lambda: graph.bulk_import(values=[123456, 123457])
+    )
+    t.start()
+    t.join()
+    graph.add("marker")  # a write so commit validation runs
+
+    import pytest as _pytest
+    from hypergraphdb_tpu.core.errors import TransactionConflict
+    with _pytest.raises(TransactionConflict):
+        graph.txman.commit(tx)
+
+
+def test_import_graph_rolls_back_on_failure(graph, tmp_path):
+    """A corrupt record mid-file must leave the graph unchanged."""
+    import json
+
+    from hypergraphdb_tpu.ops.checkpoint import export_graph, import_graph
+
+    src_atoms = [graph.add(f"v{i}") for i in range(5)]
+    path = str(tmp_path / "dump.jsonl")
+    export_graph(graph, path)
+    # corrupt the last record: link referencing an unknown original handle
+    with open(path, "a", encoding="utf-8") as f:
+        f.write(json.dumps({
+            "h": 999999, "type": "int", "v": None, "link": True,
+            "t": [424242],
+        }) + "\n")
+
+    from hypergraphdb_tpu import HyperGraph
+    dst = HyperGraph()
+    before = sorted(dst.atoms())
+    import pytest as _pytest
+    with _pytest.raises(Exception):
+        import_graph(dst, path)
+    assert sorted(dst.atoms()) == before  # nothing leaked
+    dst.close()
+
+
+def test_removed_unreplicated_atom_mints_no_gid():
+    """Removing an atom that never crossed the replication boundary must
+    not mint a gid nor push a retraction."""
+    import hypergraphdb_tpu as hgdb
+    from hypergraphdb_tpu.peer import HyperGraphPeer, LoopbackNetwork, transfer
+
+    net = LoopbackNetwork()
+    g = hgdb.HyperGraph()
+    # the atom predates the peer: it never crossed the replication boundary
+    a = g.add("local-only")
+    p1 = HyperGraphPeer.loopback(g, net, identity="p1")
+    p1.start()
+    try:
+        rep = p1.replication
+        assert transfer.existing_gid(g, int(a)) is None
+        n_log = len(rep.log.entries)
+        g.remove(a)
+        assert transfer.existing_gid(g, int(a)) is None  # no mint
+        removes = [
+            e for e in rep.log.entries[n_log:] if e[1] == "remove"
+        ]
+        assert removes == []
+    finally:
+        p1.stop()
+        g.close()
+
+
+def test_keep_incident_links_rewrite_fires_replaced_event(graph):
+    """remove(keep_incident_links=True) rewrites incident links' target
+    tuples in place; snapshot overlays must be told (via replaced events)
+    or columnar Arity/PositionedIncident filters serve stale answers."""
+    import numpy as np
+
+    from hypergraphdb_tpu.query import conditions as c
+    from hypergraphdb_tpu.query.compiler import filter_predicates
+
+    a, b, x = graph.add("a"), graph.add("b"), graph.add("x")
+    l = graph.add_link((a, b, x), value="rel")
+    graph.enable_incremental(headroom=10.0, background=False)
+    graph.snapshot()
+    graph.remove(x, keep_incident_links=True)  # l becomes (a, b)
+
+    arr = np.asarray([int(l)], dtype=np.int64)
+    got3 = filter_predicates(graph, arr, [c.Arity(3, "eq")])
+    got2 = filter_predicates(graph, arr, [c.Arity(2, "eq")])
+    assert got3.tolist() == []          # stale column answer would keep l
+    assert got2.tolist() == [int(l)]

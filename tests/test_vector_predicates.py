@@ -1,0 +1,103 @@
+"""Vectorized residual predicates (VERDICT r2 item 7): Arity / IsLink /
+IsNode / AtomType / PositionedIncident must evaluate against snapshot
+columns, not one Python ``satisfies`` call per handle."""
+
+import time
+
+import numpy as np
+import pytest
+
+from hypergraphdb_tpu import HyperGraph
+from hypergraphdb_tpu.query import conditions as c
+from hypergraphdb_tpu.query.compiler import filter_predicates
+from hypergraphdb_tpu.query import dsl as hg
+
+
+@pytest.fixture()
+def filled():
+    g = HyperGraph()
+    nodes = [g.add(f"n{i}") for i in range(40)]
+    links = []
+    rng = np.random.default_rng(3)
+    for i in range(300):
+        k = int(rng.integers(2, 5))
+        ts = rng.choice(40, size=k, replace=False)
+        links.append(g.add_link(tuple(nodes[t] for t in ts), value=i))
+    g.snapshot()  # fresh column cache
+    yield g, nodes, links
+    g.close()
+
+
+def _loop(g, arr, preds):
+    return np.asarray(
+        [h for h in arr.tolist() if all(p.satisfies(g, h) for p in preds)],
+        dtype=np.int64,
+    )
+
+
+@pytest.mark.parametrize("pred", [
+    c.Arity(2, "eq"),
+    c.Arity(3, "gte"),
+    c.IsLink(),
+    c.IsNode(),
+    c.AtomType("int"),
+])
+def test_vector_matches_loop(filled, pred):
+    g, nodes, links = filled
+    arr = np.asarray(sorted(int(x) for x in nodes + links), dtype=np.int64)
+    got = filter_predicates(g, arr, [pred])
+    want = _loop(g, arr, [pred])
+    assert got.tolist() == want.tolist()
+
+
+def test_positioned_incident_vectorized(filled):
+    g, nodes, links = filled
+    arr = np.asarray(sorted(int(x) for x in links), dtype=np.int64)
+    for pos in (0, 1, 3):
+        pred = c.PositionedIncident(int(nodes[5]), pos)
+        got = filter_predicates(g, arr, [pred])
+        want = _loop(g, arr, [pred])
+        assert got.tolist() == want.tolist(), pos
+
+
+def test_vector_filter_exact_under_incremental(filled):
+    """Handles touched after the base pack must be evaluated exactly."""
+    g, nodes, links = filled
+    g.enable_incremental(headroom=5.0, background=False)
+    l_new = g.add_link((nodes[0], nodes[1], nodes[2]), value=777)
+    g.remove(links[0])
+    arr = np.asarray(
+        sorted(int(x) for x in links[1:] + [l_new]), dtype=np.int64
+    )
+    pred = c.Arity(3, "eq")
+    got = filter_predicates(g, arr, [pred])
+    want = _loop(g, arr, [pred])
+    assert got.tolist() == want.tolist()
+    assert int(l_new) in got.tolist()
+
+
+def test_vector_filter_speedup():
+    """The VERDICT bar: a large predicate filter must beat the per-handle
+    Python loop by >= 50x (typically far more)."""
+    g = HyperGraph()
+    n = 200_000
+    g.bulk_import(values=list(range(n)))
+    nodes = np.arange(n, dtype=np.int64) + 0  # handles not exact; re-derive
+    arr = np.fromiter(g.atoms(), dtype=np.int64)
+    g.snapshot()
+    preds = [c.IsNode(), c.Arity(0, "eq")]
+
+    t0 = time.perf_counter()
+    fast = filter_predicates(g, arr, preds)
+    t_fast = time.perf_counter() - t0
+
+    sub = arr[:20_000]  # loop timed on a slice, extrapolated
+    t0 = time.perf_counter()
+    slow = _loop(g, sub, preds)
+    t_slow = (time.perf_counter() - t0) * (len(arr) / len(sub))
+
+    assert set(sub.tolist()) <= set(fast.tolist())
+    assert len(fast) >= n
+    ratio = t_slow / max(t_fast, 1e-9)
+    assert ratio >= 50, f"vectorized filter only {ratio:.1f}x faster"
+    g.close()
