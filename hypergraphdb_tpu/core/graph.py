@@ -103,8 +103,18 @@ class HyperGraph:
         self._snapshot_cache = None
         self._snapshot_mgr = None  # incremental mode (enable_incremental)
         self._mutations = 0  # bumped on every committed structural change
-        self.events.dispatch(self, ev.HGOpenedEvent(graph=self))
         self._open = True
+        # restore the database's self-knowledge from the store (the
+        # reference's HGIndexManager.loadIndexers + class↔type index
+        # recovery at open, HGTypeSystem.java:97-98): registered indexers
+        # answer queries and the subtype closure is intact after reopen
+        from hypergraphdb_tpu.indexing.manager import load_indexers
+
+        load_indexers(self)
+        from hypergraphdb_tpu.atom.utilities import load_subsumptions
+
+        load_subsumptions(self)
+        self.events.dispatch(self, ev.HGOpenedEvent(graph=self))
 
     @staticmethod
     def _make_backend(config: HGConfiguration) -> StorageBackend:

@@ -127,6 +127,95 @@ class TargetToTargetIndexer(HGIndexer):
         return [int(targets[self.value_pos])]
 
 
+# -- persistence ---------------------------------------------------------------
+
+#: storage index holding one JSON descriptor per registered indexer — the
+#: analogue of the reference persisting indexer atoms so registrations
+#: survive reopen (``HGIndexManager.java:62-215`` ``loadIndexers``)
+_REG_INDEX = "hg.sys.indexers"
+
+
+def _to_config(ix: HGIndexer) -> Optional[dict]:
+    """JSON-able descriptor for the built-in indexer kinds; custom
+    subclasses may implement ``to_config()`` themselves (returning a dict
+    with a ``cls`` naming an importable class with ``from_config``)."""
+    own = getattr(ix, "to_config", None)
+    if own is not None:
+        return own()
+    if isinstance(ix, ByPartIndexer):
+        return {"cls": "ByPartIndexer", "name": ix.name,
+                "type_handle": ix.type_handle, "dimension": ix.dimension}
+    if isinstance(ix, ByTargetIndexer):
+        return {"cls": "ByTargetIndexer", "name": ix.name,
+                "type_handle": ix.type_handle, "position": ix.position}
+    if isinstance(ix, DirectValueIndexer):
+        return {"cls": "DirectValueIndexer", "name": ix.name,
+                "type_handle": ix.type_handle}
+    if isinstance(ix, TargetToTargetIndexer):
+        return {"cls": "TargetToTargetIndexer", "name": ix.name,
+                "type_handle": ix.type_handle,
+                "key_pos": ix.key_pos, "value_pos": ix.value_pos}
+    if isinstance(ix, CompositeIndexer):
+        parts = [_to_config(p) for p in ix.parts]
+        if any(p is None for p in parts):
+            return None
+        return {"cls": "CompositeIndexer", "name": ix.name,
+                "type_handle": ix.type_handle, "parts": parts}
+    return None
+
+
+def _from_config(cfg: dict) -> HGIndexer:
+    cls = cfg["cls"]
+    if cls == "ByPartIndexer":
+        return ByPartIndexer(cfg["name"], cfg["type_handle"], cfg["dimension"])
+    if cls == "ByTargetIndexer":
+        return ByTargetIndexer(cfg["name"], cfg["type_handle"], cfg["position"])
+    if cls == "DirectValueIndexer":
+        return DirectValueIndexer(cfg["name"], cfg["type_handle"])
+    if cls == "TargetToTargetIndexer":
+        return TargetToTargetIndexer(cfg["name"], cfg["type_handle"],
+                                     cfg["key_pos"], cfg["value_pos"])
+    if cls == "CompositeIndexer":
+        return CompositeIndexer(cfg["name"], cfg["type_handle"],
+                                [_from_config(p) for p in cfg["parts"]])
+    # dotted path to a user class exposing from_config
+    import importlib
+
+    mod, _, attr = cls.rpartition(".")
+    klass = getattr(importlib.import_module(mod), attr)
+    return klass.from_config(cfg)
+
+
+def load_indexers(graph) -> int:
+    """Open path: restore persisted registrations into the in-process
+    registry WITHOUT rebuilding (the index data itself is already in the
+    store). Returns how many were loaded."""
+    import json
+
+    idx = graph.store.get_index(_REG_INDEX, create=False)
+    if idx is None:
+        return 0
+    n = 0
+    reg = _registry(graph)
+    for key, _hs in idx.bulk_items():
+        try:
+            ix = _from_config(json.loads(key.decode("utf-8")))
+        except Exception:
+            import logging
+
+            logging.getLogger("hypergraphdb_tpu.indexing").warning(
+                "could not restore indexer registration %r", key, exc_info=True
+            )
+            continue
+        if any(x.name == ix.name for xs in reg.values() for x in xs):
+            continue
+        reg.setdefault(int(ix.type_handle), []).append(ix)
+        n += 1
+    if n:
+        _bump_registry_version(graph)
+    return n
+
+
 # -- registration + hooks ------------------------------------------------------
 
 def _bump_registry_version(graph) -> None:
@@ -136,21 +225,42 @@ def _bump_registry_version(graph) -> None:
 def register(graph, indexer: HGIndexer, populate: bool = True) -> None:
     """Register and (optionally) build the index over existing atoms — the
     online equivalent of the reference's offline ``ApplyNewIndexer``
-    maintenance op (``maintenance/ApplyNewIndexer.java:36``)."""
+    maintenance op (``maintenance/ApplyNewIndexer.java:36``). The
+    registration descriptor is persisted so it survives reopen."""
+    import json
+
     reg = _registry(graph)
     reg.setdefault(int(indexer.type_handle), []).append(indexer)
     _bump_registry_version(graph)
+    cfg = _to_config(indexer)
+    if cfg is not None:
+        key = json.dumps(cfg, sort_keys=True).encode("utf-8")
+        graph.txman.ensure_transaction(
+            lambda: graph.store.get_index(_REG_INDEX).add_entry(key, 0)
+        )
     if populate:
         rebuild(graph, indexer)
 
 
 def unregister(graph, indexer_name: str) -> None:
+    import json
+
     reg = _registry(graph)
+    dropped: list[HGIndexer] = []
     for th, idxs in list(reg.items()):
+        dropped += [ix for ix in idxs if ix.name == indexer_name]
         reg[th] = [ix for ix in idxs if ix.name != indexer_name]
         if not reg[th]:
             del reg[th]
     _bump_registry_version(graph)
+    for ix in dropped:
+        cfg = _to_config(ix)
+        if cfg is not None:
+            key = json.dumps(cfg, sort_keys=True).encode("utf-8")
+            graph.txman.ensure_transaction(
+                lambda k=key: graph.store.get_index(_REG_INDEX)
+                .remove_entry(k, 0)
+            )
     graph.store.remove_index(_storage_name(indexer_name))
 
 
@@ -243,7 +353,9 @@ def maybe_index(
             for v in indexer.values(graph, h, value, targets):
                 idx.add_entry(key, v)
             if touched is not None:
-                touched.add((indexer.name, key))
+                # the STORAGE name — readers note ("idx", storage_name, key)
+                # (core/store.py), so bumps must use the same cell id
+                touched.add((_storage_name(indexer.name), key))
 
 
 def maybe_unindex(

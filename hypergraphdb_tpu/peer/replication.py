@@ -26,19 +26,60 @@ from hypergraphdb_tpu.query import serialize as qser
 
 
 class OpLog:
-    """Append-only in-memory log of local mutations (one per peer).
+    """Append-only log of local mutations (one per peer).
 
     Entries: (seq, kind, payload). seq is this peer's own monotonically
-    increasing timestamp — the vector-clock component it owns."""
+    increasing timestamp — the vector-clock component it owns.
 
-    def __init__(self) -> None:
+    Durable when constructed with a graph (the reference persists its
+    versioned log, ``peer/log/Log.java:34``, so peers can serve CATCH-UP
+    across restarts): each entry is a data record in the graph's store —
+    WAL-protected on the native backend — addressed by an ordered system
+    index keyed on the big-endian sequence number. A RAM-only log would
+    silently break offline catch-up the moment the serving peer restarts."""
+
+    IDX = "hg.sys.oplog"
+
+    def __init__(self, graph=None) -> None:
         self._lock = threading.Lock()
         self.entries: list[tuple[int, str, Any]] = []
+        self._graph = graph
+        if graph is not None:
+            self._load()
+
+    def _load(self) -> None:
+        import json
+
+        g = self._graph
+        idx = g.store.get_index(self.IDX, create=False)
+        if idx is None:
+            return
+        for key, hs in idx.bulk_items():  # ordered by big-endian seq key
+            seq = int.from_bytes(key, "big")
+            for dh in hs.tolist():
+                raw = g.store.get_data(int(dh))
+                if raw is None:
+                    continue
+                kind, payload = json.loads(raw.decode("utf-8"))
+                self.entries.append((seq, kind, payload))
 
     def append(self, kind: str, payload: Any) -> int:
         with self._lock:
             seq = len(self.entries) + 1
             self.entries.append((seq, kind, payload))
+            g = self._graph
+            if g is not None:
+                import json
+
+                raw = json.dumps([kind, payload]).encode("utf-8")
+                key = seq.to_bytes(8, "big")
+
+                def persist() -> None:
+                    dh = g.handles.make()
+                    g.store.store_data(dh, raw)
+                    g.store.get_index(self.IDX).add_entry(key, dh)
+
+                g.txman.ensure_transaction(persist)
             return seq
 
     def since(self, seq: int) -> list[tuple[int, str, Any]]:
@@ -51,6 +92,48 @@ class OpLog:
             return len(self.entries)
 
 
+class SeenMap:
+    """Durable vector clock: peer id → last seq of THEIR log applied here.
+    Persisted through the store so catch-up resumes correctly after BOTH
+    sides restart (ref ``CatchUpTaskClient.java:33``)."""
+
+    IDX = "hg.sys.oplog.seen"
+
+    def __init__(self, graph=None) -> None:
+        self._graph = graph
+        self._map: dict[str, int] = {}
+        if graph is not None:
+            idx = graph.store.get_index(self.IDX, create=False)
+            if idx is not None:
+                for key, hs in idx.bulk_items():
+                    vals = hs.tolist()
+                    if vals:
+                        self._map[key.decode("utf-8")] = max(vals)
+
+    def get(self, pid: str, default: int = 0) -> int:
+        return self._map.get(pid, default)
+
+    def set(self, pid: str, seq: int) -> None:
+        prev = self._map.get(pid)
+        if prev is not None and seq <= prev:
+            return  # no durable rewrite for an unchanged/backward clock
+        self._map[pid] = seq
+        g = self._graph
+        if g is not None:
+            key = pid.encode("utf-8")
+
+            def persist() -> None:
+                idx = g.store.get_index(self.IDX)
+                if prev is not None:
+                    idx.remove_entry(key, prev)
+                idx.add_entry(key, seq)
+
+            g.txman.ensure_transaction(persist)
+
+    def items(self):
+        return self._map.items()
+
+
 class Replication:
     """Per-peer replication service: publishes interests, pushes matching
     changes, applies incoming pushes, serves/runs catch-up."""
@@ -59,13 +142,13 @@ class Replication:
 
     def __init__(self, peer):
         self.peer = peer
-        self.log = OpLog()
+        self.log = OpLog(peer.graph)
         #: my interest predicate (None = not interested in anything)
         self.interest = None
         #: peer id -> their deserialized interest condition
         self.peer_interests: dict[str, Any] = {}
-        #: vector clock: peer id -> last seq of THEIR log I've applied
-        self.last_seen: dict[str, int] = {}
+        #: durable vector clock: peer id → last seq of THEIR log applied
+        self.last_seen = SeenMap(peer.graph)
         self._listening = False
         # thread-local "applying a foreign push" flag: suppresses the local
         # event listeners so replicated writes don't echo back out, without
@@ -172,9 +255,9 @@ class Replication:
             )
         elif what == "push":
             self._apply(sender, content["kind"], content["entry"])
-            self.last_seen[sender] = max(
+            self.last_seen.set(sender, max(
                 self.last_seen.get(sender, 0), int(content.get("seq", 0))
-            )
+            ))
         elif what == "catchup":
             since = int(content.get("since", 0))
             entries = [
@@ -187,11 +270,12 @@ class Replication:
                  "head": self.log.head},
             ))
         elif what == "catchup-result":
+            hi = self.last_seen.get(sender, 0)
             for e in content.get("entries", ()):
                 self._apply(sender, e["kind"], e["entry"])
-                self.last_seen[sender] = max(
-                    self.last_seen.get(sender, 0), int(e["seq"])
-                )
+                hi = max(hi, int(e["seq"]))
+            # ONE durable clock write for the whole batch, after it applied
+            self.last_seen.set(sender, hi)
         else:
             return False
         return True

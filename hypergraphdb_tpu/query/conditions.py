@@ -298,6 +298,58 @@ class OrderedLink(HGQueryCondition):
         return ts[: len(self.targets)] == self.targets
 
 
+def _subsumption_holds(graph, general: int, specific: int) -> bool:
+    """Reference subsumption check (``query/impl/SubsumesImpl.java``):
+    a DECLARED ``HGSubsumes`` link ``(general, specific)`` wins outright;
+    otherwise both atoms must share a type whose ``subsumes`` relation
+    accepts the value pair."""
+    from hypergraphdb_tpu.atom.utilities import subsumes_declared
+
+    if subsumes_declared(graph, general, specific):
+        return True
+    try:
+        gt = int(graph.get_type_handle_of(general))
+        st = int(graph.get_type_handle_of(specific))
+    except Exception:
+        return False
+    if gt != st:
+        return False
+    try:
+        atype = graph.typesystem.get_type(gt)
+    except Exception:
+        return False
+    from hypergraphdb_tpu.core.graph import HGLink
+
+    def val(h):
+        v = graph.get(h)
+        return v.value if isinstance(v, HGLink) else v
+
+    return bool(atype.subsumes(val(general), val(specific)))
+
+
+@dataclass(frozen=True)
+class Subsumes(HGQueryCondition):
+    """Atoms that subsume ``specific`` — i.e. are more general than it
+    (``SubsumesCondition.java``: declared ``HGSubsumes`` links first, then
+    same-type value subsumption)."""
+
+    specific: HGHandle
+
+    def satisfies(self, graph, h):
+        return _subsumption_holds(graph, int(h), int(self.specific))
+
+
+@dataclass(frozen=True)
+class Subsumed(HGQueryCondition):
+    """Atoms subsumed by ``general`` — more specific than it
+    (``SubsumedCondition.java``)."""
+
+    general: HGHandle
+
+    def satisfies(self, graph, h):
+        return _subsumption_holds(graph, int(self.general), int(h))
+
+
 @dataclass(frozen=True)
 class Target(HGQueryCondition):
     """Atoms that are targets of the given link (``TargetCondition``)."""

@@ -101,6 +101,16 @@ def ordered_link(*targets) -> c.OrderedLink:
     return c.OrderedLink(*targets)
 
 
+def subsumes(specific) -> c.Subsumes:
+    """Atoms more general than ``specific`` (``SubsumesCondition``)."""
+    return c.Subsumes(_h(specific))
+
+
+def subsumed(general) -> c.Subsumed:
+    """Atoms more specific than ``general`` (``SubsumedCondition``)."""
+    return c.Subsumed(_h(general))
+
+
 def target(link_handle) -> c.Target:
     return c.Target(_h(link_handle))
 

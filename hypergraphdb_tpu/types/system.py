@@ -206,6 +206,19 @@ class HGTypeSystem:
             return name
         return None
 
+    def adopt_type_atom(self, handle: int) -> Optional[str]:
+        """Reopen path: bind a persisted type atom's name↔handle mapping
+        WITHOUT requiring its HGAtomType implementation to be registered
+        this session — enough for by-type/TypePlus queries to resolve
+        (value decoding still needs the type registered, exactly like the
+        reference needs the class on the classpath)."""
+        name = self._type_atom_name(int(handle))
+        if name is None:
+            return None
+        self._handle_by_name.setdefault(name, int(handle))
+        self._name_by_handle.setdefault(int(handle), name)
+        return name
+
     def handle_of(self, name: str) -> HGHandle:
         h = self._handle_by_name.get(name)
         if h is None:

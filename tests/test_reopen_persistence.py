@@ -1,0 +1,171 @@
+"""A durable database must not forget its self-knowledge on reopen
+(VERDICT r2 item 4): indexer registrations, the subtype hierarchy, and the
+replication op log all restore from the store at open — mirroring the
+reference's ``HGIndexManager.loadIndexers`` (``HGIndexManager.java:62-215``),
+class↔type index recovery (``HGTypeSystem.java:97-98``), and persisted
+versioned log (``peer/log/Log.java:34``)."""
+
+import pytest
+
+import hypergraphdb_tpu as hg
+
+pytest.importorskip("hypergraphdb_tpu.storage.native")
+
+
+def _open(loc):
+    return hg.HyperGraph(hg.HGConfiguration(store_backend="native",
+                                            location=loc))
+
+
+def test_indexer_registration_survives_reopen(tmp_path):
+    from dataclasses import dataclass
+
+    from hypergraphdb_tpu.indexing.manager import (
+        ByPartIndexer,
+        get_index,
+        indexers_of,
+        register,
+    )
+
+    @dataclass(frozen=True)
+    class Person:
+        name: str = ""
+        age: int = 0
+
+    loc = str(tmp_path / "db")
+    g = _open(loc)
+    th = int(g.typesystem.handle_of(g.typesystem.infer(Person()).name))
+    register(g, ByPartIndexer("person-by-name", th, "name"))
+    g.add(Person("ada", 36))
+    g.close()
+
+    g2 = _open(loc)
+    # session 2 restored the registration at open...
+    restored = indexers_of(g2, th)
+    assert [ix.name for ix in restored] == ["person-by-name"]
+    # ...the index answers queries...
+    pt = g2.typesystem.infer("ada")
+    hits = get_index(g2, "person-by-name").find(pt.to_key("ada")).array()
+    assert len(hits) == 1
+    # binding the class (first use, as any app does) makes values load
+    g2.typesystem.infer(Person())
+    assert g2.get(int(hits[0])).name == "ada"
+    # ...and NEW atoms keep being indexed without re-registration
+    g2.add(Person("bob", 9))
+    hits_bob = get_index(g2, "person-by-name").find(pt.to_key("bob")).array()
+    assert len(hits_bob) == 1
+    g2.close()
+
+
+def test_unregister_survives_reopen(tmp_path):
+    from dataclasses import dataclass
+
+    from hypergraphdb_tpu.indexing.manager import (
+        ByPartIndexer,
+        indexers_of,
+        register,
+        unregister,
+    )
+
+    @dataclass(frozen=True)
+    class Thing:
+        tag: str = ""
+
+    loc = str(tmp_path / "db")
+    g = _open(loc)
+    th = int(g.typesystem.handle_of(g.typesystem.infer(Thing()).name))
+    register(g, ByPartIndexer("thing-by-tag", th, "tag"))
+    unregister(g, "thing-by-tag")
+    g.close()
+
+    g2 = _open(loc)
+    assert indexers_of(g2, th) == []
+    g2.close()
+
+
+def test_subtype_hierarchy_survives_reopen(tmp_path):
+    from hypergraphdb_tpu.atom.utilities import declare_subsumes
+    from hypergraphdb_tpu.query import dsl as q
+
+    loc = str(tmp_path / "db")
+    g = _open(loc)
+    # animal subsumes dog; both are plain (string-named primitive) types
+    # pre-registered as type atoms here
+    g.typesystem.register(_named_type("animal"))
+    g.typesystem.register(_named_type("dog"))
+    declare_subsumes(g, "animal", "dog")
+    d = g.add_node("rex", type="dog")
+    g.close()
+
+    g2 = _open(loc)
+    assert "dog" in g2.typesystem.subtypes_closure("animal")
+    # TypePlus closure intact: the subtype's atoms answer
+    res = q.find_all(g2, q.type_plus("animal"))
+    assert int(d) in res
+    g2.close()
+
+
+def _named_type(name):
+    from hypergraphdb_tpu.types.primitive import StringType
+
+    class T(StringType):
+        pass
+
+    t = T()
+    t.name = name
+    return t
+
+
+def test_oplog_and_vector_clock_survive_reopen(tmp_path):
+    """Catch-up must work after the SERVING peer restarts: its op log (and
+    the client's vector clock) restore from the store."""
+    import time
+
+    from hypergraphdb_tpu.peer import HyperGraphPeer, LoopbackNetwork
+
+    loc1 = str(tmp_path / "p1")
+    loc2 = str(tmp_path / "p2")
+
+    net = LoopbackNetwork()
+    g1 = _open(loc1)
+    p1 = HyperGraphPeer.loopback(g1, net, identity="peer-1")
+    p1.start()
+    a = g1.add("replicated-1")
+    b = g1.add("replicated-2")
+    head_before = p1.replication.log.head
+    assert head_before >= 2
+    p1.stop()
+    g1.close()
+
+    # restart peer-1 on the same store: the log must still be there
+    net2 = LoopbackNetwork()
+    g1b = _open(loc1)
+    p1b = HyperGraphPeer.loopback(g1b, net2, identity="peer-1")
+    p1b.start()
+    assert p1b.replication.log.head == head_before
+
+    # a fresh peer-2 catches up from the RESTARTED peer-1
+    g2 = _open(loc2)
+    p2 = HyperGraphPeer.loopback(g2, net2, identity="peer-2")
+    p2.start()
+    p2.replication.catch_up("peer-1")
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 5.0:
+        if p2.replication.last_seen.get("peer-1") >= head_before:
+            break
+        time.sleep(0.01)
+    assert p2.replication.last_seen.get("peer-1") >= head_before
+    from hypergraphdb_tpu.query import dsl as q
+
+    assert q.find_all(g2, q.value("replicated-1"))
+    p2.stop()
+    g2.close()
+
+    # restart peer-2: its vector clock survived, so a new catch-up asks
+    # only for entries beyond what it already applied
+    g2b = _open(loc2)
+    p2b = HyperGraphPeer.loopback(g2b, net2, identity="peer-2b")
+    assert p2b.replication.last_seen.get("peer-1") >= head_before
+    g2b.close()
+    p1b.stop()
+    g1b.close()

@@ -180,3 +180,29 @@ def test_keep_incident_links_rewrite_fires_replaced_event(graph):
     got2 = filter_predicates(graph, arr, [c.Arity(2, "eq")])
     assert got3.tolist() == []          # stale column answer would keep l
     assert got2.tolist() == [int(l)]
+
+
+def test_bulk_import_invalidates_user_index_readers(graph):
+    """The user-index version bump must use the STORAGE cell name readers
+    note — a raw-name bump is a no-op (review r4)."""
+    import threading
+
+    from hypergraphdb_tpu.core.errors import TransactionConflict
+    from hypergraphdb_tpu.indexing.manager import (
+        DirectValueIndexer,
+        get_index,
+        register,
+    )
+
+    th = int(graph.typesystem.handle_of("int"))
+    register(graph, DirectValueIndexer("myidx", th))
+    tx = graph.txman.begin()
+    key = graph.typesystem.infer(777).to_key(777)
+    assert get_index(graph, "myidx").find(key).array().tolist() == []
+    t = threading.Thread(target=lambda: graph.bulk_import(values=[777]))
+    t.start()
+    t.join()
+    graph.add("marker")
+    import pytest as _pytest
+    with _pytest.raises(TransactionConflict):
+        graph.txman.commit(tx)

@@ -1,0 +1,93 @@
+"""Subsumption as data (VERDICT r2 item 9): ``Subsumes``/``Subsumed``
+conditions mirror ``SubsumesCondition.java``/``SubsumedCondition.java`` —
+declared ``HGSubsumes`` links first, then same-type value subsumption —
+and the type hierarchy feeding TypePlus is graph-resident."""
+
+import pytest
+
+from hypergraphdb_tpu import HyperGraph
+from hypergraphdb_tpu.atom.utilities import (
+    SubsumesValue,
+    declare_subsumes,
+    subsumes_declared,
+)
+from hypergraphdb_tpu.query import dsl as q
+
+
+@pytest.fixture()
+def g():
+    graph = HyperGraph()
+    yield graph
+    graph.close()
+
+
+def test_declared_subsumption_link(g):
+    gen = g.add("general-concept")
+    spec = g.add("specific-concept")
+    # declare at the atom level: a SubsumesValue-typed ordered link
+    g.add_link((gen, spec), value=SubsumesValue())
+    assert subsumes_declared(g, int(gen), int(spec))
+    assert not subsumes_declared(g, int(spec), int(gen))  # directional
+
+    assert q.find_all(g, q.and_(q.is_(gen), q.subsumes(spec))) == [int(gen)]
+    assert q.find_all(g, q.and_(q.is_(spec), q.subsumed(gen))) == [int(spec)]
+    # and not the other way around
+    assert q.find_all(g, q.and_(q.is_(spec), q.subsumes(gen))) == []
+
+
+def test_value_level_subsumption_same_type(g):
+    """Without a declared link, same-type atoms subsume iff the type's
+    value relation accepts them (default: equality)."""
+    a1 = g.add("same")
+    a2 = g.add("same")
+    b = g.add("different")
+    res = q.find_all(g, q.subsumes(a2))
+    assert int(a1) in res and int(a2) in res
+    assert int(b) not in res
+
+
+def test_subsumption_rejects_cross_type(g):
+    n_int = g.add(42)
+    n_str = g.add("42")
+    assert q.find_all(g, q.and_(q.is_(n_int), q.subsumes(n_str))) == []
+
+
+def test_custom_type_subsumption(g):
+    """A type overriding ``subsumes`` drives the relation (the reference's
+    HGAtomType.subsumes contract)."""
+    from hypergraphdb_tpu.types.primitive import StringType
+
+    class PrefixType(StringType):
+        name = "prefix-str"
+
+        def subsumes(self, general, specific):
+            return specific is not None and general is not None \
+                and str(specific).startswith(str(general))
+
+    g.typesystem.register(PrefixType())
+    a = g.add_node("ab", type="prefix-str")
+    abc = g.add_node("abcde", type="prefix-str")
+    res = q.find_all(g, q.and_(q.is_(a), q.subsumes(abc)))
+    assert res == [int(a)]
+    assert q.find_all(g, q.and_(q.is_(abc), q.subsumes(a))) == []
+
+
+def test_type_hierarchy_via_links_feeds_typeplus(g):
+    from hypergraphdb_tpu.types.primitive import StringType
+
+    class T(StringType):
+        pass
+
+    for name in ("vehicle", "car"):
+        t = T()
+        t.name = name
+        g.typesystem.register(t)
+    declare_subsumes(g, "vehicle", "car")
+    c1 = g.add_node("beetle", type="car")
+    v1 = g.add_node("boat", type="vehicle")
+    res = q.find_all(g, q.type_plus("vehicle"))
+    assert int(c1) in res and int(v1) in res
+    # the hierarchy is graph-resident: a subsumes link atom exists
+    th = g.typesystem.handle_of("vehicle")
+    sh = g.typesystem.handle_of("car")
+    assert subsumes_declared(g, int(th), int(sh))
