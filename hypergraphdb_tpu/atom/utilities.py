@@ -269,19 +269,25 @@ def declared_specifics(graph, general: int) -> frozenset:
     ``satisfies`` call would otherwise re-walk the incidence set)."""
     from hypergraphdb_tpu.types.record import _qualname
 
+    # inside a transaction the incidence read merges the tx OVERLAY —
+    # neither usable from nor storable into the committed-state memo
+    # (an aborted tx would leave phantom subsumptions behind)
+    in_tx = graph.txman.current() is not None
     version = graph._mutations
     cache = getattr(graph, "_subsumes_cache", None)
     if cache is None or cache[0] != version:
         th = graph._find_type_atom(_qualname(SubsumesValue))
         cache = (version, th, {})
-        graph._subsumes_cache = cache
+        if not in_tx:
+            graph._subsumes_cache = cache
     _, th, memo = cache
     if th is None:
         return frozenset()
     general = int(general)
-    hit = memo.get(general)
-    if hit is not None:
-        return hit
+    if not in_tx:
+        hit = memo.get(general)
+        if hit is not None:
+            return hit
     out = set()
     try:
         inc = graph.get_incidence_set(general).array()

@@ -50,9 +50,12 @@ class HGStore:
                 return None if v is _TOMBSTONE else v
             tx = tx.parent
         cur = self.tx.current()
-        if cur is not None:
-            cur.note_read(("link", h))
-        return self.backend.get_link(h)
+        if cur is None:
+            return self.backend.get_link(h)
+        cur.note_read(("link", h))
+        # begin-time snapshot read (VBox.java:28): concurrent commits after
+        # our start_version are invisible
+        return self.tx.link_at(h, cur.start_version)
 
     def remove_link(self, h: HGHandle) -> None:
         tx = self.tx.current()
@@ -81,9 +84,10 @@ class HGStore:
                 return None if v is _TOMBSTONE else v
             tx = tx.parent
         cur = self.tx.current()
-        if cur is not None:
-            cur.note_read(("data", h))
-        return self.backend.get_data(h)
+        if cur is None:
+            return self.backend.get_data(h)
+        cur.note_read(("data", h))
+        return self.tx.data_at(h, cur.start_version)
 
     def remove_data(self, h: HGHandle) -> None:
         tx = self.tx.current()
@@ -119,7 +123,9 @@ class HGStore:
         tx = self.tx.current()
         if tx is not None:
             tx.note_read(("inc", atom))
-        base = self.backend.get_incidence_set(atom).array()
+            base = self.tx.inc_at(atom, tx.start_version)
+        else:
+            base = self.backend.get_incidence_set(atom).array()
         # merge overlay deltas, innermost-last
         deltas: list[_IncDelta] = []
         t = tx
@@ -212,7 +218,9 @@ class TxIndexView(HGBidirectionalIndex):
         tx = self._tx()
         if tx is not None:
             tx.note_read(("idx", self.name, key))
-        base = self._backing.find(key).array()
+            base = self._store.tx.idx_at(self.name, key, tx.start_version)
+        else:
+            base = self._backing.find(key).array()
         deltas = self._deltas_for(key)
         if not deltas:
             return HGSortedResultSet(base)
@@ -276,6 +284,9 @@ class TxIndexView(HGBidirectionalIndex):
                 return False
             return True
 
+        # keys to re-check: this tx's own writes PLUS keys other commits
+        # moved past our snapshot (their current committed membership is
+        # in `base` but must not be visible)
         touched: set[bytes] = set()
         t = tx
         while t is not None:
@@ -283,6 +294,11 @@ class TxIndexView(HGBidirectionalIndex):
                 if nm == self.name and in_range(k):
                     touched.add(k)
             t = t.parent
+        for k in self._store.tx.idx_keys_changed_since(
+            self.name, tx.start_version
+        ):
+            if in_range(k):
+                touched.add(k)
         if not touched:
             return HGSortedResultSet(base)
         vals = set(base.tolist())

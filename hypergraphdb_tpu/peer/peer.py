@@ -41,6 +41,9 @@ class HyperGraphPeer:
         self.identity = identity or self._load_identity()
         self.activities = ActivityManager(self)
         self.replication = Replication(self)
+        #: peers whose identity handshake completed (AffirmIdentity
+        #: bootstrap, ``peer/bootstrap/AffirmIdentityBootstrap``): id → info
+        self.known_peers: dict[str, dict] = {}
         self._started = False
 
         # bootstrap: server-side activity factories (CACTBootstrap analogue)
@@ -78,17 +81,53 @@ class HyperGraphPeer:
         self.activities.start()
         self.replication.attach()
         self._started = True
+        self.affirm_identity()
 
     def stop(self) -> None:
         if not self._started:
             return
+        self.replication.detach()  # flush pending pushes, stop the worker
         self.activities.stop()
         self.interface.stop()
         self._started = False
 
+    # -- identity handshake (AffirmIdentityBootstrap) --------------------------
+    def affirm_identity(self) -> None:
+        """Announce this peer's identity to every reachable peer; receivers
+        record it and acknowledge with their own (the reference's
+        AffirmIdentity bootstrap handshake that precedes other activity)."""
+        for pid in self.interface.peers():
+            if pid != self.identity:
+                self.interface.send(pid, {
+                    "activity_type": "identity",
+                    "content": {"what": "affirm",
+                                "identity": self.identity},
+                })
+
+    def _handle_identity(self, sender: str, msg: dict) -> bool:
+        if msg.get("activity_type") != "identity":
+            return False
+        content = msg.get("content") or {}
+        what = content.get("what")
+        if what == "affirm":
+            self.known_peers[sender] = {"identity": content.get("identity")}
+            self.interface.send(sender, {
+                "activity_type": "identity",
+                "content": {"what": "affirm-ack",
+                            "identity": self.identity},
+            })
+        elif what == "affirm-ack":
+            self.known_peers[sender] = {"identity": content.get("identity")}
+        else:
+            return False
+        return True
+
     def _dispatch(self, sender: str, msg: dict) -> None:
-        # replication messages are lightweight service traffic; everything
-        # else is conversation-scoped and goes through the activity scheduler
+        # identity handshake first, then replication service traffic;
+        # everything else is conversation-scoped and goes through the
+        # activity scheduler
+        if self._handle_identity(sender, msg):
+            return
         if self.replication.handle(sender, msg):
             return
         self.activities.on_message(sender, msg)

@@ -35,15 +35,36 @@ def gid_of(graph, h: int, origin_peer: str) -> str:
     exported before) already have a mapping in the atom map — reuse it, so
     a replicated atom keeps ONE identity everywhere instead of being
     re-minted (and duplicated) on push-back. Fresh local atoms are assigned
-    ``origin_peer:handle`` and recorded for the same reason."""
+    ``origin_peer:handle`` and recorded for the same reason.
+
+    A handle→gid memo rides on the graph: a gid never changes once
+    assigned and handles are never reused, so positive results cache
+    forever (the push worker calls this for every target of every
+    mutation — an index lookup each was the hottest line in the profile)."""
     h = int(h)
+    cache = getattr(graph, "_gid_cache", None)
+    if cache is None:
+        cache = graph._gid_cache = {}
+    hit = cache.get(h)
+    if hit is not None:
+        return hit
     keys = _atom_map(graph).find_by_value(h)
     if keys:
-        return keys[0].decode("utf-8")
+        gid = keys[0].decode("utf-8")
+        cache[h] = gid
+        return gid
     gid = global_id(origin_peer, h)
+    cur = graph.txman.current()
     graph.txman.ensure_transaction(
         lambda: _atom_map(graph).add_entry(gid.encode("utf-8"), h)
     )
+    if cur is not None:
+        # the mapping is only STAGED in the enclosing tx: caching now would
+        # poison the forever-cache if that tx aborts/conflicts (the entry
+        # would never persist while lookups keep short-circuiting)
+        cur.on_commit.append(lambda: cache.__setitem__(h, gid))
+    else:
+        cache[h] = gid  # ensure_transaction committed before returning
     return gid
 
 

@@ -88,6 +88,12 @@ class HGTransaction:
         self.parent = parent
         self.readonly = readonly
         self.active = True
+        # begin-time read snapshot (VBox semantics, transaction/VBox.java:28):
+        # every read inside this tx sees the committed state AS OF this
+        # version; nested txs share the top-level snapshot
+        self.start_version = (
+            parent.start_version if parent is not None else mgr._clock
+        )
         # cell -> observed version
         self.read_set: dict[tuple, int] = {}
         # write buffers
@@ -103,8 +109,16 @@ class HGTransaction:
 
     # -- read-set tracking ---------------------------------------------------
     def note_read(self, cell: tuple) -> None:
-        if not self.readonly:
-            self.read_set.setdefault(cell, self.mgr.cell_version(cell))
+        if self.readonly:
+            return
+        v = self.mgr.cell_version(cell)
+        # snapshot reads return the BEGIN-time value: if the cell already
+        # moved past our snapshot, this tx read stale data by design and
+        # must fail commit validation — record a version that can never
+        # match (the reference's doomed-transaction outcome)
+        self.read_set.setdefault(
+            cell, v if v <= self.start_version else -1
+        )
 
     def is_empty(self) -> bool:
         return not (self.links or self.data or self.inc or self.idx)
@@ -146,6 +160,15 @@ class HGTransactionManager:
         self._versions: dict[tuple, int] = {}
         self._clock = 0
         self._tls = threading.local()
+        # MVCC version chains (transaction/VBox.java:28): per cell, an
+        # ascending list of (version, pre-image) — "immediately before
+        # commit `version`, the committed value was `pre-image`". Captured
+        # only while OTHER transactions are active (their snapshots may
+        # need the old values) and GC'd up to the oldest active snapshot
+        # (ActiveTransactionsRecord.java:33 semantics).
+        self._history: dict[tuple, list[tuple[int, Any]]] = {}
+        #: id(tx) -> start_version for every live top-level transaction
+        self._active: dict[int, int] = {}
         # stats (reference: TxMonitor.java:14 + conflicted/successful counters
         # at HGTransactionManager.java:40-41)
         self.committed = 0
@@ -183,6 +206,15 @@ class HGTransactionManager:
     # -- lifecycle --------------------------------------------------------------
     def begin(self, readonly: bool = False) -> HGTransaction:
         tx = HGTransaction(self, self.current(), readonly=readonly)
+        if tx.parent is None:
+            # snapshot choice + registration must be atomic with commits:
+            # outside the lock, a committer could bump the clock and skip
+            # pre-image capture between our clock read and registration,
+            # silently letting this tx read past its snapshot. The lock
+            # also guarantees the chosen version's state is fully applied.
+            with self._commit_lock:
+                tx.start_version = self._clock
+                self._active[id(tx)] = tx.start_version
         self._stack().append(tx)
         return tx
 
@@ -192,6 +224,8 @@ class HGTransactionManager:
             raise TransactionAborted("abort of non-innermost transaction")
         st.pop()
         tx.active = False
+        if tx.parent is None:
+            self._active.pop(id(tx), None)
         self.aborted += 1
 
     def commit(self, tx: HGTransaction) -> None:
@@ -203,28 +237,146 @@ class HGTransactionManager:
         if tx.parent is not None:
             tx.merge_into(tx.parent)
             return
-        if tx.readonly or tx.is_empty():
-            self.committed += 1
-            self._run_commit_hooks(tx)
-            return
-        with self._commit_lock:
-            for cell, observed in tx.read_set.items():
-                if self._versions.get(cell, 0) != observed:
-                    self.conflicted += 1
-                    raise TransactionConflict(f"cell {cell!r} changed")
-            self._apply(tx)
-            self._clock += 1
-            v = self._clock
-            for h in tx.links:
-                self._versions[("link", h)] = v
-            for h in tx.data:
-                self._versions[("data", h)] = v
-            for atom in tx.inc:
-                self._versions[("inc", atom)] = v
-            for key in tx.idx:
-                self._versions[("idx",) + key] = v
-            self.committed += 1
+        try:
+            if tx.readonly or tx.is_empty():
+                self.committed += 1
+                self._run_commit_hooks(tx)
+                return
+            with self._commit_lock:
+                for cell, observed in tx.read_set.items():
+                    if self._versions.get(cell, 0) != observed:
+                        self.conflicted += 1
+                        raise TransactionConflict(f"cell {cell!r} changed")
+                self._clock += 1
+                v = self._clock
+                self._capture_history(tx, v)
+                self._apply(tx)
+                for h in tx.links:
+                    self._versions[("link", h)] = v
+                for h in tx.data:
+                    self._versions[("data", h)] = v
+                for atom in tx.inc:
+                    self._versions[("inc", atom)] = v
+                for key in tx.idx:
+                    self._versions[("idx",) + key] = v
+                self.committed += 1
+                self._gc_history()
+        finally:
+            self._active.pop(id(tx), None)
         self._run_commit_hooks(tx)
+
+    # -- MVCC history -----------------------------------------------------------
+    def _capture_history(self, tx: HGTransaction, v: int) -> None:
+        """Record pre-images of every cell this commit overwrites, IF any
+        other live transaction's snapshot might still need them. Called
+        under the commit lock, before ``_apply``."""
+        # fast path: no OTHER active transaction → nobody can read the old
+        # values, skip all capture work (the single-threaded common case).
+        # NB: begin()/abort() mutate _active without the commit lock, so
+        # iterate over a point-in-time copy.
+        if not any(tid != id(tx) for tid in list(self._active)):
+            return
+        b = self.backend
+        H = self._history
+        for h in tx.links:
+            H.setdefault(("link", h), []).append((v, b.get_link(h)))
+        for h in tx.data:
+            H.setdefault(("data", h), []).append((v, b.get_data(h)))
+        for atom, d in tx.inc.items():
+            if d.cleared:
+                old = ("full", b.get_incidence_set(atom).array().copy())
+            else:
+                old = ("delta", set(d.added), set(d.removed))
+            H.setdefault(("inc", atom), []).append((v, old))
+        for (name, key), d in tx.idx.items():
+            index = b.get_index(name, create=True)
+            if d.removed_all:
+                old = ("full", index.find(key).array().copy())
+            else:
+                old = ("delta", set(d.added), set(d.removed))
+            H.setdefault(("idx", name, key), []).append((v, old))
+
+    def _gc_history(self) -> None:
+        """Drop pre-images no live snapshot can reach (called under the
+        commit lock)."""
+        if not self._history:
+            return
+        floor = min(list(self._active.values()) or [self._clock])
+        dead = []
+        for cell, entries in self._history.items():
+            keep = [e for e in entries if e[0] > floor]
+            if keep:
+                self._history[cell] = keep
+            else:
+                dead.append(cell)
+        for cell in dead:
+            del self._history[cell]
+
+    def _value_at(self, cell: tuple, sv: int, current: Any) -> Any:
+        """Reconstruct a link/data cell's value at snapshot ``sv``: the
+        pre-image of the FIRST commit after sv (chains are ascending)."""
+        for ver, old in self._history.get(cell, ()):
+            if ver > sv:
+                return old
+        return current
+
+    def link_at(self, h: int, sv: int):
+        cell = ("link", h)
+        if cell not in self._history:
+            return self.backend.get_link(h)
+        sentinel = object()
+        got = self._value_at(cell, sv, sentinel)
+        return self.backend.get_link(h) if got is sentinel else got
+
+    def data_at(self, h: int, sv: int):
+        cell = ("data", h)
+        if cell not in self._history:
+            return self.backend.get_data(h)
+        sentinel = object()
+        got = self._value_at(cell, sv, sentinel)
+        return self.backend.get_data(h) if got is sentinel else got
+
+    def _set_at(self, cell: tuple, sv: int, current: set) -> set:
+        """Reconstruct a set cell (incidence/index members) at ``sv`` by
+        undoing newer commits newest-first."""
+        entries = self._history.get(cell)
+        if not entries:
+            return current
+        vals = current
+        for ver, old in reversed(entries):
+            if ver <= sv:
+                break
+            if old[0] == "full":
+                vals = set(old[1].tolist())
+            else:
+                _, added, removed = old
+                vals = (vals - added) | removed
+        return vals
+
+    def inc_at(self, atom: int, sv: int) -> np.ndarray:
+        cur = set(self.backend.get_incidence_set(atom).array().tolist())
+        vals = self._set_at(("inc", atom), sv, cur)
+        return np.asarray(sorted(vals), dtype=np.int64)
+
+    def idx_at(self, name: str, key: bytes, sv: int) -> np.ndarray:
+        idx = self.backend.get_index(name, create=True)
+        cur = set(idx.find(key).array().tolist())
+        vals = self._set_at(("idx", name, key), sv, cur)
+        return np.asarray(sorted(vals), dtype=np.int64)
+
+    def idx_keys_changed_since(self, name: str, sv: int) -> list[bytes]:
+        """Index keys whose membership moved after ``sv`` (range/scan reads
+        under a snapshot patch exactly these)."""
+        out = []
+        # point-in-time copy: committers mutate _history under the commit
+        # lock, but this runs on reader threads without it
+        for cell, entries in list(self._history.items()):
+            if cell[0] == "idx" and cell[1] == name and entries and entries[-1][0] > sv:
+                out.append(cell[2])
+        return out
+
+    def cell_changed_since(self, cell: tuple, sv: int) -> bool:
+        return self._versions.get(cell, 0) > sv
 
     @staticmethod
     def _run_commit_hooks(tx: HGTransaction) -> None:

@@ -132,6 +132,7 @@ def test_oplog_and_vector_clock_survive_reopen(tmp_path):
     p1.start()
     a = g1.add("replicated-1")
     b = g1.add("replicated-2")
+    assert p1.replication.flush()  # pushes are async off the mutation path
     head_before = p1.replication.log.head
     assert head_before >= 2
     p1.stop()

@@ -150,6 +150,7 @@ def test_removed_unreplicated_atom_mints_no_gid():
         assert transfer.existing_gid(g, int(a)) is None
         n_log = len(rep.log.entries)
         g.remove(a)
+        assert rep.flush()  # drain the async push worker before asserting
         assert transfer.existing_gid(g, int(a)) is None  # no mint
         removes = [
             e for e in rep.log.entries[n_log:] if e[1] == "remove"
