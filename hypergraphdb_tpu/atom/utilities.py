@@ -281,6 +281,8 @@ def declared_specifics(graph, general: int) -> frozenset:
         if not in_tx:
             graph._subsumes_cache = cache
     _, th, memo = cache
+    if in_tx:
+        memo = {}  # throwaway: overlay-tainted results must never be shared
     if th is None:
         return frozenset()
     general = int(general)

@@ -61,8 +61,9 @@ class HGConfiguration:
 
     transactional: bool = True
     keep_incident_links_on_removal: bool = False
-    store_backend: str = "memory"      # "memory" | "native" (C++ mmap log)
+    store_backend: str = "memory"      # "memory" | "native" | "partitioned"
     location: Optional[str] = None     # directory for persistent backends
+    n_partitions: int = 4              # partitioned backend: child count
     handle_factory: str = "sequential"  # "sequential" | "uuid"
     query: QueryConfig = field(default_factory=QueryConfig)
     cache: CacheConfig = field(default_factory=CacheConfig)

@@ -127,6 +127,38 @@ class HyperGraph:
                     "in this build; use store_backend='memory'"
                 ) from e
             return NativeStorage(config.location or ".hgdb")
+        if config.store_backend == "partitioned":
+            # the hazelstore role: record/key-routed storage over N child
+            # partitions (native WAL stores when a location is given)
+            from hypergraphdb_tpu.storage.partitioned import PartitionedStorage
+
+            if config.location:
+                import json
+                import os
+
+                from hypergraphdb_tpu.storage.native import NativeStorage
+
+                loc = config.location
+                # the partition count is part of the on-disk layout:
+                # handle routing is h % n, so reopening with a different n
+                # would silently mis-route every record. First open
+                # records it; later opens USE the recorded count.
+                os.makedirs(loc, exist_ok=True)
+                marker = os.path.join(loc, "partitions.json")
+                if os.path.exists(marker):
+                    with open(marker, encoding="utf-8") as f:
+                        n = int(json.load(f)["n_partitions"])
+                else:
+                    n = int(config.n_partitions)
+                    with open(marker, "w", encoding="utf-8") as f:
+                        json.dump({"n_partitions": n}, f)
+                return PartitionedStorage(
+                    n_partitions=n,
+                    factory=lambda i: NativeStorage(
+                        os.path.join(loc, f"part{i}")
+                    ),
+                )
+            return PartitionedStorage(n_partitions=config.n_partitions)
         from hypergraphdb_tpu.storage.memstore import MemStorage
 
         return MemStorage()

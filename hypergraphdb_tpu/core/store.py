@@ -244,8 +244,10 @@ class TxIndexView(HGBidirectionalIndex):
         return self._backing.key_count()
 
     def scan_keys(self):
-        # any key the tx chain touched (adds OR removes) must be re-checked
-        # against the merged view; untouched keys pass through unchanged
+        # keys to re-check against the merged/snapshot view: the tx's own
+        # writes AND keys other commits moved past the snapshot (a
+        # post-snapshot key must not surface here while find() reports it
+        # empty — the phantom find_range already suppresses)
         touched = set()
         t = self._tx()
         while t is not None:
@@ -253,6 +255,13 @@ class TxIndexView(HGBidirectionalIndex):
                 if nm == self.name and (d.added or d.removed or d.removed_all):
                     touched.add(k)
             t = t.parent
+        tx = self._tx()
+        if tx is not None:
+            touched.update(
+                self._store.tx.idx_keys_changed_since(
+                    self.name, tx.start_version
+                )
+            )
         if not touched:
             yield from self._backing.scan_keys()
             return

@@ -105,6 +105,27 @@ class CompositeIndexer(HGIndexer):
         return [b"\x00".join(parts)]
 
 
+class LinkIndexer(HGIndexer):
+    """Index links of a type by their FULL ordered target tuple
+    (``indexing/LinkIndexer.java``): one key per link, the concatenation
+    of its targets' order-preserving encodings — an exact-tuple lookup
+    ("find the link (a, b, c)") without intersecting incidence sets."""
+
+    def __init__(self, name: str, type_handle: HGHandle):
+        self.name = name
+        self.type_handle = int(type_handle)
+
+    def keys(self, graph, h, value, targets):
+        if not targets:
+            return []
+        return [b"".join(encode_int(int(t)) for t in targets)]
+
+    @staticmethod
+    def tuple_key(targets: Sequence[HGHandle]) -> bytes:
+        """The lookup key for an ordered target tuple."""
+        return b"".join(encode_int(int(t)) for t in targets)
+
+
 class TargetToTargetIndexer(HGIndexer):
     """Bidirectional target→target index over links of a type
     (``TargetToTargetIndexer.java``): key = target at ``key_pos``, value =
@@ -148,6 +169,9 @@ def _to_config(ix: HGIndexer) -> Optional[dict]:
     if isinstance(ix, ByTargetIndexer):
         return {"cls": "ByTargetIndexer", "name": ix.name,
                 "type_handle": ix.type_handle, "position": ix.position}
+    if isinstance(ix, LinkIndexer):
+        return {"cls": "LinkIndexer", "name": ix.name,
+                "type_handle": ix.type_handle}
     if isinstance(ix, DirectValueIndexer):
         return {"cls": "DirectValueIndexer", "name": ix.name,
                 "type_handle": ix.type_handle}
@@ -170,6 +194,8 @@ def _from_config(cfg: dict) -> HGIndexer:
         return ByPartIndexer(cfg["name"], cfg["type_handle"], cfg["dimension"])
     if cls == "ByTargetIndexer":
         return ByTargetIndexer(cfg["name"], cfg["type_handle"], cfg["position"])
+    if cls == "LinkIndexer":
+        return LinkIndexer(cfg["name"], cfg["type_handle"])
     if cls == "DirectValueIndexer":
         return DirectValueIndexer(cfg["name"], cfg["type_handle"])
     if cls == "TargetToTargetIndexer":

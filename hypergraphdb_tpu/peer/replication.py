@@ -358,9 +358,13 @@ class Replication:
         import logging
 
         logging.getLogger("hypergraphdb_tpu.peer").warning(
-            "replication batch kept conflicting; entries dropped from the "
-            "log (peers recover via catch-up)"
+            "replication batch kept conflicting; re-enqueued for a later "
+            "drain cycle"
         )
+        # the log IS the catch-up source — dropping the batch would be
+        # permanent silent replication loss. Put it back at the FRONT so
+        # ordering is preserved and the next (debounced) cycle retries.
+        self._pending.extendleft(reversed(batch))
         return [], []
 
     def _prepare_remove(self, h: int):
@@ -416,9 +420,18 @@ class Replication:
             for pid in list(self.peer_interests):
                 self._push(pid, "remove", entry)
             return
-        for pid, cond in list(self.peer_interests.items()):
-            if cond is None or self._matches(cond, h):
-                self._push(pid, kind, entry)
+        targets = [
+            pid for pid, cond in list(self.peer_interests.items())
+            if cond is None or self._matches(cond, h)
+        ]
+        if not targets:
+            return
+        # an interest may have arrived AFTER prepare chose the log-only
+        # single-atom form; pushes are applied out of order at receivers,
+        # so expand to the full closure (same rule as catch-up serving)
+        entry = self._expand_for_wire(kind, entry)
+        for pid in targets:
+            self._push(pid, kind, entry)
 
     def _matches(self, cond, h: int) -> bool:
         try:

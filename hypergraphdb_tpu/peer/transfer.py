@@ -48,20 +48,23 @@ def gid_of(graph, h: int, origin_peer: str) -> str:
     hit = cache.get(h)
     if hit is not None:
         return hit
+    cur = graph.txman.current()
     keys = _atom_map(graph).find_by_value(h)
     if keys:
         gid = keys[0].decode("utf-8")
-        cache[h] = gid
+        if cur is None:
+            cache[h] = gid
+        else:
+            # find_by_value merges the tx OVERLAY: this gid may only be
+            # STAGED (e.g. minted earlier in this very tx) — caching now
+            # would poison the forever-cache if the tx aborts/conflicts
+            cur.on_commit.append(lambda: cache.__setitem__(h, gid))
         return gid
     gid = global_id(origin_peer, h)
-    cur = graph.txman.current()
     graph.txman.ensure_transaction(
         lambda: _atom_map(graph).add_entry(gid.encode("utf-8"), h)
     )
     if cur is not None:
-        # the mapping is only STAGED in the enclosing tx: caching now would
-        # poison the forever-cache if that tx aborts/conflicts (the entry
-        # would never persist while lookups keep short-circuiting)
         cur.on_commit.append(lambda: cache.__setitem__(h, gid))
     else:
         cache[h] = gid  # ensure_transaction committed before returning

@@ -479,6 +479,126 @@ class FilterScanPlan(Plan):
         return "scan|" + ",".join(type(p).__name__ for p in self.predicates)
 
 
+# ============================================================ result mapping
+
+
+@dataclass(frozen=True)
+class LinkProjectionMapping:
+    """Map each result LINK to its target at ``position``
+    (``query/impl/LinkProjectionMapping``). Vectorized against the
+    snapshot's target columns when fresh, per-handle otherwise."""
+
+    position: int
+
+    def apply(self, graph, arr: np.ndarray) -> np.ndarray:
+        if len(arr) == 0:
+            return arr
+        cols = _columns_for_filter(graph, len(arr))
+        pos = int(self.position)
+        if cols is not None:
+            snap, memtable = cols
+            ok = (arr < snap.num_atoms)
+            if memtable:
+                ok &= ~np.isin(arr, np.fromiter(memtable, dtype=np.int64))
+            out = []
+            sel = arr[ok]
+            good = snap.arity[sel] > pos
+            offs = snap.tgt_offsets[sel[good]].astype(np.int64) + pos
+            out.append(snap.tgt_flat[offs].astype(np.int64))
+            for h in arr[~ok].tolist():
+                try:
+                    ts = graph.get_targets(h)
+                except Exception:
+                    continue
+                if pos < len(ts):
+                    out.append(np.asarray([int(ts[pos])], dtype=np.int64))
+            return np.unique(np.concatenate(out)) if out else _EMPTY
+        vals = []
+        for h in arr.tolist():
+            try:
+                ts = graph.get_targets(h)
+            except Exception:
+                continue
+            if pos < len(ts):
+                vals.append(int(ts[pos]))
+        return np.unique(np.asarray(vals, dtype=np.int64)) if vals else _EMPTY
+
+
+@dataclass(frozen=True)
+class DerefMapping:
+    """Map each result handle to its VALUE (``query/impl/DerefMapping``);
+    the output is a python list, not a handle set."""
+
+    def apply(self, graph, arr: np.ndarray) -> list:
+        return [graph.get(int(h)) for h in arr.tolist()]
+
+
+@dataclass
+class ResultMapPlan(Plan):
+    """``ResultMapQuery``: run the child, then map every result."""
+
+    child: Plan
+    mapping: Any
+
+    def run(self, graph):
+        return self.mapping.apply(graph, self.child.run(graph))
+
+    def estimate(self, graph):
+        return self.child.estimate(graph)
+
+    def describe(self):
+        return f"map[{type(self.mapping).__name__}]({self.child.describe()})"
+
+
+@dataclass
+class PipePlan(Plan):
+    """``PipeQuery`` (``query/impl/PipeQuery.java:25``): every result of
+    the producer becomes the KEY of a dependent query; the union of the
+    keyed queries' results is the pipe's output. ``key_condition`` maps a
+    produced handle to the downstream condition."""
+
+    producer: Plan
+    key_condition: Any  # Callable[[int], HGQueryCondition]
+
+    def run(self, graph):
+        keys = self.producer.run(graph)
+        if len(keys) == 0:
+            return _EMPTY
+        outs = []
+        for k in keys.tolist():
+            sub = compile_query(graph, self.key_condition(int(k)))
+            arr = sub.plan.run(graph)
+            if len(arr):
+                outs.append(arr)
+        if not outs:
+            return _EMPTY
+        return np.unique(np.concatenate(outs))
+
+    def describe(self):
+        return f"pipe({self.producer.describe()} → ...)"
+
+
+def result_map(graph, condition, mapping):
+    """Compile + run ``condition`` and map results (the hg.apply DSL)."""
+    q = compile_query(graph, condition)
+
+    def run():
+        return ResultMapPlan(q.plan, mapping).run(graph)
+
+    return graph.txman.ensure_transaction(run, readonly=True)
+
+
+def pipe(graph, producer_condition, key_condition):
+    """Compile + run a pipe: producer results keyed into a dependent
+    condition builder (``PipeQuery`` semantics)."""
+    q = compile_query(graph, producer_condition)
+
+    def run():
+        return PipePlan(q.plan, key_condition).run(graph)
+
+    return graph.txman.ensure_transaction(run, readonly=True)
+
+
 # ============================================================ helpers
 
 

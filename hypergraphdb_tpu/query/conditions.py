@@ -298,6 +298,56 @@ class OrderedLink(HGQueryCondition):
         return ts[: len(self.targets)] == self.targets
 
 
+@dataclass(frozen=True)
+class ValueRegex(HGQueryCondition):
+    """Atoms whose (string) value matches a regular expression — the
+    reference's ``AtomValueRegExPredicate``. A predicate (P class): it
+    narrows other conditions' results, never produces a set by itself."""
+
+    pattern: str
+    flags: int = 0
+
+    def _rx(self):
+        import re
+
+        return re.compile(self.pattern, self.flags)
+
+    def satisfies(self, graph, h):
+        from hypergraphdb_tpu.core.graph import HGLink
+
+        v = graph.get(h)
+        if isinstance(v, HGLink):
+            v = v.value
+        return isinstance(v, str) and self._rx().search(v) is not None
+
+
+@dataclass(frozen=True)
+class PartRegex(HGQueryCondition):
+    """Record-projection regex (``AtomPartRegExPredicate``): the value's
+    ``path`` projection matches the pattern."""
+
+    path: str
+    pattern: str
+    flags: int = 0
+
+    def satisfies(self, graph, h):
+        import re
+
+        from hypergraphdb_tpu.core.graph import HGLink
+
+        v = graph.get(h)
+        if isinstance(v, HGLink):
+            v = v.value
+        try:
+            atype = graph.typesystem.get_type(graph.get_type_handle_of(h))
+            part = atype.project(v, self.path)
+        except Exception:
+            return False
+        return isinstance(part, str) and re.search(
+            self.pattern, part, self.flags
+        ) is not None
+
+
 def _subsumption_holds(graph, general: int, specific: int) -> bool:
     """Reference subsumption check (``query/impl/SubsumesImpl.java``):
     a DECLARED ``HGSubsumes`` link ``(general, specific)`` wins outright;

@@ -101,6 +101,42 @@ def ordered_link(*targets) -> c.OrderedLink:
     return c.OrderedLink(*targets)
 
 
+def value_regex(pattern: str, flags: int = 0) -> c.ValueRegex:
+    """String-value regex predicate (``AtomValueRegExPredicate``)."""
+    return c.ValueRegex(pattern, flags)
+
+
+def part_regex(path: str, pattern: str, flags: int = 0) -> c.PartRegex:
+    """Record-projection regex predicate (``AtomPartRegExPredicate``)."""
+    return c.PartRegex(path, pattern, flags)
+
+
+def target_at(graph, condition, position: int):
+    """Map each result link to its target at ``position`` — the
+    LinkProjectionMapping form of ``ResultMapQuery``."""
+    from hypergraphdb_tpu.query.compiler import (
+        LinkProjectionMapping,
+        result_map,
+    )
+
+    return result_map(graph, condition, LinkProjectionMapping(position))
+
+
+def deref(graph, condition):
+    """Map each result handle to its value (``DerefMapping``)."""
+    from hypergraphdb_tpu.query.compiler import DerefMapping, result_map
+
+    return result_map(graph, condition, DerefMapping())
+
+
+def pipe(graph, producer_condition, key_condition):
+    """``PipeQuery``: each producer result keys a dependent condition;
+    returns the union of the keyed queries' results."""
+    from hypergraphdb_tpu.query.compiler import pipe as _pipe
+
+    return _pipe(graph, producer_condition, key_condition)
+
+
 def subsumes(specific) -> c.Subsumes:
     """Atoms more general than ``specific`` (``SubsumesCondition``)."""
     return c.Subsumes(_h(specific))

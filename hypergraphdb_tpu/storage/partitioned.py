@@ -1,0 +1,268 @@
+"""Partitioned storage backend — the hazelstore role in the rebuild.
+
+The reference proves its storage SPI tolerates non-local, sharded backends
+with a Hazelcast data-grid implementation (``storage/hazelstore/
+Hazelstore.scala``, ``HazelIndex.scala`` — SURVEY §2.2). This module plays
+the same role TPU-natively: one :class:`PartitionedStorage` front routes
+every SPI operation across N child backends —
+
+- **records route by handle** (modulo partitioning of link/data/incidence
+  rows: the owner of atom ``h`` holds its record, payload and incidence
+  set),
+- **index entries route by key** (stable key hash), with range scans and
+  key enumeration served by k-way merges across all partitions (Hazelcast
+  orders within partitions the same way),
+- commit-batch barriers fan out to every partition, so a crash replays
+  each partition's WAL to the same barrier.
+
+Children are any ``StorageBackend`` (memory partitions for tests, native
+C++ WAL stores for durable sharding — the closest single-process analogue
+of a storage grid, and the shape a multi-host DCN storage service would
+take: swap the child list for RPC stubs without touching the SPI).
+"""
+
+from __future__ import annotations
+
+import heapq
+import zlib
+from typing import Callable, Iterator, Optional, Sequence
+
+import numpy as np
+
+from hypergraphdb_tpu.core.handles import HGHandle
+from hypergraphdb_tpu.storage.api import (
+    HGBidirectionalIndex,
+    HGSortedResultSet,
+    StorageBackend,
+)
+
+
+def _key_part(key: bytes, n: int) -> int:
+    """Stable partition of an index key (content hash, not Python hash —
+    must agree across processes)."""
+    return zlib.crc32(bytes(key)) % n
+
+
+class PartitionedIndex(HGBidirectionalIndex):
+    """Key-routed view over the per-partition indices of one logical name."""
+
+    def __init__(self, children: list[HGBidirectionalIndex]):
+        self._children = children
+
+    def _owner(self, key: bytes) -> HGBidirectionalIndex:
+        return self._children[_key_part(key, len(self._children))]
+
+    # -- single-key ops route to the owner ------------------------------------
+    def add_entry(self, key: bytes, value: HGHandle) -> None:
+        self._owner(key).add_entry(key, value)
+
+    def remove_entry(self, key: bytes, value: HGHandle) -> None:
+        self._owner(key).remove_entry(key, value)
+
+    def remove_all_entries(self, key: bytes) -> None:
+        self._owner(key).remove_all_entries(key)
+
+    def find(self, key: bytes) -> HGSortedResultSet:
+        return self._owner(key).find(key)
+
+    def find_first(self, key: bytes) -> Optional[HGHandle]:
+        return self._owner(key).find_first(key)
+
+    def count(self, key: bytes) -> int:
+        return self._owner(key).count(key)
+
+    # -- whole-index ops merge across partitions -------------------------------
+    def key_count(self) -> int:
+        return sum(c.key_count() for c in self._children)
+
+    def scan_keys(self) -> Iterator[bytes]:
+        # each child scans in sorted order; k-way merge keeps the global
+        # sorted-key contract range scans rely on
+        yield from heapq.merge(*(c.scan_keys() for c in self._children))
+
+    def scan_values(self) -> Iterator[HGHandle]:
+        for c in self._children:
+            yield from c.scan_values()
+
+    def bulk_items(self):
+        yield from heapq.merge(
+            *(c.bulk_items() for c in self._children), key=lambda kv: kv[0]
+        )
+
+    def find_range(
+        self,
+        lo: Optional[bytes] = None,
+        hi: Optional[bytes] = None,
+        lo_inclusive: bool = True,
+        hi_inclusive: bool = False,
+    ) -> HGSortedResultSet:
+        parts = [
+            c.find_range(lo, hi, lo_inclusive, hi_inclusive).array()
+            for c in self._children
+        ]
+        parts = [p for p in parts if len(p)]
+        if not parts:
+            return HGSortedResultSet(np.empty(0, dtype=np.int64))
+        return HGSortedResultSet(np.unique(np.concatenate(parts)))
+
+    def find_lt(self, key: bytes) -> HGSortedResultSet:
+        return self.find_range(hi=key, hi_inclusive=False)
+
+    def find_lte(self, key: bytes) -> HGSortedResultSet:
+        return self.find_range(hi=key, hi_inclusive=True)
+
+    def find_gt(self, key: bytes) -> HGSortedResultSet:
+        return self.find_range(lo=key, lo_inclusive=False)
+
+    def find_gte(self, key: bytes) -> HGSortedResultSet:
+        return self.find_range(lo=key, lo_inclusive=True)
+
+    def find_by_value(self, value: HGHandle) -> list[bytes]:
+        keys: list[bytes] = []
+        for c in self._children:
+            keys.extend(c.find_by_value(value))
+        return sorted(set(keys))
+
+    def count_keys(self, value: HGHandle) -> int:
+        return len(self.find_by_value(value))
+
+
+class PartitionedStorage(StorageBackend):
+    """Handle-routed record storage + key-routed indices over N children."""
+
+    def __init__(
+        self,
+        partitions: Sequence[StorageBackend] = (),
+        n_partitions: int = 4,
+        factory: Optional[Callable[[int], StorageBackend]] = None,
+    ):
+        if partitions:
+            self._parts = list(partitions)
+        else:
+            if factory is None:
+                from hypergraphdb_tpu.storage.memstore import MemStorage
+
+                factory = lambda i: MemStorage()  # noqa: E731
+            self._parts = [factory(i) for i in range(n_partitions)]
+        if not self._parts:
+            raise ValueError("need at least one partition")
+
+    # -- lifecycle --------------------------------------------------------------
+    def startup(self) -> None:
+        for p in self._parts:
+            p.startup()
+
+    def shutdown(self) -> None:
+        for p in self._parts:
+            p.shutdown()
+
+    def checkpoint(self) -> None:
+        for p in self._parts:
+            p.checkpoint()
+
+    def commit_batch_begin(self) -> None:
+        for p in self._parts:
+            p.commit_batch_begin()
+
+    def commit_batch_end(self) -> None:
+        for p in self._parts:
+            p.commit_batch_end()
+
+    def commit_batch_abort(self) -> None:
+        for p in self._parts:
+            p.commit_batch_abort()
+
+    # -- record routing ---------------------------------------------------------
+    def _own(self, h: HGHandle) -> StorageBackend:
+        return self._parts[int(h) % len(self._parts)]
+
+    def store_link(self, h: HGHandle, targets: Sequence[HGHandle]) -> None:
+        self._own(h).store_link(h, targets)
+
+    def get_link(self, h: HGHandle):
+        return self._own(h).get_link(h)
+
+    def remove_link(self, h: HGHandle) -> None:
+        self._own(h).remove_link(h)
+
+    def contains_link(self, h: HGHandle) -> bool:
+        return self._own(h).contains_link(h)
+
+    def store_data(self, h: HGHandle, data: bytes) -> None:
+        self._own(h).store_data(h, data)
+
+    def get_data(self, h: HGHandle) -> Optional[bytes]:
+        return self._own(h).get_data(h)
+
+    def remove_data(self, h: HGHandle) -> None:
+        self._own(h).remove_data(h)
+
+    def contains_data(self, h: HGHandle) -> bool:
+        return self._own(h).contains_data(h)
+
+    def add_incidence_link(self, atom: HGHandle, link: HGHandle) -> None:
+        self._own(atom).add_incidence_link(atom, link)
+
+    def remove_incidence_link(self, atom: HGHandle, link: HGHandle) -> None:
+        self._own(atom).remove_incidence_link(atom, link)
+
+    def remove_incidence_set(self, atom: HGHandle) -> None:
+        self._own(atom).remove_incidence_set(atom)
+
+    def get_incidence_set(self, atom: HGHandle) -> HGSortedResultSet:
+        return self._own(atom).get_incidence_set(atom)
+
+    def incidence_count(self, atom: HGHandle) -> int:
+        return self._own(atom).incidence_count(atom)
+
+    # -- indices ----------------------------------------------------------------
+    def get_index(self, name: str, create: bool = True):
+        children = []
+        for p in self._parts:
+            idx = p.get_index(name, create=create)
+            if idx is None:
+                return None
+            children.append(idx)
+        return PartitionedIndex(children)
+
+    def remove_index(self, name: str) -> None:
+        for p in self._parts:
+            p.remove_index(name)
+
+    def index_names(self) -> list[str]:
+        names: set[str] = set()
+        for p in self._parts:
+            names.update(p.index_names())
+        return sorted(names)
+
+    # -- bulk export ------------------------------------------------------------
+    def bulk_links(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Concatenate the partitions' record tables, re-sorted to the
+        id-ascending order the snapshot packer expects."""
+        ids_l, offs_l, flats_l = [], [], []
+        for p in self._parts:
+            ids, offsets, flat = p.bulk_links()
+            ids_l.append(np.asarray(ids, dtype=np.int64))
+            offs_l.append(np.asarray(offsets, dtype=np.int64))
+            flats_l.append(np.asarray(flat, dtype=np.int64))
+        total_ids = np.concatenate(ids_l) if ids_l else np.empty(0, np.int64)
+        if not len(total_ids):
+            return total_ids, np.zeros(1, np.int64), np.empty(0, np.int64)
+        # rebuild per-record rows, then emit in global id order
+        rows: list[tuple[int, np.ndarray]] = []
+        for ids, offsets, flat in zip(ids_l, offs_l, flats_l):
+            for j, h in enumerate(ids.tolist()):
+                rows.append((h, flat[offsets[j]:offsets[j + 1]]))
+        rows.sort(key=lambda r: r[0])
+        out_ids = np.asarray([h for h, _ in rows], dtype=np.int64)
+        lens = np.asarray([len(r) for _, r in rows], dtype=np.int64)
+        out_offsets = np.zeros(len(rows) + 1, dtype=np.int64)
+        np.cumsum(lens, out=out_offsets[1:])
+        out_flat = (
+            np.concatenate([r for _, r in rows])
+            if rows else np.empty(0, np.int64)
+        )
+        return out_ids, out_offsets, out_flat
+
+    def max_handle(self) -> int:
+        return max(p.max_handle() for p in self._parts)

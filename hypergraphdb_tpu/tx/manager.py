@@ -314,27 +314,25 @@ class HGTransactionManager:
 
     def _value_at(self, cell: tuple, sv: int, current: Any) -> Any:
         """Reconstruct a link/data cell's value at snapshot ``sv``: the
-        pre-image of the FIRST commit after sv (chains are ascending)."""
+        pre-image of the FIRST commit after sv (chains are ascending).
+
+        Callers MUST read ``current`` from the backend BEFORE consulting
+        the history: capture happens before apply under the commit lock,
+        so a backend read that raced a commit is always correctable by the
+        (already-visible) pre-image — the reverse order has a window where
+        the history looks empty but the backend already moved."""
         for ver, old in self._history.get(cell, ()):
             if ver > sv:
                 return old
         return current
 
     def link_at(self, h: int, sv: int):
-        cell = ("link", h)
-        if cell not in self._history:
-            return self.backend.get_link(h)
-        sentinel = object()
-        got = self._value_at(cell, sv, sentinel)
-        return self.backend.get_link(h) if got is sentinel else got
+        current = self.backend.get_link(h)
+        return self._value_at(("link", h), sv, current)
 
     def data_at(self, h: int, sv: int):
-        cell = ("data", h)
-        if cell not in self._history:
-            return self.backend.get_data(h)
-        sentinel = object()
-        got = self._value_at(cell, sv, sentinel)
-        return self.backend.get_data(h) if got is sentinel else got
+        current = self.backend.get_data(h)
+        return self._value_at(("data", h), sv, current)
 
     def _set_at(self, cell: tuple, sv: int, current: set) -> set:
         """Reconstruct a set cell (incidence/index members) at ``sv`` by

@@ -16,6 +16,8 @@ from hypergraphdb_tpu.storage.memstore import MemStorage
 
 def _backends():
     yield "memory"
+    yield "partitioned"
+    yield "partitioned-native"
     try:
         from hypergraphdb_tpu.storage.native import NativeStorage  # noqa: F401
 
@@ -28,6 +30,19 @@ def _backends():
 def store(request, tmp_path):
     if request.param == "memory":
         b = MemStorage()
+    elif request.param == "partitioned":
+        from hypergraphdb_tpu.storage.partitioned import PartitionedStorage
+
+        b = PartitionedStorage(n_partitions=3)
+    elif request.param == "partitioned-native":
+        pytest.importorskip("hypergraphdb_tpu.storage.native")
+        from hypergraphdb_tpu.storage.native import NativeStorage
+        from hypergraphdb_tpu.storage.partitioned import PartitionedStorage
+
+        b = PartitionedStorage(
+            n_partitions=3,
+            factory=lambda i: NativeStorage(str(tmp_path / f"part{i}")),
+        )
     else:
         from hypergraphdb_tpu.storage.native import NativeStorage
 
