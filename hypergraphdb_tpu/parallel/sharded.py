@@ -329,6 +329,13 @@ def bfs_packed_sharded_blocked(
         )
     seeds = np.asarray(seeds, dtype=np.int32)
     K = len(seeds)
+    if K == 0:
+        w = (sdev.n_loc * len(sdev.mesh.devices.flat)) // WORD
+        return (
+            jnp.zeros((0, w), dtype=jnp.uint32),
+            np.zeros(0, dtype=np.int64),
+            device_memory_stats(),
+        )
     pads = (-K) % WORD
     if pads:
         seeds = np.concatenate(

@@ -490,6 +490,14 @@ class LinkProjectionMapping:
 
     position: int
 
+    def __post_init__(self):
+        if int(self.position) < 0:
+            raise QueryError(
+                "LinkProjectionMapping position must be >= 0 (negative "
+                "indexing would mean different things on the columnar and "
+                "per-handle paths)"
+            )
+
     def apply(self, graph, arr: np.ndarray) -> np.ndarray:
         if len(arr) == 0:
             return arr

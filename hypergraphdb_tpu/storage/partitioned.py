@@ -12,8 +12,13 @@ every SPI operation across N child backends —
 - **index entries route by key** (stable key hash), with range scans and
   key enumeration served by k-way merges across all partitions (Hazelcast
   orders within partitions the same way),
-- commit-batch barriers fan out to every partition, so a crash replays
-  each partition's WAL to the same barrier.
+- commit-batch barriers fan out to every partition. NB: the fan-out is
+  sequential and uncoordinated — each partition replays atomically to
+  ITS OWN last barrier, but a crash between two partitions' barrier
+  writes can leave one partition a commit ahead of another (the same
+  eventual-consistency stance as a real storage grid; a cross-partition
+  commit marker would be the upgrade path to atomic multi-partition
+  recovery).
 
 Children are any ``StorageBackend`` (memory partitions for tests, native
 C++ WAL stores for durable sharding — the closest single-process analogue
@@ -248,20 +253,36 @@ class PartitionedStorage(StorageBackend):
         total_ids = np.concatenate(ids_l) if ids_l else np.empty(0, np.int64)
         if not len(total_ids):
             return total_ids, np.zeros(1, np.int64), np.empty(0, np.int64)
-        # rebuild per-record rows, then emit in global id order
-        rows: list[tuple[int, np.ndarray]] = []
-        for ids, offsets, flat in zip(ids_l, offs_l, flats_l):
-            for j, h in enumerate(ids.tolist()):
-                rows.append((h, flat[offsets[j]:offsets[j + 1]]))
-        rows.sort(key=lambda r: r[0])
-        out_ids = np.asarray([h for h, _ in rows], dtype=np.int64)
-        lens = np.asarray([len(r) for _, r in rows], dtype=np.int64)
-        out_offsets = np.zeros(len(rows) + 1, dtype=np.int64)
-        np.cumsum(lens, out=out_offsets[1:])
-        out_flat = (
-            np.concatenate([r for _, r in rows])
-            if rows else np.empty(0, np.int64)
+        # vectorized global re-sort (this is the snapshot-pack hot path):
+        # permute record lengths by id order, then gather each record's
+        # flat slice via repeat/offset arithmetic — no per-record python
+        all_lens = np.concatenate(
+            [o[1:] - o[:-1] for o in offs_l]
+        ).astype(np.int64)
+        # rebase starts into the concatenated flat array
+        flat_cat = (
+            np.concatenate(flats_l) if flats_l else np.empty(0, np.int64)
         )
+        base = 0
+        rebased = []
+        for o, f in zip(offs_l, flats_l):
+            rebased.append(o[:-1].astype(np.int64) + base)
+            base += len(f)
+        all_starts = np.concatenate(rebased)
+        order = np.argsort(total_ids, kind="stable")
+        out_ids = total_ids[order]
+        lens = all_lens[order]
+        starts = all_starts[order]
+        out_offsets = np.zeros(len(out_ids) + 1, dtype=np.int64)
+        np.cumsum(lens, out=out_offsets[1:])
+        total = int(lens.sum())
+        if total:
+            idx = np.repeat(
+                starts - np.concatenate([[0], np.cumsum(lens)[:-1]]), lens
+            ) + np.arange(total, dtype=np.int64)
+            out_flat = flat_cat[idx]
+        else:
+            out_flat = np.empty(0, np.int64)
         return out_ids, out_offsets, out_flat
 
     def max_handle(self) -> int:

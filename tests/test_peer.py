@@ -295,3 +295,79 @@ def test_replication_ingest_overhead_bounded():
         g_repl.close()
         ratios.append(t_repl / t_plain)
     assert min(ratios) < 2.0, ratios
+
+
+def test_contract_net_conversation():
+    """FIPA contract-net (ProposalConversation analogue): CFP → bids →
+    accept lowest → perform → result; losers are rejected cleanly."""
+    from hypergraphdb_tpu.peer.contractnet import ContractNet, TaskParticipant
+
+    class Worker(TaskParticipant):
+        COSTS = {"w1": 5, "w2": 2, "w3": 9}
+
+        def bid(self, task):
+            me = self.peer.identity
+            if task.get("kind") != "count":
+                return None
+            return {"cost": self.COSTS[me]}
+
+        def perform(self, task):
+            return {"by": self.peer.identity,
+                    "n": self.peer.graph.atom_count()}
+
+    net = LoopbackNetwork()
+    peers = []
+    for pid in ("boss", "w1", "w2", "w3"):
+        g = hg.HyperGraph()
+        p = HyperGraphPeer.loopback(g, net, identity=pid)
+        if pid != "boss":
+            p.activities.register_type(
+                ContractNet.TYPE,
+                lambda peer, activity_id=None: Worker(
+                    peer, activity_id=activity_id),
+            )
+        p.start()
+        peers.append((p, g))
+    boss = peers[0][0]
+    try:
+        act = boss.activities.initiate(ContractNet(
+            boss, task={"kind": "count"},
+            participants=["w1", "w2", "w3"],
+        ))
+        winner, result = act.future.result(timeout=10)
+        assert winner == "w2"  # lowest cost bid
+        assert result["by"] == "w2"
+        assert isinstance(result["n"], int)
+    finally:
+        for p, g in peers:
+            p.stop()
+            g.close()
+
+
+def test_contract_net_all_refuse():
+    from hypergraphdb_tpu.peer.contractnet import ContractNet, TaskParticipant
+
+    class Refuser(TaskParticipant):
+        def bid(self, task):
+            return None
+
+    net = LoopbackNetwork()
+    g1, g2 = hg.HyperGraph(), hg.HyperGraph()
+    boss = HyperGraphPeer.loopback(g1, net, identity="boss")
+    w = HyperGraphPeer.loopback(g2, net, identity="w")
+    w.activities.register_type(
+        ContractNet.TYPE,
+        lambda peer, activity_id=None: Refuser(peer, activity_id=activity_id),
+    )
+    boss.start()
+    w.start()
+    try:
+        act = boss.activities.initiate(ContractNet(
+            boss, task={"kind": "anything"}, participants=["w"]))
+        with pytest.raises(Exception, match="refused"):
+            act.future.result(timeout=10)
+    finally:
+        boss.stop()
+        w.stop()
+        g1.close()
+        g2.close()
