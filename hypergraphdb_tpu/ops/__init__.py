@@ -10,6 +10,12 @@ from hypergraphdb_tpu.ops.bitfrontier import (
 )
 from hypergraphdb_tpu.ops.ellbfs import PullBFSResult, bfs_pull, visited_rows
 from hypergraphdb_tpu.ops.incremental import SnapshotManager, bfs_levels_delta
+from hypergraphdb_tpu.ops.setops import (
+    and_incident_pattern,
+    collect_pattern,
+    execute_pattern,
+    plan_pattern,
+)
 from hypergraphdb_tpu.ops.checkpoint import (
     copy_subgraph,
     export_graph,
@@ -23,8 +29,12 @@ __all__ = [
     "DeviceSnapshot",
     "PullBFSResult",
     "SnapshotManager",
+    "and_incident_pattern",
     "bfs_levels",
     "bfs_pull",
+    "collect_pattern",
+    "execute_pattern",
+    "plan_pattern",
     "visited_rows",
     "bfs_memory_bytes",
     "bfs_packed",

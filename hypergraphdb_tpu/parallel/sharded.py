@@ -331,10 +331,18 @@ def bfs_packed_sharded_blocked(
     K = len(seeds)
     if K == 0:
         w = (sdev.n_loc * len(sdev.mesh.devices.flat)) // WORD
+        empty_report = {
+            did: {
+                "bytes_in_use_before": stats["bytes_in_use"],
+                "bytes_in_use_after": stats["bytes_in_use"],
+                "process_peak_bytes_in_use": stats["process_peak_bytes_in_use"],
+            }
+            for did, stats in device_memory_stats().items()
+        }
         return (
             jnp.zeros((0, w), dtype=jnp.uint32),
             np.zeros(0, dtype=np.int64),
-            device_memory_stats(),
+            empty_report,
         )
     pads = (-K) % WORD
     if pads:

@@ -55,6 +55,11 @@ class Activity:
         self.state = STARTED
         self.future: Future = Future()
         self._transitions = self._collect_transitions()
+        # transitions of ONE activity must serialize: the manager's worker
+        # pool can otherwise run two messages of the same conversation
+        # concurrently, racing FSM state (the reference serializes through
+        # per-activity action queues; our heap pops can interleave)
+        self._handle_lock = threading.Lock()
 
     @classmethod
     def _collect_transitions(cls) -> list:
@@ -70,16 +75,20 @@ class Activity:
         """Client-side kick-off: send the opening message."""
 
     def handle(self, sender: str, msg: dict) -> None:
-        """Dispatch to the matching @from_state transition."""
-        for fn in self._transitions:
-            if fn._from_state == self.state and (
-                fn._performative is None
-                or fn._performative == msg.get("performative")
-            ):
-                fn(self, sender, msg)
-                return
-        self.fail(f"no transition from {self.state} "
-                  f"for {msg.get('performative')}")
+        """Dispatch to the matching @from_state transition (serialized per
+        activity — see ``_handle_lock``)."""
+        with self._handle_lock:
+            if self.state in TERMINAL:
+                return  # late message after completion: drop, don't fail
+            for fn in self._transitions:
+                if fn._from_state == self.state and (
+                    fn._performative is None
+                    or fn._performative == msg.get("performative")
+                ):
+                    fn(self, sender, msg)
+                    return
+            self.fail(f"no transition from {self.state} "
+                      f"for {msg.get('performative')}")
 
     def complete(self, result: Any = None) -> None:
         self.state = COMPLETED

@@ -72,6 +72,9 @@ class ContractNet(Activity):
         self.winner: Optional[str] = None
 
     def initiate(self) -> None:
+        if not self.participants:
+            self.fail("no participants to call for proposals")
+            return
         self.state = WAITING_PROPOSALS
         for pid in self.participants:
             self.send(pid, M.REQUEST, {"what": "cfp", "task": self.task})
@@ -116,6 +119,16 @@ class ContractNet(Activity):
     def on_failure(self, sender: str, msg: dict) -> None:
         if sender == self.winner:
             self.fail(f"winner {sender} failed: {msg.get('content')}")
+
+    # late bids/refusals after the decision are protocol noise, not errors
+    @from_state(WAITING_RESULT, M.PROPOSE)
+    def on_late_propose(self, sender: str, msg: dict) -> None:
+        if sender in self.participants and sender not in self.bids:
+            self.send(sender, M.REJECT_PROPOSAL, None)
+
+    @from_state(WAITING_RESULT, M.REFUSE)
+    def on_late_refuse(self, sender: str, msg: dict) -> None:
+        pass
 
 
 class TaskParticipant(Activity):
