@@ -31,6 +31,10 @@ class CacheConfig:
     atom_cache_size: int = 1 << 20
     incidence_cache_entries: int = 1 << 16
     max_cached_incidence_set_size: int = 1 << 20
+    #: RSS threshold (bytes) above which caches shrink; 0 disables the
+    #: watcher (MemoryWarningSystem analogue)
+    memory_warning_bytes: int = 0
+    memory_warning_interval_s: float = 5.0
 
 
 @dataclass

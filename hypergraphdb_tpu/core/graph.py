@@ -86,7 +86,13 @@ class HyperGraph:
         self.backend = backend
         backend.startup()
         self.txman = HGTransactionManager(backend, enabled=self.config.transactional)
-        self.store = HGStore(backend, self.txman)
+        self.store = HGStore(
+            backend, self.txman,
+            incidence_cache_entries=self.config.cache.incidence_cache_entries,
+            max_cached_incidence_set_size=(
+                self.config.cache.max_cached_incidence_set_size
+            ),
+        )
         if self.config.handle_factory == "uuid":
             self.handles: HandleFactory = UUIDHandleFactory()
         else:
@@ -103,6 +109,18 @@ class HyperGraph:
         self._snapshot_cache = None
         self._snapshot_mgr = None  # incremental mode (enable_incremental)
         self._mutations = 0  # bumped on every committed structural change
+        self._memwatch = None
+        if self.config.cache.memory_warning_bytes > 0:
+            from hypergraphdb_tpu.utils.cache import MemoryWarningSystem
+
+            self._memwatch = MemoryWarningSystem(
+                self.config.cache.memory_warning_bytes,
+                self.config.cache.memory_warning_interval_s,
+            )
+            self._memwatch.add_listener(self._atom_cache.clear)
+            if self.store._inc_cache is not None:
+                self._memwatch.add_listener(self.store._inc_cache.clear)
+            self._memwatch.start()
         self._open = True
         # restore the database's self-knowledge from the store (the
         # reference's HGIndexManager.loadIndexers + class↔type index
@@ -168,6 +186,9 @@ class HyperGraph:
         if not getattr(self, "_open", False):
             return
         self.events.dispatch(self, ev.HGClosingEvent(graph=self))
+        if self._memwatch is not None:
+            self._memwatch.stop()
+            self._memwatch = None
         if self._snapshot_mgr is not None:
             self._snapshot_mgr.close()
             self._snapshot_mgr = None

@@ -29,9 +29,53 @@ from hypergraphdb_tpu.tx.manager import (
 
 
 class HGStore:
-    def __init__(self, backend: StorageBackend, txman: HGTransactionManager):
+    def __init__(self, backend: StorageBackend, txman: HGTransactionManager,
+                 incidence_cache_entries: int = 0,
+                 max_cached_incidence_set_size: int = 0):
         self.backend = backend
         self.tx = txman
+        # incidence-set LRU (the reference wires an LRUCache with a
+        # maxCachedIncidenceSetSize cap at HyperGraph.java:316-323 /
+        # HGConfiguration.java:39): entries are (cell_version, readonly
+        # array) — version-validated, so invalidation is free
+        from hypergraphdb_tpu.utils.cache import LRUCache
+
+        self._inc_cache = (
+            LRUCache(incidence_cache_entries)
+            if incidence_cache_entries > 0 else None
+        )
+        self._inc_cache_max = max_cached_incidence_set_size
+
+    def _committed_incidence(self, atom: int, sv: Optional[int]) -> np.ndarray:
+        """The committed incidence array for ``atom`` as of snapshot ``sv``
+        (None = latest), through the capped LRU when possible.
+
+        Snapshot readers NEVER take the raw-backend fast path on a miss:
+        ``tx.inc_at`` reads the backend first and then undoes newer
+        history, which is the race-free order (see ``_value_at`` in
+        tx/manager.py). Cache entries are only written when the cell
+        version is unchanged across the read — a mid-read commit must not
+        publish a torn array."""
+        cache = self._inc_cache
+        ver = self.tx.cell_version(("inc", atom))
+        if cache is not None and (sv is None or ver <= sv):
+            hit = cache.get(atom)
+            if hit is not None and hit[0] == ver:
+                return hit[1]
+        if sv is not None:
+            arr = self.tx.inc_at(atom, sv)
+        else:
+            arr = self.backend.get_incidence_set(atom).array()
+        if (
+            cache is not None
+            and len(arr) <= self._inc_cache_max
+            and (sv is None or ver <= sv)
+            and self.tx.cell_version(("inc", atom)) == ver
+        ):
+            arr = np.asarray(arr)
+            arr.setflags(write=False)  # shared across readers
+            cache.put(atom, (ver, arr))
+        return arr
 
     # ---- links --------------------------------------------------------------
     def store_link(self, h: HGHandle, targets: Sequence[HGHandle]) -> None:
@@ -123,9 +167,9 @@ class HGStore:
         tx = self.tx.current()
         if tx is not None:
             tx.note_read(("inc", atom))
-            base = self.tx.inc_at(atom, tx.start_version)
+            base = self._committed_incidence(atom, tx.start_version)
         else:
-            base = self.backend.get_incidence_set(atom).array()
+            base = self._committed_incidence(atom, None)
         # merge overlay deltas, innermost-last
         deltas: list[_IncDelta] = []
         t = tx

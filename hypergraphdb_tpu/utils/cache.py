@@ -19,37 +19,125 @@ _MISSING = object()
 
 
 class LRUCache(Generic[K, V]):
-    __slots__ = ("_d", "capacity", "hits", "misses")
+    """Bounded LRU, thread-safe: shared across reader threads and the
+    memory-watcher daemon (an unguarded ``move_to_end`` would KeyError if
+    another thread evicted/cleared the key mid-``get``)."""
+
+    __slots__ = ("_d", "_lock", "capacity", "hits", "misses")
 
     def __init__(self, capacity: int = 1 << 16):
+        import threading
+
         self._d: OrderedDict[K, V] = OrderedDict()
+        self._lock = threading.Lock()
         self.capacity = capacity
         self.hits = 0
         self.misses = 0
 
     def get(self, key: K, default: Any = None) -> Optional[V]:
-        v = self._d.get(key, _MISSING)
-        if v is _MISSING:
-            self.misses += 1
-            return default
-        self._d.move_to_end(key)
-        self.hits += 1
-        return v
+        with self._lock:
+            v = self._d.get(key, _MISSING)
+            if v is _MISSING:
+                self.misses += 1
+                return default
+            self._d.move_to_end(key)
+            self.hits += 1
+            return v
 
     def put(self, key: K, value: V) -> None:
-        self._d[key] = value
-        self._d.move_to_end(key)
-        while len(self._d) > self.capacity:
-            self._d.popitem(last=False)
+        with self._lock:
+            self._d[key] = value
+            self._d.move_to_end(key)
+            while len(self._d) > self.capacity:
+                self._d.popitem(last=False)
 
     def invalidate(self, key: K) -> None:
-        self._d.pop(key, None)
+        with self._lock:
+            self._d.pop(key, None)
 
     def clear(self) -> None:
-        self._d.clear()
+        with self._lock:
+            self._d.clear()
 
     def __len__(self) -> int:
         return len(self._d)
 
     def __contains__(self, key: K) -> bool:
         return key in self._d
+
+
+class MemoryWarningSystem:
+    """RSS-threshold cache eviction — the ``util/MemoryWarningSystem``
+    analogue (the reference listens to JVM memory-pool thresholds and
+    shrinks caches, ``cache/ColdAtoms.java:32-52``, ``LRUCache.java:227``).
+
+    Listeners are shrink callbacks; ``check_now()`` reads the process RSS
+    from ``/proc/self/statm`` and fires them when over the threshold. A
+    daemon thread polls on an interval; tests call ``check_now`` directly.
+    """
+
+    def __init__(self, threshold_bytes: int, interval_s: float = 5.0):
+        import threading
+
+        self.threshold_bytes = int(threshold_bytes)
+        self.interval_s = interval_s
+        self._listeners: list = []
+        self._stop = threading.Event()
+        self._thread = None
+        self.triggered = 0
+
+    def add_listener(self, fn) -> None:
+        self._listeners.append(fn)
+
+    @staticmethod
+    def rss_bytes() -> int:
+        try:
+            with open("/proc/self/statm") as f:
+                pages = int(f.read().split()[1])
+            import os
+
+            return pages * os.sysconf("SC_PAGE_SIZE")
+        except Exception:  # pragma: no cover - non-linux
+            # no reliable CURRENT-rss source without psutil (ru_maxrss is a
+            # lifetime peak — and platform-dependent units — which would
+            # latch the watcher permanently on once tripped): stay inert
+            return 0
+
+    def check_now(self) -> bool:
+        if self.threshold_bytes <= 0:
+            return False
+        if self.rss_bytes() <= self.threshold_bytes:
+            return False
+        self.triggered += 1
+        for fn in list(self._listeners):
+            try:
+                fn()
+            except Exception:  # pragma: no cover - listener bug
+                import logging
+
+                logging.getLogger("hypergraphdb_tpu.cache").warning(
+                    "memory-warning listener failed", exc_info=True
+                )
+        return True
+
+    def start(self) -> None:
+        import threading
+
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                self.check_now()
+
+        self._thread = threading.Thread(
+            target=loop, name="hgdb-memwatch", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

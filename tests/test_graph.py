@@ -259,3 +259,59 @@ def test_multihost_helpers():
     mesh = multihost.global_mesh()
     assert mesh.devices.size == info["global_devices"]
     assert not multihost.is_multihost()
+
+
+# ---------------------------------------------------------------- caching
+
+
+def test_incidence_cache_hit_and_invalidation(graph):
+    """The incidence LRU (HGConfiguration.maxCachedIncidenceSetSize
+    analogue) must serve repeated reads and invalidate on mutation."""
+    a = graph.add("hub")
+    l1 = graph.add_link((a,), value=1)
+    cache = graph.store._inc_cache
+    assert cache is not None
+    r1 = graph.get_incidence_set(a).array()
+    assert r1.tolist() == [int(l1)]
+    assert int(a) in cache  # populated
+    # a cached array is shared readonly — callers cannot corrupt it
+    import numpy as np
+    import pytest as _pytest
+    hit = graph.get_incidence_set(a).array()
+    if hit.base is not None or not hit.flags.writeable:
+        with _pytest.raises(ValueError):
+            hit[0] = 999
+    # mutation bumps the cell version: next read re-fetches
+    l2 = graph.add_link((a,), value=2)
+    r2 = graph.get_incidence_set(a).array()
+    assert r2.tolist() == sorted([int(l1), int(l2)])
+
+
+def test_oversized_incidence_sets_not_cached():
+    from hypergraphdb_tpu import HGConfiguration, HyperGraph
+
+    cfg = HGConfiguration()
+    cfg.cache.max_cached_incidence_set_size = 2
+    g = HyperGraph(cfg)
+    a = g.add("hub")
+    for i in range(5):
+        g.add_link((a,), value=i)
+    assert len(g.get_incidence_set(a)) == 5
+    assert int(a) not in g.store._inc_cache  # over the cap: not cached
+    g.close()
+
+
+def test_memory_warning_evicts_caches():
+    from hypergraphdb_tpu import HGConfiguration, HyperGraph
+
+    cfg = HGConfiguration()
+    cfg.cache.memory_warning_bytes = 1  # any RSS trips it
+    cfg.cache.memory_warning_interval_s = 3600  # no background noise
+    g = HyperGraph(cfg)
+    a = g.add("x")
+    g.add_link((a,), value=1)
+    g.get_incidence_set(a)
+    assert len(g.store._inc_cache) > 0
+    assert g._memwatch.check_now()  # over threshold → listeners fired
+    assert len(g.store._inc_cache) == 0
+    g.close()
