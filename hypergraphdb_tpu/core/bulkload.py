@@ -68,11 +68,43 @@ def bulk_import(
 
     with graph.txman._commit_lock:
         r = graph.handles.make_many(n)
+        # MVCC pre-image capture (ADVICE r4): a transaction begun BEFORE
+        # this load must keep its begin-time view of every inc/idx cell the
+        # load touches (its reads go straight to the backend when the
+        # history chain is empty). Mirror _capture_history lazily: full
+        # pre-image per cell, recorded before the first write, tagged with
+        # the clock tick this batch will commit as. begin() also takes the
+        # commit lock, so the active-set check cannot race a new reader.
+        # Scope is inc/idx only, like the version bumps below: the
+        # link/data cells all belong to handles minted by THIS load, and a
+        # snapshot reader can only discover those through the captured
+        # index cells — per-atom link/data pre-images would cost O(load)
+        # history memory to cover handles no snapshot can reach.
+        txman = graph.txman
+        # no current() tx on this thread — any active tx is a reader
+        capturing = bool(txman._active)
+        vnext = txman._clock + 1
+        captured: set = set()
+
+        def cap(cell, read_pre):
+            if not capturing or cell in captured:
+                return
+            captured.add(cell)
+            txman._history.setdefault(cell, []).append(
+                (vnext, ("full", read_pre()))
+            )
+
+        def cap_user_idx(storage_name, key, idx):
+            cap(("idx", storage_name, key),
+                lambda: idx.find(key).array().copy())
+
         backend.commit_batch_begin()
         try:
             by_type = backend.get_index(IDX_BY_TYPE)
             by_value = backend.get_index(IDX_BY_VALUE)
             tkey = _type_key(type_handle)
+            cap(("idx", IDX_BY_TYPE, tkey),
+                lambda: by_type.find(tkey).array().copy())
             flags = _FLAG_LINK if target_lists is not None else 0
             value_keys: set = set()
             touched_targets: set = set()
@@ -92,16 +124,38 @@ def bulk_import(
                 backend.store_link(h, (type_handle, value_handle, flags)
                                    + targets)
                 by_type.add_entry(tkey, h)
+                if capturing:
+                    cap(("idx", IDX_BY_VALUE, vkey),
+                        lambda k=vkey: by_value.find(k).array().copy())
                 by_value.add_entry(vkey, h)
                 value_keys.add(vkey)
                 for t in targets:
+                    if capturing:
+                        cap(("inc", t),
+                            lambda a=t: backend.get_incidence_set(a)
+                            .array().copy())
                     backend.add_incidence_link(t, h)
                     touched_targets.add(t)
                 if has_indexers:
                     maybe_index(graph, h, type_handle, v, targets or None,
-                                touched=touched_user_idx)
+                                touched=touched_user_idx,
+                                before_write=(cap_user_idx if capturing
+                                              else None))
         except BaseException:
             backend.commit_batch_abort()
+            # the tick `vnext` will never commit — drop its pre-images so
+            # the per-cell chains keep one entry per real commit version.
+            # Rebind a FRESH list (never mutate in place): lock-free
+            # readers may hold a live iterator over the old one
+            # (_gc_history keeps the same discipline).
+            for cell in captured:
+                entries = txman._history.get(cell)
+                if entries is not None:
+                    keep = [e for e in entries if e[0] != vnext]
+                    if keep:
+                        txman._history[cell] = keep
+                    else:
+                        del txman._history[cell]
             raise
         else:
             backend.commit_batch_end()

@@ -50,29 +50,30 @@ class HGStore:
         """The committed incidence array for ``atom`` as of snapshot ``sv``
         (None = latest), through the capped LRU when possible.
 
-        Snapshot readers NEVER take the raw-backend fast path on a miss:
-        ``tx.inc_at`` reads the backend first and then undoes newer
-        history, which is the race-free order (see ``_value_at`` in
-        tx/manager.py). Cache entries are only written when the cell
-        version is unchanged across the read — a mid-read commit must not
-        publish a torn array."""
+        Misses ALWAYS go through the MVCC reconstruction (``tx.inc_at``
+        reads the backend first and then undoes newer history — the
+        race-free order, see ``_value_at`` in tx/manager.py), pinned at
+        ``sv`` for snapshot readers and at the observed ``ver`` otherwise.
+        A raw backend read is NOT safe to cache: commit applies backend
+        writes before bumping ``_versions``, so a read that straddles
+        ``_apply`` can pair a post-commit array with the pre-commit
+        version and survive the version re-check (ADVICE r4). The
+        reconstruction undoes exactly that in-flight commit, so the array
+        is the value at ``ver`` by construction; the re-check below only
+        guards the completed-commit + history-GC window."""
         cache = self._inc_cache
         ver = self.tx.cell_version(("inc", atom))
         if cache is not None and (sv is None or ver <= sv):
             hit = cache.get(atom)
             if hit is not None and hit[0] == ver:
                 return hit[1]
-        if sv is not None:
-            arr = self.tx.inc_at(atom, sv)
-        else:
-            arr = self.backend.get_incidence_set(atom).array()
+        arr = self.tx.inc_at(atom, sv if sv is not None else ver)
         if (
             cache is not None
             and len(arr) <= self._inc_cache_max
             and (sv is None or ver <= sv)
             and self.tx.cell_version(("inc", atom)) == ver
         ):
-            arr = np.asarray(arr)
             arr.setflags(write=False)  # shared across readers
             cache.put(atom, (ver, arr))
         return arr

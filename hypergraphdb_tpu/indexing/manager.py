@@ -14,7 +14,7 @@ handle; ``maybe_index`` fires only for atoms of that type (or its subtypes).
 
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence
+from typing import Any, Callable, Optional, Sequence
 
 from hypergraphdb_tpu.core.handles import HGHandle
 from hypergraphdb_tpu.utils.ordered_bytes import encode_int
@@ -368,14 +368,20 @@ def maybe_index(
     value: Any,
     targets: Optional[Sequence[HGHandle]],
     touched: Optional[set] = None,
+    before_write: Optional[Callable] = None,
 ) -> None:
     """Called from the kernel's add path (``HyperGraph.java:1618``).
     ``touched`` (if given) collects the ``(index_name, key)`` cells written
     — bulk loaders bump their transaction versions so open readers fail
-    validation instead of committing on stale index reads."""
+    validation instead of committing on stale index reads.
+    ``before_write(storage_name, key, idx)`` (if given) runs before the
+    first entry lands on a key — bulk loaders capture MVCC pre-images
+    there so snapshot readers keep their begin-time view."""
     for indexer in indexers_of(graph, type_handle):
         idx = get_index(graph, indexer.name)
         for key in indexer.keys(graph, h, value, targets):
+            if before_write is not None:
+                before_write(_storage_name(indexer.name), key, idx)
             for v in indexer.values(graph, h, value, targets):
                 idx.add_entry(key, v)
             if touched is not None:

@@ -352,8 +352,17 @@ class HGTransactionManager:
         return vals
 
     def inc_at(self, atom: int, sv: int) -> np.ndarray:
-        cur = set(self.backend.get_incidence_set(atom).array().tolist())
-        vals = self._set_at(("inc", atom), sv, cur)
+        arr = self.backend.get_incidence_set(atom).array()
+        # fast path: no history for this cell → `arr` already is the value
+        # at `sv`. The membership check runs AFTER the backend read:
+        # capture-before-apply means a commit that raced the read has
+        # already published its pre-image, so an empty chain here proves
+        # the read didn't straddle an apply. (Backends return fresh
+        # arrays — memstore snapshots, native copies out — so callers may
+        # freeze/cache the result.)
+        if ("inc", atom) not in self._history:
+            return np.asarray(arr, dtype=np.int64)
+        vals = self._set_at(("inc", atom), sv, set(arr.tolist()))
         return np.asarray(sorted(vals), dtype=np.int64)
 
     def idx_at(self, name: str, key: bytes, sv: int) -> np.ndarray:

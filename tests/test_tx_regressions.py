@@ -91,3 +91,41 @@ def test_environment_does_not_mutate_caller_config(tmp_path):
     assert cfg.location is None
     assert cfg.store_backend == "memory"
     environment.close(str(tmp_path / "db"))
+
+
+def test_bulk_import_preserves_open_snapshots(graph: HyperGraph):
+    """A transaction begun BEFORE a concurrent bulk_import keeps its
+    begin-time view of every cell the load touches (ADVICE r4: bulk_import
+    bumped versions but captured no MVCC pre-images)."""
+    import threading
+
+    target = graph.add("target")
+    l0 = graph.add_link((target,), value="pre")
+    from hypergraphdb_tpu.core.graph import IDX_BY_VALUE
+
+    tx = graph.txman.begin()
+    # warm the read-set/snapshot on the cells the bulk load will touch
+    pre_inc = graph.get_incidence_set(target).array().tolist()
+    pre_vals = graph.store.get_index(IDX_BY_VALUE, create=True)
+
+    done = threading.Event()
+
+    def load():
+        graph.bulk_import(
+            values=[f"bulk{i}" for i in range(8)],
+            target_lists=[[int(target)]] * 8,
+        )
+        done.set()
+
+    t = threading.Thread(target=load)
+    t.start()
+    t.join()
+    assert done.is_set()
+    # snapshot reads must still see the pre-load state
+    assert graph.get_incidence_set(target).array().tolist() == pre_inc == [int(l0)]
+    th = graph._resolve_type_handle("bulk0", None)
+    key = graph.typesystem.get_type(int(th)).to_key("bulk0")
+    assert len(pre_vals.find(key)) == 0  # bulk value keys invisible in-tx
+    graph.txman.abort(tx)
+    # outside the snapshot the bulk atoms are visible
+    assert len(graph.get_incidence_set(target)) == 9
