@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import base64
 import json
+import os
 from typing import Optional, Sequence
 
 import numpy as np
@@ -34,7 +35,16 @@ def _npz_path(path: str) -> str:
     return path if path.endswith(".npz") else path + ".npz"
 
 
-def save_snapshot(snap: CSRSnapshot, path: str) -> None:
+def _plans_path(path: str) -> str:
+    return _npz_path(path)[:-4] + ".plans.npz"
+
+
+def save_snapshot(snap: CSRSnapshot, path: str,
+                  with_plans: bool = False) -> None:
+    """Persist the CSR arrays; ``with_plans=True`` additionally writes the
+    pull-BFS plan pyramid next to the npz (``<path>.plans.npz``), so a
+    reopened session skips the plan rebuild (the reference never rebuilds
+    its indexes on open either — ``HGStore.java:282``)."""
     by_type_keys = np.asarray(sorted(snap.by_type), dtype=np.int64)
     arrays = {
         "version": np.asarray([snap.version], dtype=np.int64),
@@ -57,11 +67,37 @@ def save_snapshot(snap: CSRSnapshot, path: str) -> None:
     for k in by_type_keys.tolist():
         arrays[f"bt_{k}"] = snap.by_type[int(k)]
     np.savez_compressed(_npz_path(path), **arrays)
+    pp = _plans_path(path)
+    if with_plans:
+        from hypergraphdb_tpu.ops.ellbfs import (
+            plans_for, save_plans, snapshot_fingerprint)
+
+        save_plans(plans_for(snap), pp,
+                   fingerprint=snapshot_fingerprint(snap))
+    elif os.path.exists(pp):
+        # overwriting a snapshot without plans must not leave a stale
+        # sidecar behind for the loader to pick up
+        os.remove(pp)
 
 
 def load_snapshot(path: str) -> CSRSnapshot:
+    """Restore a snapshot; a sibling ``.plans.npz`` (see
+    :func:`save_snapshot`) is attached so ``plans_for`` is a no-op."""
     with np.load(_npz_path(path)) as z:
-        return _snapshot_from_npz(z)
+        snap = _snapshot_from_npz(z)
+    pp = _plans_path(path)
+    if os.path.exists(pp):
+        from hypergraphdb_tpu.ops.ellbfs import (
+            load_plans, snapshot_fingerprint)
+
+        try:
+            plans = load_plans(
+                pp, expect_fingerprint=snapshot_fingerprint(snap)
+            )
+            object.__setattr__(snap, "_pull_plans", plans)
+        except Exception:
+            pass  # stale/mismatched sidecar → plans_for rebuilds
+    return snap
 
 
 def _snapshot_from_npz(z) -> CSRSnapshot:

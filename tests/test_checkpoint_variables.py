@@ -134,3 +134,53 @@ def test_snapshot_path_without_extension(graph, tmp_path):
     save_snapshot(snap, p)
     back = load_snapshot(p)  # both sides normalize to .npz
     assert back.num_atoms == snap.num_atoms
+
+
+def test_plans_persist_with_snapshot(tmp_path, graph):
+    """save_snapshot(with_plans=True) writes a sidecar the loader attaches,
+    and the restored plans drive bit-identical BFS results."""
+    import numpy as np
+
+    from tests.conftest import make_random_hypergraph
+    from hypergraphdb_tpu.ops import checkpoint as cp
+    from hypergraphdb_tpu.ops.ellbfs import bfs_pull, plans_for
+
+    make_random_hypergraph(graph, n_nodes=150, n_links=300, seed=11)
+    snap = graph.snapshot()
+    path = str(tmp_path / "snap.npz")
+    cp.save_snapshot(snap, path, with_plans=True)
+    loaded = cp.load_snapshot(path)
+    assert getattr(loaded, "_pull_plans", None) is not None  # no rebuild
+    seeds = np.arange(24, dtype=np.int32)
+    a = bfs_pull(snap, seeds, 3)
+    b = bfs_pull(loaded, seeds, 3)
+    assert np.array_equal(a.edges_touched, b.edges_touched)
+    assert np.array_equal(np.asarray(a.visited_t), np.asarray(b.visited_t))
+    # plan pyramids round-trip exactly
+    p0, p1 = plans_for(snap), loaded._pull_plans
+    assert p0.stage2_widths == p1.stage2_widths
+    for x, y in zip(p0.stage1.levels, p1.stage1.levels):
+        assert np.array_equal(x, y)
+    assert np.array_equal(p0.out_map, p1.out_map)
+
+
+def test_plan_cache_env_roundtrip(tmp_path, graph, monkeypatch):
+    import numpy as np
+
+    from tests.conftest import make_random_hypergraph
+    from hypergraphdb_tpu.ops import ellbfs as E
+
+    make_random_hypergraph(graph, n_nodes=100, n_links=200, seed=5)
+    snap = graph.snapshot()
+    monkeypatch.setenv("HG_PLAN_CACHE", str(tmp_path / "plancache"))
+    p0 = E.plans_for(snap)
+    # a content-identical snapshot hits the disk cache, not the builder
+    snap2 = graph.snapshot()
+    calls = []
+    monkeypatch.setattr(E, "build_pull_plans",
+                        lambda *a, **k: calls.append(1))
+    p1 = E.plans_for(snap2)
+    assert not calls  # loaded, not rebuilt
+    assert np.array_equal(p0.out_map, p1.out_map)
+    for x, y in zip(p0.stage2_levels, p1.stage2_levels):
+        assert np.array_equal(x, y)
