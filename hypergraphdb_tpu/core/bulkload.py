@@ -143,19 +143,18 @@ def bulk_import(
                                               else None))
         except BaseException:
             backend.commit_batch_abort()
-            # the tick `vnext` will never commit — drop its pre-images so
-            # the per-cell chains keep one entry per real commit version.
-            # Rebind a FRESH list (never mutate in place): lock-free
-            # readers may hold a live iterator over the old one
-            # (_gc_history keeps the same discipline).
-            for cell in captured:
-                entries = txman._history.get(cell)
-                if entries is not None:
-                    keep = [e for e in entries if e[0] != vnext]
-                    if keep:
-                        txman._history[cell] = keep
-                    else:
-                        del txman._history[cell]
+            # Direct backend writes already applied are NOT rolled back on
+            # memory backends (commit_batch_abort is a durability marker),
+            # so the error path must still honor both isolation promises:
+            # KEEP the captured pre-images (snapshot readers reconstruct
+            # their begin-time view through them) and consume the `vnext`
+            # tick + bump the captured cells (open readers of the
+            # half-applied state fail commit validation instead of
+            # committing on top of it).
+            if captured:
+                txman._clock = vnext
+                for cell in captured:
+                    txman._versions[cell] = vnext
             raise
         else:
             backend.commit_batch_end()

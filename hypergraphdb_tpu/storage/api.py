@@ -110,10 +110,14 @@ class HGIndex:
         for k in self.scan_keys():
             yield from self.find(k)
 
-    def bulk_items(self):
-        """Iterate (key, sorted int64 ndarray) pairs — the CSR-pack fast
-        path. Backends override with direct container access."""
+    def bulk_items(self, lo: Optional[bytes] = None):
+        """Iterate (key, sorted int64 ndarray) pairs in key order — the
+        CSR-pack fast path and the op-log cursor. ``lo`` starts the scan at
+        the first key ≥ lo. Backends override with direct container
+        access."""
         for k in self.scan_keys():
+            if lo is not None and k < lo:
+                continue
             yield k, self.find(k).array()
 
     # range queries (HGSortIndex semantics)

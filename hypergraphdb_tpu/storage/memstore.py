@@ -126,10 +126,11 @@ class MemIndex(HGBidirectionalIndex):
     def find_by_value(self, value: HGHandle) -> list[bytes]:
         return sorted(self._vk.get(value, ()))
 
-    def bulk_items(self):
+    def bulk_items(self, lo=None):
         # direct container access: no result-set wrappers on the pack path
-        for k, s in self._kv.items():
-            yield k, s.snapshot()
+        keys = self._kv.irange(minimum=lo) if lo is not None else self._kv
+        for k in keys:
+            yield k, self._kv[k].snapshot()
 
 
 class MemStorage(StorageBackend):

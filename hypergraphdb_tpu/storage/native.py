@@ -102,6 +102,18 @@ class NativeIndex(HGBidirectionalIndex):
         )
         return iter(_take_key_list(L, out, total.value, count.value))
 
+    def bulk_items(self, lo: Optional[bytes] = None):
+        # one native call for the (sorted) key list, bisect to the cursor
+        # start, then per-key value fetches — O(result + one key scan in
+        # C), not a Python skip-loop over every key (op-log cursor path)
+        keys = list(self.scan_keys())
+        if lo is not None:
+            import bisect
+
+            keys = keys[bisect.bisect_left(keys, lo):]
+        for k in keys:
+            yield k, self.find(k).array()
+
     def find_range(
         self,
         lo: Optional[bytes] = None,

@@ -89,9 +89,9 @@ class PartitionedIndex(HGBidirectionalIndex):
         for c in self._children:
             yield from c.scan_values()
 
-    def bulk_items(self):
+    def bulk_items(self, lo=None):
         yield from heapq.merge(
-            *(c.bulk_items() for c in self._children), key=lambda kv: kv[0]
+            *(c.bulk_items(lo) for c in self._children), key=lambda kv: kv[0]
         )
 
     def find_range(

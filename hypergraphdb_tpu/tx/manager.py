@@ -357,9 +357,10 @@ class HGTransactionManager:
         # at `sv`. The membership check runs AFTER the backend read:
         # capture-before-apply means a commit that raced the read has
         # already published its pre-image, so an empty chain here proves
-        # the read didn't straddle an apply. (Backends return fresh
-        # arrays — memstore snapshots, native copies out — so callers may
-        # freeze/cache the result.)
+        # the read didn't straddle an apply. NB the array may be SHARED
+        # with the backend (memstore memoizes its snapshot and rebuilds on
+        # mutation; native copies out) — immutable once returned, so
+        # callers may cache/freeze it but must never write through it.
         if ("inc", atom) not in self._history:
             return np.asarray(arr, dtype=np.int64)
         vals = self._set_at(("inc", atom), sv, set(arr.tolist()))

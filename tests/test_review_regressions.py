@@ -148,12 +148,12 @@ def test_removed_unreplicated_atom_mints_no_gid():
     try:
         rep = p1.replication
         assert transfer.existing_gid(g, int(a)) is None
-        n_log = len(rep.log.entries)
+        n_log = rep.log.head
         g.remove(a)
         assert rep.flush()  # drain the async push worker before asserting
         assert transfer.existing_gid(g, int(a)) is None  # no mint
         removes = [
-            e for e in rep.log.entries[n_log:] if e[1] == "remove"
+            e for e in rep.log.since(n_log) if e[1] == "remove"
         ]
         assert removes == []
     finally:

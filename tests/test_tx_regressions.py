@@ -129,3 +129,44 @@ def test_bulk_import_preserves_open_snapshots(graph: HyperGraph):
     graph.txman.abort(tx)
     # outside the snapshot the bulk atoms are visible
     assert len(graph.get_incidence_set(target)) == 9
+
+
+def test_bulk_import_abort_keeps_snapshot_isolation(graph: HyperGraph):
+    """A bulk_import that fails mid-batch must still serve open snapshots
+    their begin-time view of the half-applied cells AND doom transactions
+    that read them (the error path keeps pre-images and bumps versions)."""
+    import threading
+
+    target = graph.add("t")
+    l0 = graph.add_link((target,), value="pre")
+    tx = graph.txman.begin()
+    pre = graph.get_incidence_set(target).array().tolist()
+
+    err = {}
+
+    def load():
+        try:
+            # an unparseable target mid-batch raises after some direct
+            # backend writes already landed
+            graph.bulk_import(
+                values=["a", "b", "c", "d"],
+                target_lists=[[int(target)], [int(target)],
+                              ["not-a-handle"], [int(target)]],
+            )
+        except Exception as e:  # noqa: BLE001
+            err["e"] = e
+
+    t = threading.Thread(target=load)
+    t.start()
+    t.join()
+    assert "e" in err  # the batch did fail
+    # snapshot still sees the begin-time incidence
+    assert graph.get_incidence_set(target).array().tolist() == pre == [int(l0)]
+    # and committing on top of that read must conflict, not succeed
+    graph.add("unrelated-write")
+    import pytest
+
+    from hypergraphdb_tpu import TransactionConflict
+
+    with pytest.raises(TransactionConflict):
+        graph.txman.commit(tx)
