@@ -386,6 +386,25 @@ class SnapshotManager:
                 self._uploaded_atoms = len(self._new_atoms)
             return base.device, self._device_delta
 
+    def host_delta(self) -> dict:
+        """Host-side copy of the delta memtable for OTHER planes to shard
+        (``parallel.sharded.shard_host_delta``): COO edge buffers, the dead
+        set, and the epoch (compaction counter) the buffers belong to.
+        Captured under one lock so the arrays are mutually consistent; a
+        multi-chip caller re-shards the base when ``epoch`` moves (the
+        sharded twin of ``device()``'s epoch marker)."""
+        with self._lock:
+            return {
+                "epoch": self.compactions,
+                "capacity": self._capacity,
+                "inc_links": np.asarray(self._inc_links, dtype=np.int32),
+                "inc_src": np.asarray(self._inc_src, dtype=np.int32),
+                "tgt_flat": np.asarray(self._tgt_flat, dtype=np.int32),
+                "tgt_src": np.asarray(self._tgt_src, dtype=np.int32),
+                "dead": np.fromiter(self._dead, dtype=np.int64)
+                if self._dead else np.empty(0, dtype=np.int64),
+            }
+
     def device_visible_new_atoms(self) -> list[int]:
         """New atoms whose delta edges are ALREADY uploaded to the device
         (edge buffers append in commit order, so the first

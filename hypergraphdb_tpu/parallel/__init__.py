@@ -7,20 +7,28 @@ over ICI, candidate-sharded pattern matching. The host-side control plane
 
 from hypergraphdb_tpu.parallel.sharded import (
     AXIS,
+    ShardedDelta,
     ShardedSnapshot,
     and_incident_pattern_sharded,
     bfs_levels_sharded,
+    bfs_levels_sharded_delta,
     bfs_packed_sharded,
+    bfs_packed_sharded_delta,
     make_mesh,
     match_candidates_sharded,
+    shard_host_delta,
 )
 
 __all__ = [
     "AXIS",
+    "ShardedDelta",
     "ShardedSnapshot",
     "and_incident_pattern_sharded",
     "bfs_levels_sharded",
+    "bfs_levels_sharded_delta",
     "bfs_packed_sharded",
+    "bfs_packed_sharded_delta",
     "make_mesh",
     "match_candidates_sharded",
+    "shard_host_delta",
 ]

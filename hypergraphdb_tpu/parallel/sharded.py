@@ -284,6 +284,217 @@ def bfs_packed_sharded(
     return visited, counts, (levels if with_levels else None)
 
 
+# --------------------------------------------------------------------------
+# sharded (base, delta) overlay: the multi-chip face of ops.incremental
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class ShardedDelta:
+    """Row/edge-sharded twin of :class:`ops.incremental.DeviceDelta`.
+
+    Delta COO edges are partitioned by the owner of their *destination*
+    row — the SAME row partition as the :class:`ShardedSnapshot` they
+    overlay — with destinations rewritten to local ids, so a hop's delta
+    scatter is purely local and OR-merges with the base scatter before the
+    packed bitmaps cross ICI. Tombstones ship as per-device packed words.
+
+    The reference serves concurrent reads during checkpoints from MVCC
+    B-tree snapshots (``storage/bdb-je/.../BJEConfig.java:27-35``); here
+    the immutable sharded base + this small sharded overlay is that read
+    snapshot, kept fresh between compactions.
+    """
+
+    epoch: int            # SnapshotManager.compactions the buffers belong to
+    edge_chunk: int       # static scan slice for the delta scatter loop
+    inc_src: jax.Array    # (n_dev*D_inc_loc,) sharded — global source atom
+    inc_dst: jax.Array    # (n_dev*D_inc_loc,) sharded — LOCAL dest link
+    tgt_src: jax.Array    # (n_dev*D_tgt_loc,) sharded — global source link
+    tgt_dst: jax.Array    # (n_dev*D_tgt_loc,) sharded — LOCAL dest atom
+    dead: jax.Array       # (n_dev*w_loc,) sharded uint32 — packed tombstones
+
+
+def _register_delta_pytree() -> None:
+    jax.tree_util.register_pytree_node(
+        ShardedDelta,
+        lambda d: ((d.inc_src, d.inc_dst, d.tgt_src, d.tgt_dst, d.dead),
+                   (d.epoch, d.edge_chunk)),
+        lambda aux, ch: ShardedDelta(aux[0], aux[1], *ch),
+    )
+
+
+_register_delta_pytree()
+
+
+def shard_host_delta(
+    sdev: ShardedSnapshot, hd: dict, edge_chunk: int = 4096
+) -> ShardedDelta:
+    """Shard a ``SnapshotManager.host_delta()`` capture over ``sdev``'s mesh.
+
+    ``hd['capacity']`` must equal ``sdev.num_atoms`` (same epoch: the delta's
+    id space is the base's padded capacity); a mismatch means the manager
+    compacted after ``sdev`` was built and the caller must re-shard the base.
+    """
+    if hd["capacity"] != sdev.num_atoms:
+        raise ValueError(
+            f"delta capacity {hd['capacity']} != sharded base "
+            f"{sdev.num_atoms}: epochs diverged, re-shard the base"
+        )
+    n_dev, n_loc, N = sdev.n_dev, sdev.n_loc, sdev.num_atoms
+    shard = NamedSharding(sdev.mesh, P(AXIS))
+
+    def part(src, dst):
+        if len(src) == 0:
+            src = np.empty(0, dtype=np.int32)
+            dst = np.empty(0, dtype=np.int32)
+        s, d = _partition_by_owner(
+            np.asarray(src, dtype=np.int32), np.asarray(dst, dtype=np.int32),
+            n_dev, n_loc, N, edge_chunk,
+        )
+        return (
+            jax.device_put(jnp.asarray(s), shard),
+            jax.device_put(jnp.asarray(d), shard),
+        )
+
+    # direction mirrors DeviceDelta's scatters: atom→link lands on the
+    # link's owner; link→target lands on the target atom's owner
+    inc_src, inc_dst = part(hd["inc_src"], hd["inc_links"])
+    tgt_src, tgt_dst = part(hd["tgt_src"], hd["tgt_flat"])
+
+    dead_bits = np.zeros(n_dev * n_loc, dtype=bool)
+    dd = hd["dead"]
+    if len(dd):
+        dead_bits[dd[dd < n_dev * n_loc]] = True
+    dead_words = np.packbits(
+        dead_bits.reshape(-1, WORD), axis=-1, bitorder="little"
+    ).view("<u4").reshape(-1)
+    return ShardedDelta(
+        epoch=int(hd["epoch"]),
+        edge_chunk=edge_chunk,
+        inc_src=inc_src,
+        inc_dst=inc_dst,
+        tgt_src=tgt_src,
+        tgt_dst=tgt_dst,
+        dead=jax.device_put(jnp.asarray(dead_words), shard),
+    )
+
+
+@partial(jax.jit, static_argnames=("max_hops", "with_levels"))
+def bfs_packed_sharded_delta(
+    sdev: ShardedSnapshot,
+    sdelta: ShardedDelta,
+    seeds: jax.Array,   # (K,) int32
+    max_hops: int,
+    with_levels: bool = False,
+):
+    """Batched K-seed BFS over base ∪ delta minus tombstones, on the mesh.
+
+    Same contract and ICI profile as :func:`bfs_packed_sharded` — two
+    all-gathers of packed words per hop — plus two LOCAL delta scatters
+    OR-merged in before each exchange; tombstoned rows are cleared with a
+    per-device packed mask. Sharded twin of
+    :func:`ops.incremental.bfs_levels_delta`.
+    """
+    if max_hops > 127:
+        raise ValueError(
+            "bfs_packed_sharded_delta: max_hops > 127 would overflow int8"
+        )
+    mesh = sdev.mesh
+    N = sdev.num_atoms
+    n_loc = sdev.n_loc
+    w_loc = n_loc // WORD
+    chunk = sdev.edge_chunk
+    d_chunk = sdelta.edge_chunk
+    K = seeds.shape[0]
+
+    def stepper(inc_src, inc_dst, tgt_src, tgt_dst,
+                d_inc_src, d_inc_dst, d_tgt_src, d_tgt_dst,
+                dead_w, seeds):
+        d = jax.lax.axis_index(AXIS)
+        row_start = d * n_loc
+        local_ids = row_start + jnp.arange(n_loc, dtype=jnp.int32)
+        live_loc = pack_bits((local_ids < N)[None, :])[0] & ~dead_w
+
+        mine = (seeds >= row_start) & (seeds < row_start + n_loc)
+        sl = jnp.where(mine, seeds - row_start, 0)
+        bitv = jnp.where(
+            mine,
+            jnp.left_shift(jnp.uint32(1), (sl & 31).astype(jnp.uint32)),
+            jnp.uint32(0),
+        )
+        frontier = (
+            jnp.zeros((K, w_loc), dtype=jnp.uint32)
+            .at[jnp.arange(K), sl >> 5].max(bitv)
+        ) & live_loc  # dead seeds emit nothing (bfs_levels_delta semantics)
+        visited = frontier
+        if with_levels:
+            levels = jnp.where(unpack_bits(frontier), 0, -1).astype(jnp.int8)
+        else:
+            levels = jnp.zeros((), dtype=jnp.int8)
+
+        def body(i, state):
+            frontier, visited, counts, levels = state
+            f_full = jax.lax.all_gather(frontier, AXIS, axis=1, tiled=True)
+            link_loc, c = _scatter_local(
+                inc_src, inc_dst, f_full, n_loc, chunk, count=True
+            )
+            dlink_loc, dc = _scatter_local(
+                d_inc_src, d_inc_dst, f_full, n_loc, d_chunk, count=True
+            )
+            link_loc = (link_loc | dlink_loc) & live_loc
+            l_full = jax.lax.all_gather(link_loc, AXIS, axis=1, tiled=True)
+            nbr_loc, _ = _scatter_local(
+                tgt_src, tgt_dst, l_full, n_loc, chunk, count=False
+            )
+            dnbr_loc, _ = _scatter_local(
+                d_tgt_src, d_tgt_dst, l_full, n_loc, d_chunk, count=False
+            )
+            nxt = (nbr_loc | dnbr_loc) & live_loc & ~visited
+            if with_levels:
+                levels = jnp.where(
+                    unpack_bits(nxt), (i + 1).astype(jnp.int8), levels
+                )
+            counts = counts + jax.lax.psum(c + dc, AXIS)
+            return nxt, visited | nxt, counts, levels
+
+        frontier, visited, counts, levels = jax.lax.fori_loop(
+            0, max_hops, body,
+            (frontier, visited, jnp.zeros((K,), dtype=jnp.int32), levels),
+        )
+        return visited, counts, levels
+
+    out_levels_spec = P(None, AXIS) if with_levels else P()
+    fn = jax.shard_map(
+        stepper,
+        mesh=mesh,
+        in_specs=(P(AXIS),) * 9 + (P(),),
+        out_specs=(P(None, AXIS), P(), out_levels_spec),
+    )
+    visited, counts, levels = fn(
+        sdev.inc_src, sdev.inc_dst, sdev.tgt_src, sdev.tgt_dst,
+        sdelta.inc_src, sdelta.inc_dst, sdelta.tgt_src, sdelta.tgt_dst,
+        sdelta.dead,
+        jnp.asarray(seeds, dtype=jnp.int32),
+    )
+    return visited, counts, (levels if with_levels else None)
+
+
+def bfs_levels_sharded_delta(
+    sdev: ShardedSnapshot, sdelta: ShardedDelta, seeds, max_hops: int
+) -> tuple[jax.Array, jax.Array]:
+    """Dense (levels, visited) compat contract of
+    :func:`ops.incremental.bfs_levels_delta` on the mesh — for graphs small
+    enough to materialize (K, N+1); large callers use
+    :func:`bfs_packed_sharded_delta` directly."""
+    visited_p, _, levels = bfs_packed_sharded_delta(
+        sdev, sdelta, jnp.asarray(seeds, dtype=jnp.int32), max_hops,
+        with_levels=True,
+    )
+    n1 = sdev.num_atoms + 1
+    visited = unpack_bits(visited_p)[:, :n1]
+    return levels.astype(jnp.int32)[:, :n1], visited
+
+
 def device_memory_stats() -> dict:
     """MEASURED per-device allocator stats via ``memory_stats()``:
     ``bytes_in_use`` now and the PROCESS-LIFETIME ``peak_bytes_in_use``

@@ -117,3 +117,123 @@ def test_blocked_sharded_bfs_validates_k_block(graph, mesh):
         bfs_packed_sharded_blocked(
             sdev, np.asarray([int(nodes[0])]), 2, k_block=48
         )
+
+
+# --------------------------------------------------------------------------
+# sharded (base, delta) overlay — VERDICT r4 item 3
+# --------------------------------------------------------------------------
+
+
+def _make_mgr_with_delta(graph, seed=11):
+    """Base-packed manager + post-base mutations living only in the delta."""
+    from hypergraphdb_tpu.ops.incremental import SnapshotManager
+
+    nodes, links = make_random_hypergraph(
+        graph, n_nodes=120, n_links=200, seed=seed
+    )
+    mgr = SnapshotManager(graph, headroom=2.0, compact_ratio=50.0)
+    base_epoch = mgr.compactions
+    # post-base: new links between existing nodes, one new node + link,
+    # one removal — all must be visible through the sharded overlay
+    r = np.random.default_rng(seed + 1)
+    for _ in range(40):
+        a, b = (int(x) for x in r.choice(nodes, size=2, replace=False))
+        graph.add_link([a, b], value="post-base")
+    nn = graph.add("post-base-node")
+    graph.add_link([int(nn), int(nodes[0])], value="post-base-bridge")
+    graph.remove(int(links[3]))
+    assert mgr.compactions == base_epoch, "delta must not have compacted"
+    assert mgr.delta_edges > 0
+    return mgr, nodes, nn
+
+
+def test_sharded_delta_bfs_matches_host_oracle(graph, mesh):
+    """Sharded (base, delta) BFS must agree bit-for-bit with the
+    single-device bfs_levels_delta oracle, including post-base links."""
+    from hypergraphdb_tpu.ops.incremental import bfs_levels_delta
+    from hypergraphdb_tpu.parallel import (
+        bfs_levels_sharded_delta,
+        shard_host_delta,
+    )
+
+    mgr, nodes, nn = _make_mgr_with_delta(graph)
+    dev, delta = mgr.device()
+    sdev = ShardedSnapshot.from_host(mgr.base, mesh)
+    sdelta = shard_host_delta(sdev, mgr.host_delta())
+
+    seeds = jnp.asarray(
+        [int(nodes[0]), int(nodes[7]), int(nn)], dtype=jnp.int32
+    )
+    lv_ref, vis_ref = bfs_levels_delta(dev, delta, seeds, max_hops=3)
+    lv_sh, vis_sh = bfs_levels_sharded_delta(sdev, sdelta, seeds, max_hops=3)
+
+    np.testing.assert_array_equal(np.asarray(vis_ref), np.asarray(vis_sh))
+    np.testing.assert_array_equal(np.asarray(lv_ref), np.asarray(lv_sh))
+    # the post-base node is reachable from nodes[0] through the bridge link
+    assert bool(np.asarray(vis_sh)[0, int(nn)])
+    mgr.close()
+
+
+def test_sharded_delta_sees_post_base_links(graph, mesh):
+    """A link added after the base pack must connect components through the
+    sharded overlay (the read-freshness contract of BASELINE config 5)."""
+    from hypergraphdb_tpu.ops.incremental import SnapshotManager
+    from hypergraphdb_tpu.parallel import (
+        bfs_packed_sharded_delta,
+        shard_host_delta,
+    )
+    from hypergraphdb_tpu.ops.bitfrontier import unpack_visited
+
+    a = graph.add("a")
+    b = graph.add("b")
+    mgr = SnapshotManager(graph, headroom=4.0, compact_ratio=50.0)
+    sdev = ShardedSnapshot.from_host(mgr.base, mesh)
+
+    # before: a and b are disconnected
+    sd0 = shard_host_delta(sdev, mgr.host_delta())
+    vis0, _, _ = bfs_packed_sharded_delta(
+        sdev, sd0, jnp.asarray([int(a)], dtype=jnp.int32), 2
+    )
+    assert not unpack_visited(np.asarray(vis0), sdev.num_atoms)[0][int(b)]
+
+    graph.add_link([int(a), int(b)], value="bridge")
+    sd1 = shard_host_delta(sdev, mgr.host_delta())
+    vis1, counts, _ = bfs_packed_sharded_delta(
+        sdev, sd1, jnp.asarray([int(a)], dtype=jnp.int32), 2
+    )
+    assert unpack_visited(np.asarray(vis1), sdev.num_atoms)[0][int(b)]
+    assert int(np.asarray(counts)[0]) >= 1
+    mgr.close()
+
+
+def test_sharded_delta_tombstones_and_epoch_guard(graph, mesh):
+    """Removed atoms must be invisible through the overlay; a stale delta
+    (capacity from another epoch) must be rejected loudly."""
+    from hypergraphdb_tpu.ops.incremental import SnapshotManager
+    from hypergraphdb_tpu.parallel import (
+        bfs_packed_sharded_delta,
+        shard_host_delta,
+    )
+    from hypergraphdb_tpu.ops.bitfrontier import unpack_visited
+
+    a = graph.add("a")
+    b = graph.add("b")
+    c = graph.add("c")
+    graph.add_link([int(a), int(b)], value=1)
+    lk = graph.add_link([int(b), int(c)], value=2)
+    mgr = SnapshotManager(graph, headroom=4.0, compact_ratio=50.0)
+    sdev = ShardedSnapshot.from_host(mgr.base, mesh)
+
+    graph.remove(int(lk))  # tombstone the b—c link post-base
+    sd = shard_host_delta(sdev, mgr.host_delta())
+    vis, _, _ = bfs_packed_sharded_delta(
+        sdev, sd, jnp.asarray([int(a)], dtype=jnp.int32), 4
+    )
+    row = unpack_visited(np.asarray(vis), sdev.num_atoms)[0]
+    assert row[int(b)] and not row[int(c)]
+
+    hd = mgr.host_delta()
+    hd["capacity"] = hd["capacity"] + 128  # simulate post-compaction epoch
+    with pytest.raises(ValueError, match="epoch"):
+        shard_host_delta(sdev, hd)
+    mgr.close()
