@@ -148,24 +148,30 @@ class OpLog:
             return out[:limit] if limit is not None else out
         import json
 
-        # the backing index is the cursor source: no tx needed, committed
-        # reads only (catch-up serving tolerates a marginally stale tail)
         idx = g.backend.get_index(self.IDX, create=False)
         if idx is None:
             return []
         lo = (max(seq, 0) + 1).to_bytes(8, "big")
+        # key scan under the commit lock: memstore's bulk_items iterates the
+        # LIVE sorted dict, so a concurrent persist_many would raise
+        # RuntimeError mid-iteration (review r5 finding 3). The hold is
+        # bounded by `limit`; payload loads happen outside the lock.
+        pairs: list[tuple[int, int]] = []
+        with g.txman._commit_lock:
+            for key, hs in idx.bulk_items(lo=lo):
+                s = int.from_bytes(key, "big")
+                for dh in hs.tolist():
+                    pairs.append((s, int(dh)))
+                if limit is not None and len(pairs) >= limit:
+                    break
         res: list[tuple[int, str, Any]] = []
-        for key, hs in idx.bulk_items(lo=lo):
-            s = int.from_bytes(key, "big")
-            for dh in hs.tolist():
-                raw = g.store.get_data(int(dh))
-                if raw is None:
-                    continue
-                kind, payload = json.loads(raw.decode("utf-8"))
-                res.append((s, kind, payload))
-            if limit is not None and len(res) >= limit:
-                return res[:limit]
-        return res
+        for s, dh in pairs:
+            raw = g.store.get_data(dh)
+            if raw is None:
+                continue
+            kind, payload = json.loads(raw.decode("utf-8"))
+            res.append((s, kind, payload))
+        return res[:limit] if limit is not None else res
 
     def truncate_below(self, seq: int) -> int:
         """Drop entries with sequence ≤ ``seq`` (their data records too)
@@ -187,11 +193,12 @@ class OpLog:
         if idx is None:
             return 0
         victims: list[tuple[bytes, int]] = []
-        for key, hs in idx.bulk_items():
-            if int.from_bytes(key, "big") > seq:
-                break
-            for dh in hs.tolist():
-                victims.append((key, int(dh)))
+        with g.txman._commit_lock:  # live-iterator guard, same as since()
+            for key, hs in idx.bulk_items():
+                if int.from_bytes(key, "big") > seq:
+                    break
+                for dh in hs.tolist():
+                    victims.append((key, int(dh)))
 
         def drop() -> None:
             sidx = g.store.get_index(self.IDX)
@@ -318,6 +325,11 @@ class Replication:
         #: acknowledged at least `truncate_batch` entries past the floor
         self.auto_truncate = True
         self.truncate_batch = 256
+        #: catch-up responses are served in pages of this many entries (one
+        #: rejoining peer must not make the dispatch thread materialize and
+        #: wire-expand the whole surviving log); the client requests the
+        #: next page after applying the previous one
+        self.catchup_page = 1024
         #: debounce: wait for a quiet gap before draining so serialization
         #: does not steal cycles from a hot ingest loop (with the GIL, a
         #: busy worker halves writer throughput); backpressure cap bounds
@@ -636,11 +648,24 @@ class Replication:
         elif what == "catchup":
             since = int(content.get("since", 0))
             floor = self.log.floor
-            entries = [] if since < floor else [
-                {"seq": seq, "kind": kind,
-                 "entry": self._expand_for_wire(kind, entry)}
-                for seq, kind, entry in self.log.since(since)
-            ]
+            entries = []
+            if since >= floor:
+                # page-sized serve (review r5 finding 4): one request must
+                # not materialize + wire-expand the whole remaining log on
+                # the dispatch thread; the client re-requests after applying
+                raw = self.log.since(since, limit=self.catchup_page)
+                # re-read the floor AFTER the scan: a truncation that raced
+                # the cursor may have dropped entries in (since, floor] —
+                # serving the surviving tail would silently skip them
+                # (review r5 finding 2); report the gap instead so the
+                # client falls back to a full bootstrap
+                floor = self.log.floor
+                if since >= floor:
+                    entries = [
+                        {"seq": seq, "kind": kind,
+                         "entry": self._expand_for_wire(kind, entry)}
+                        for seq, kind, entry in raw
+                    ]
             self.peer.interface.send(sender, M.make_message(
                 M.INFORM, self.ACTIVITY_TYPE,
                 {"what": "catchup-result", "entries": entries,
@@ -648,18 +673,21 @@ class Replication:
             ))
         elif what == "catchup-result":
             floor = int(content.get("floor", 0))
-            if floor > self.last_seen.get(sender, 0) and not content.get(
-                "entries"
-            ):
+            entries = content.get("entries") or []
+            if floor > self.last_seen.get(sender, 0) and not entries:
                 # the server truncated past our position: incremental
                 # catch-up cannot converge — a full bootstrap (TransferGraph)
                 # is required
                 self.needs_full_sync.add(sender)
                 return True
+            # a page-limited response may stop short of the server's head:
+            # continue the catch-up after this page has been applied
+            head = int(content.get("head", 0))
+            top = max((int(e["seq"]) for e in entries), default=0)
             self._enqueue_apply(
                 sender,
-                [(e["kind"], e["entry"], int(e["seq"]))
-                 for e in content.get("entries", ())],
+                [(e["kind"], e["entry"], int(e["seq"])) for e in entries],
+                continue_catchup=bool(entries) and top < head,
             )
         elif what == "ack":
             # receiver's applied position in MY log: feeds truncation
@@ -676,11 +704,12 @@ class Replication:
             return False
         return True
 
-    def _enqueue_apply(self, sender: str, items: list) -> None:
+    def _enqueue_apply(self, sender: str, items: list,
+                       continue_catchup: bool = False) -> None:
         if not items:
             return
         with self._apply_cv:
-            self._apply_q.append((sender, items))
+            self._apply_q.append((sender, items, continue_catchup))
             self._apply_cv.notify_all()
 
     def _apply_drain(self) -> None:
@@ -699,7 +728,10 @@ class Replication:
                 # one ack per sender per drained cycle, not per push
                 his: dict[str, int] = {}
                 failed: set[str] = set()
-                for sender, items in batch:
+                conts: set[str] = set()
+                for sender, items, cont in batch:
+                    if cont:
+                        conts.add(sender)
                     for kind, entry, seq in items:
                         if sender in failed:
                             # a failed apply must not be acked past — stop
@@ -722,8 +754,22 @@ class Replication:
                         if seq:
                             his[sender] = max(his.get(sender, 0), seq)
                 for sender, hi in his.items():
-                    if hi > self.last_seen.get(sender, 0):
-                        self.last_seen.set(sender, hi)
+                    try:
+                        if hi > self.last_seen.get(sender, 0):
+                            self.last_seen.set(sender, hi)
+                    except Exception:
+                        # e.g. TransactionConflict after retries under a hot
+                        # ingest loop — the worker must NEVER die (review r5
+                        # finding 1). Not durably recorded → do not ack past
+                        # it either; the sender re-serves from our last ack
+                        # and _apply is idempotent.
+                        import logging
+
+                        logging.getLogger("hypergraphdb_tpu.peer").warning(
+                            "seen-map update failed for %s", sender,
+                            exc_info=True,
+                        )
+                        continue
                     try:
                         self.peer.interface.send(sender, M.make_message(
                             M.INFORM, self.ACTIVITY_TYPE,
@@ -731,6 +777,21 @@ class Replication:
                         ))
                     except Exception:  # noqa: BLE001 - peer may be gone
                         pass
+                # page-limited catch-up: pull the next page now that this
+                # one is applied and acknowledged
+                for sender in conts - failed:
+                    try:
+                        self.catch_up(sender)
+                    except Exception:  # noqa: BLE001 - peer may be gone
+                        pass
+            except Exception:
+                # belt-and-braces: anything unexpected is logged, the
+                # worker loop survives
+                import logging
+
+                logging.getLogger("hypergraphdb_tpu.peer").warning(
+                    "replication apply cycle failed", exc_info=True
+                )
             finally:
                 with self._apply_cv:
                     self._apply_busy -= 1
