@@ -21,6 +21,9 @@ class QueryConfig:
     prefer_device: bool = True         # plan onto TPU snapshot when possible
     device_min_batch: int = 64         # below this, host cursors win (planner duality)
     contract_conjunctions: bool = True
+    #: cost cap for range-scan cardinality estimates: counts are exact up
+    #: to this many entries, then clamped (HGIndexStats.java:37 analogue)
+    range_estimate_cap: int = 4096
 
 
 @dataclass

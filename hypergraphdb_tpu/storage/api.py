@@ -120,6 +120,33 @@ class HGIndex:
                 continue
             yield k, self.find(k).array()
 
+    def count_range(
+        self,
+        lo: Optional[bytes] = None,
+        hi: Optional[bytes] = None,
+        lo_inclusive: bool = True,
+        hi_inclusive: bool = False,
+        cap: Optional[int] = None,
+    ) -> int:
+        """Entries (not keys) in the key range — EXACT up to ``cap``, then
+        clamped to ``cap``. This is the planner's cardinality source for
+        range scans (the reference's cost-capped index statistics,
+        ``storage/HGIndexStats.java:37`` feeding ``ResultSizeEstimation``):
+        a bounded cursor walk gives exact small counts (where ordering
+        decisions matter) and a cheap "at least cap" for large ranges
+        (which all land on the same side of every planner threshold).
+        Backends with direct container access override."""
+        n = 0
+        for k, hs in self.bulk_items(lo=lo):
+            if lo is not None and not lo_inclusive and k == lo:
+                continue
+            if hi is not None and (k > hi or (k == hi and not hi_inclusive)):
+                break
+            n += len(hs)
+            if cap is not None and n >= cap:
+                return cap
+        return n
+
     # range queries (HGSortIndex semantics)
     def find_range(
         self,

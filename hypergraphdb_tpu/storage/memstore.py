@@ -123,6 +123,21 @@ class MemIndex(HGBidirectionalIndex):
         merged = np.unique(np.concatenate(parts))
         return HGSortedResultSet(merged)
 
+    def count_range(
+        self,
+        lo: Optional[bytes] = None,
+        hi: Optional[bytes] = None,
+        lo_inclusive: bool = True,
+        hi_inclusive: bool = False,
+        cap: Optional[int] = None,
+    ) -> int:
+        n = 0
+        for k in self._kv.irange(lo, hi, (lo_inclusive, hi_inclusive)):
+            n += len(self._kv[k])
+            if cap is not None and n >= cap:
+                return cap
+        return n
+
     def find_by_value(self, value: HGHandle) -> list[bytes]:
         return sorted(self._vk.get(value, ()))
 

@@ -1,0 +1,110 @@
+"""Index statistics → planner (VERDICT r4 missing #3): range-scan and
+user-index estimates come from cost-capped real counts (and persisted
+whole-index stats when capped), not hardcoded 1e6/1e12 constants — the
+reference's ``HGIndexStats.java:37`` feeding ``ResultSizeEstimation``."""
+
+import numpy as np
+import pytest
+
+from hypergraphdb_tpu import HyperGraph
+from hypergraphdb_tpu.indexing import manager as ixm
+from hypergraphdb_tpu.query import dsl as hg
+from hypergraphdb_tpu.query.compiler import (
+    AllAtomsPlan,
+    IntersectPlan,
+    TypeSetPlan,
+    ValueSetPlan,
+    compile_query,
+)
+
+
+@pytest.fixture
+def valued_graph():
+    g = HyperGraph()
+    for i in range(500):
+        g.add(i)  # ints 0..499
+    yield g
+    g.close()
+
+
+def test_range_estimate_is_real_count(valued_graph):
+    g = valued_graph
+    q = compile_query(g, hg.value(495, "gt"))
+    assert isinstance(q.plan, ValueSetPlan)
+    # 496..499 → 4 atoms; the old constant was 1e6
+    assert q.plan.estimate(g) == 4.0
+    got = q.plan.run(g)
+    assert len(got) == 4
+
+
+def test_range_plus_type_conjunction_orders_narrow_range_first(valued_graph):
+    """The plan-shape regression VERDICT asked for: a NARROW range against
+    a WIDE type set must run range-first (with the old 1e6 constant the
+    wide type set always ordered first — silently wrong)."""
+    g = valued_graph
+    cond = hg.and_(hg.type_("int"), hg.value(495, "gt"))
+    q = compile_query(g, cond)
+    assert isinstance(q.plan, IntersectPlan), q.analyze()
+    ests = {
+        type(ch).__name__: ch.estimate(g) for ch in q.plan.children
+    }
+    assert ests["ValueSetPlan"] < ests["TypeSetPlan"], ests
+    assert sorted(
+        q.plan.children, key=lambda p: p.estimate(g)
+    )[0].__class__ is ValueSetPlan
+    # and the results are still exact
+    assert sorted(g.get(h) for h in g.find_all(cond)) == [496, 497, 498, 499]
+
+
+def test_wide_range_estimate_caps_not_constant(valued_graph):
+    g = valued_graph
+    g.config.query.range_estimate_cap = 64
+    q = compile_query(g, hg.value(-1, "gt"))  # all 500 atoms
+    est = q.plan.estimate(g)
+    assert 64 <= est < 1e6  # capped fallback, never the old constant
+
+
+def test_all_atoms_estimate_tracks_id_highwater(valued_graph):
+    g = valued_graph
+    est = AllAtomsPlan().estimate(g)
+    assert 500 <= est <= 10_000  # dense-id high-water, not 1e12
+
+
+def test_user_index_range_estimate(valued_graph):
+    g = valued_graph
+    from hypergraphdb_tpu.indexing.manager import DirectValueIndexer, register
+
+    th = g.typesystem.handle_of("int")
+    register(g, DirectValueIndexer("by-int", th))
+    idx = ixm.get_index(g, "by-int")
+    assert idx.key_count() > 0
+    stats = ixm.index_stats(g, "by-int")
+    assert stats["entries"] == 500 and stats["keys"] == 500
+    # second call reuses the persisted record (no drift)
+    again = ixm.index_stats(g, "by-int")
+    assert again == stats
+
+
+def test_index_stats_persist_across_reopen(tmp_path):
+    pytest.importorskip("hypergraphdb_tpu.storage.native")
+    import hypergraphdb_tpu as hgm
+
+    loc = str(tmp_path / "db")
+    g = HyperGraph(hgm.HGConfiguration(store_backend="native", location=loc))
+    for i in range(50):
+        g.add(i)
+    from hypergraphdb_tpu.core.graph import IDX_BY_VALUE
+
+    s1 = ixm.index_stats(g, IDX_BY_VALUE)
+    assert s1["entries"] >= 50
+    g.close()
+
+    g2 = HyperGraph(hgm.HGConfiguration(store_backend="native", location=loc))
+    # restored from the persisted record: same counts, same version marker
+    s2 = ixm.index_stats(g2, IDX_BY_VALUE)
+    assert s2["entries"] == s1["entries"]
+    assert s2["version"] == s1["version"]
+    # a forced refresh recounts live
+    s3 = ixm.index_stats(g2, IDX_BY_VALUE, refresh=True)
+    assert s3["entries"] >= 50
+    g2.close()

@@ -215,3 +215,24 @@ def test_max_handle(store: StorageBackend):
     store.store_link(41, ())
     store.store_data(7, b"x")
     assert store.max_handle() == 42
+
+
+def test_index_count_range(store: StorageBackend):
+    """count_range: exact entry counts over key windows, cap clamping —
+    the planner's cardinality source (HGIndexStats.java:37 analogue)."""
+    idx = store.get_index("cr")
+    for i in range(20):
+        key = bytes([i])
+        for v in range(i % 3 + 1):  # 1..3 entries per key
+            idx.add_entry(key, 100 * i + v)
+    total = sum(i % 3 + 1 for i in range(20))
+    assert idx.count_range() == total
+    assert idx.count_range(lo=bytes([5]), hi=bytes([10])) == sum(
+        i % 3 + 1 for i in range(5, 10)
+    )
+    assert idx.count_range(
+        lo=bytes([5]), hi=bytes([10]), lo_inclusive=False, hi_inclusive=True
+    ) == sum(i % 3 + 1 for i in range(6, 11))
+    assert idx.count_range(cap=4) == 4
+    assert idx.count_range(lo=bytes([19]), hi=None) == 19 % 3 + 1
+    assert idx.count_range(lo=bytes([50])) == 0
