@@ -80,11 +80,16 @@ def expand_frontier_delta(
     return jax.vmap(one)(frontier)
 
 
-@partial(jax.jit, static_argnames=("max_hops",))
+@partial(jax.jit, static_argnames=("max_hops", "with_levels"))
 def bfs_levels_delta(
-    dev: DeviceSnapshot, delta: DeviceDelta, seeds: jax.Array, max_hops: int
-) -> tuple[jax.Array, jax.Array]:
-    """Batched BFS over base ∪ delta (same contract as ``bfs_levels``)."""
+    dev: DeviceSnapshot, delta: DeviceDelta, seeds: jax.Array, max_hops: int,
+    with_levels: bool = True,
+) -> tuple[Optional[jax.Array], jax.Array]:
+    """Batched BFS over base ∪ delta (same contract as ``bfs_levels``).
+
+    ``with_levels=False`` skips the (K, N+1) int32 hop-distance matrix —
+    at streaming-bench scale (K=256, N≈1.5M) that matrix alone is ~1.5 GB
+    of HBM a reachability-only caller pays for nothing."""
     K = seeds.shape[0]
     n1 = dev.type_of.shape[0]
     frontier = (
@@ -92,18 +97,22 @@ def bfs_levels_delta(
         & ~delta.dead[None, :]
     )
     visited = frontier
-    levels = jnp.where(frontier, 0, -1).astype(jnp.int32)
+    levels = (
+        jnp.where(frontier, 0, -1).astype(jnp.int32)
+        if with_levels else jnp.zeros((), dtype=jnp.int32)
+    )
 
     def body(i, state):
         frontier, visited, levels = state
         nxt = expand_frontier_delta(dev, delta, frontier) & ~visited
-        levels = jnp.where(nxt, i + 1, levels)
+        if with_levels:
+            levels = jnp.where(nxt, i + 1, levels)
         return nxt, visited | nxt, levels
 
     frontier, visited, levels = jax.lax.fori_loop(
         0, max_hops, body, (frontier, visited, levels)
     )
-    return levels, visited
+    return (levels if with_levels else None), visited
 
 
 class SnapshotManager:
@@ -129,7 +138,8 @@ class SnapshotManager:
 
     def __init__(self, graph, headroom: float = 2.0,
                  compact_ratio: float = 0.5, background: bool = False,
-                 delta_bucket_min: int = 128):
+                 delta_bucket_min: int = 128,
+                 pack_pad_multiple: int = 128):
         import threading
 
         self.graph = graph
@@ -139,6 +149,15 @@ class SnapshotManager:
         # floor for delta buffer padding: a large floor keeps ONE device
         # shape for a whole streaming run (no recompiles as the delta grows)
         self.delta_bucket_min = delta_bucket_min
+        # id-space capacity AND edge arrays round up to this multiple: a
+        # coarse multiple (e.g. 1<<21 for streaming benches) keeps the base
+        # device shapes IDENTICAL across successive compactions, so a base
+        # swap reuses the cached XLA executable instead of recompiling —
+        # the freshness/latency lever of BASELINE config 5
+        self.pack_pad_multiple = pack_pad_multiple
+        #: per-compaction wall timing: [{extract_s, assemble_swap_s,
+        #: total_s}]; entry 0 is the init pack
+        self.compaction_stats: list[dict] = []
         self.base: Optional[CSRSnapshot] = None
         self._capacity = 0
         self._lock = threading.RLock()
@@ -255,9 +274,12 @@ class SnapshotManager:
         the store instead of lost."""
         g = self.graph
         hw = ext["highwater"]
+        pm = self.pack_pad_multiple
         cap = max(int(hw * self.headroom), 1024)
+        cap = -(-cap // pm) * pm  # shape-stable rounding (see __init__)
         base = CSRSnapshot.pack(
-            g, version=ext["version"], capacity=cap, tables=ext["tables"]
+            g, version=ext["version"], capacity=cap, tables=ext["tables"],
+            pad_multiple=pm,
         )
         with self._lock:
             self.base = base
@@ -280,10 +302,20 @@ class SnapshotManager:
             self.compactions += 1
 
     def _compact_sync(self) -> None:
+        import time as _time
+
+        t0 = _time.perf_counter()
         with self.graph.txman._commit_lock:
             with self._lock:
                 ext = self._extract_locked()
+        t1 = _time.perf_counter()
         self._assemble_and_swap(ext)
+        t2 = _time.perf_counter()
+        self.compaction_stats.append({
+            "extract_s": t1 - t0,       # commit lock held (writers stalled)
+            "assemble_swap_s": t2 - t1,  # lock-free CSR assembly + swap
+            "total_s": t2 - t0,
+        })
 
     def _request_compact(self) -> None:
         if not self.background:

@@ -281,3 +281,27 @@ def test_concurrent_writers_and_readers_no_deadlock(graph):
         t.join(timeout=60)
     assert not any(t.is_alive() for t in ts), "deadlock: threads still alive"
     assert not errors
+
+
+def test_shape_stable_packing_and_compaction_stats(graph):
+    """pack_pad_multiple keeps base device shapes IDENTICAL across
+    compactions (cached executables survive base swaps) and every
+    compaction records wall timing."""
+    nodes = [graph.add(f"n{i}") for i in range(10)]
+    mgr = graph.enable_incremental(
+        headroom=1.5, compact_ratio=50.0, background=False,
+        pack_pad_multiple=4096,
+    )
+    assert len(mgr.compaction_stats) == 1  # the init pack
+    n0 = mgr.base.num_atoms
+    e0 = len(mgr.base.inc_links)
+    assert n0 % 4096 == 0 and e0 % 4096 == 0
+
+    for i in range(50):  # modest growth, well inside one pad bucket
+        graph.add_link((nodes[i % 10], nodes[(i + 3) % 10]), value=i)
+    mgr._compact_sync()
+    assert mgr.base.num_atoms == n0, "capacity must stay in the same bucket"
+    assert len(mgr.base.inc_links) == e0, "edge pad must stay in the bucket"
+    stats = mgr.compaction_stats[-1]
+    assert stats["total_s"] >= 0 and "extract_s" in stats
+    mgr.close()
