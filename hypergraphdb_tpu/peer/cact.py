@@ -109,11 +109,55 @@ class RemoteOpServer(Activity):
         cond = qser.from_json(op["condition"])
         return {"handles": [int(h) for h in self.peer.graph.find_all(cond)]}
 
+    def _op_replace_atom(self, op: dict) -> Any:
+        """ReplaceAtom (ref ``peer/cact/ReplaceAtom.java``): replace the
+        VALUE of the atom behind a global id, keeping identity/incidence."""
+        import base64
+
+        g = self.peer.graph
+        h = transfer.lookup_local(g, op["gid"])
+        if h is None or not g.contains(int(h)):
+            return {"replaced": False}
+        if op["type"] not in g.typesystem._by_name and op.get("type_schema"):
+            transfer.install_type(g, op["type_schema"])
+        atype = g.typesystem.get_type(op["type"])
+        value = (
+            atype.make(base64.b64decode(op["value_b64"]))
+            if op.get("value_b64") is not None else None
+        )
+        g.replace(int(h), value)
+        return {"replaced": True}
+
+    def _op_get_atom_type(self, op: dict) -> Any:
+        """GetAtomType (ref ``peer/cact/GetAtomType.java``): the type name
+        + wire schema of a remote atom, keyed by global id."""
+        g = self.peer.graph
+        h = transfer.lookup_local(g, op["gid"])
+        if h is None or not g.contains(int(h)):
+            raise KeyError(f"atom not found: {op['gid']}")
+        rec = g.store.get_link(int(h))
+        name = g.typesystem.name_of(rec[0])
+        return {"type": name, "schema": transfer.describe_type(g, name)}
+
+    def _op_sync_types(self, op: dict) -> Any:
+        """SyncTypes (ref ``peer/cact/SyncTypes.java``): install a batch of
+        remote type schemas so subsequently pushed/pulled atoms of those
+        types resolve locally instead of depending on name-keyed luck."""
+        g = self.peer.graph
+        installed = []
+        for desc in op.get("types", ()):
+            transfer.install_type(g, desc)
+            installed.append(desc["name"])
+        return {"installed": installed}
+
 
 RemoteOpServer.OPS = {
     "define_atom": RemoteOpServer._op_define_atom,
     "get_atom": RemoteOpServer._op_get_atom,
     "remove_atom": RemoteOpServer._op_remove_atom,
+    "replace_atom": RemoteOpServer._op_replace_atom,
+    "get_atom_type": RemoteOpServer._op_get_atom_type,
+    "sync_types": RemoteOpServer._op_sync_types,
     "get_incidence_set": RemoteOpServer._op_get_incidence_set,
     "query_count": RemoteOpServer._op_query_count,
     "run_query": RemoteOpServer._op_run_query,
@@ -200,3 +244,109 @@ class RemoteQueryServer(Activity):
         self.reply(sender, msg, M.INFORM, {"rows": rows, "eof": eof})
         if eof:
             self.complete(len(self.results))
+
+
+# ------------------------------------------------------- whole-graph bootstrap
+
+
+class TransferGraphClient(Activity):
+    """Whole-graph bootstrap (ref ``peer/cact/TransferGraph.java`` +
+    ``SubgraphManager.java:57``): a joining peer pulls the ENTIRE remote
+    graph in pages of serialized atoms — dependencies first, type atoms
+    mapped onto local type atoms, record-type schemas installed on the fly.
+    On completion the replication clock for the server jumps to the
+    server's op-log head AT SNAPSHOT TIME, so a follow-up catch-up replays
+    only what committed during/after the transfer — the convergence story
+    for a peer whose incremental catch-up fell past the log floor."""
+
+    TYPE = "cact-transfer"
+
+    def __init__(self, peer, target: Optional[str] = None, page: int = 256,
+                 activity_id: Optional[str] = None):
+        super().__init__(peer, activity_id)
+        self.target = target
+        self.page = page
+        self.stored = 0
+        self.log_head: Optional[int] = None
+
+    def initiate(self) -> None:
+        self.send(self.target, M.QUERY_REF, {"page": self.page})
+
+    @from_state(STARTED, M.INFORM)
+    def on_chunk(self, sender: str, msg: dict) -> None:
+        c = msg["content"]
+        if self.log_head is None:
+            self.log_head = int(c.get("log_head", 0))
+        self.stored += len(transfer.store_closure(self.peer.graph, c["atoms"]))
+        if c["eof"]:
+            rep = getattr(self.peer, "replication", None)
+            if rep is not None and self.log_head:
+                # the transferred snapshot covers everything up to the
+                # server's head at open; catch-up resumes from there
+                if self.log_head > rep.last_seen.get(sender, 0):
+                    rep.last_seen.set(sender, self.log_head)
+                rep.needs_full_sync.discard(sender)
+            self.complete(self.stored)
+        else:
+            self.reply(sender, msg, M.CONFIRM)
+
+    @from_state(STARTED, M.FAILURE)
+    def on_failure(self, sender: str, msg: dict) -> None:
+        self.fail(RuntimeError(str(msg["content"])))
+
+
+class TransferGraphServer(Activity):
+    """Server side: snapshots the atom id list ONCE (ascending handle order
+    IS dependencies-first — links are created after their targets), then
+    streams serialized pages on CONFIRM pulls."""
+
+    TYPE = "cact-transfer"
+
+    def __init__(self, peer, activity_id: Optional[str] = None):
+        super().__init__(peer, activity_id)
+        self.handles: Optional[list[int]] = None
+        self.pos = 0
+        self.page = 256
+        self.log_head = 0
+
+    @from_state(STARTED, M.QUERY_REF)
+    def on_open(self, sender: str, msg: dict) -> None:
+        try:
+            self.page = max(1, int((msg["content"] or {}).get("page", 256)))
+            rep = getattr(self.peer, "replication", None)
+            # head BEFORE the snapshot: anything later re-ships via catch-up
+            self.log_head = rep.log.head if rep is not None else 0
+            self.handles = sorted(int(h) for h in self.peer.graph.atoms())
+        except Exception as e:
+            self.reply(sender, msg, M.FAILURE, f"{type(e).__name__}: {e}")
+            self.fail(e)
+            return
+        self.state = "Streaming"
+        self._send_page(sender, msg)
+
+    @from_state("Streaming", M.CONFIRM)
+    def on_pull(self, sender: str, msg: dict) -> None:
+        self._send_page(sender, msg)
+
+    @from_state("Streaming", M.CANCEL)
+    def on_cancel(self, sender: str, msg: dict) -> None:
+        self.complete(None)
+
+    def _send_page(self, sender: str, msg: dict) -> None:
+        g = self.peer.graph
+        atoms = []
+        while self.pos < len(self.handles) and len(atoms) < self.page:
+            h = self.handles[self.pos]
+            self.pos += 1
+            if not g.contains(h):
+                continue  # removed mid-transfer; catch-up replays the remove
+            try:
+                atoms.append(transfer.serialize_atom(g, h, self.peer.identity))
+            except KeyError:
+                continue
+        eof = self.pos >= len(self.handles)
+        self.reply(sender, msg, M.INFORM, {
+            "atoms": atoms, "eof": eof, "log_head": self.log_head,
+        })
+        if eof:
+            self.complete(self.pos)

@@ -52,6 +52,9 @@ class HyperGraphPeer:
         self.activities.register_type("cact-query",
                                       lambda peer, activity_id=None:
                                       cact.RemoteQueryServer(peer, activity_id))
+        self.activities.register_type("cact-transfer",
+                                      lambda peer, activity_id=None:
+                                      cact.TransferGraphServer(peer, activity_id))
 
     def _load_identity(self) -> str:
         """Stable identity persisted in the graph (one per database)."""
@@ -179,6 +182,71 @@ class HyperGraphPeer:
         act = self.activities.initiate(
             cact.RemoteQueryClient(self, target=target, condition=condition,
                                    page=page)
+        )
+        return act.future.result(timeout=timeout)
+
+    def replace_remote(self, target: str, gid: str, value,
+                       timeout: float = 10.0) -> bool:
+        """Replace a remote atom's value by global id (ReplaceAtom)."""
+        import base64
+
+        from hypergraphdb_tpu.peer import transfer
+
+        atype = self.graph.typesystem.infer(value)
+        if atype is None:
+            raise TypeError(f"no type for value {value!r}")
+        payload = atype.store(value) if value is not None else None
+        op = {
+            "op": "replace_atom",
+            "gid": gid,
+            "type": atype.name,
+            "value_b64": (
+                base64.b64encode(payload).decode("ascii")
+                if payload is not None else None
+            ),
+        }
+        schema = transfer.describe_type(self.graph, atype.name)
+        if schema is not None and schema["schema"] != "builtin":
+            op["type_schema"] = schema
+        return self._run_op(target, op, timeout)["replaced"]
+
+    def get_remote_type(self, target: str, gid: str,
+                        timeout: float = 10.0) -> dict:
+        """Type name + schema of a remote atom (GetAtomType)."""
+        return self._run_op(target, {"op": "get_atom_type", "gid": gid},
+                            timeout)
+
+    def sync_types_to(self, target: str, names=None,
+                      timeout: float = 10.0) -> list[str]:
+        """Push local type schemas to a peer (SyncTypes): record types
+        install there class-less, so atoms of those types resolve before
+        any push/transfer arrives. ``names=None`` sends every local record
+        type."""
+        from hypergraphdb_tpu.peer import transfer
+        from hypergraphdb_tpu.types.record import RecordType
+
+        ts = self.graph.typesystem
+        if names is None:
+            names = [
+                n for n, t in ts._by_name.items()
+                if isinstance(t, RecordType)
+            ]
+        descs = [d for d in (
+            transfer.describe_type(self.graph, n) for n in names
+        ) if d is not None]
+        return self._run_op(
+            target, {"op": "sync_types", "types": descs}, timeout
+        )["installed"]
+
+    def transfer_graph_from(self, target: str, page: int = 256,
+                            timeout: float = 60.0) -> int:
+        """Pull the ENTIRE remote graph (TransferGraph bootstrap): pages of
+        dependency-ordered atoms; on completion the replication clock for
+        ``target`` advances to the server's log head at snapshot time, so a
+        follow-up ``replication.catch_up(target)`` converges the tail.
+        Returns how many atoms were stored."""
+        act = self.activities.initiate(
+            cact.TransferGraphClient(self, target=target, page=page)
         )
         return act.future.result(timeout=timeout)
 

@@ -71,9 +71,62 @@ def gid_of(graph, h: int, origin_peer: str) -> str:
     return gid
 
 
+# -- type schemas over the wire (SyncTypes, ref peer/cact/SyncTypes.java) -----
+
+
+def describe_type(graph, name: str) -> Optional[dict]:
+    """Wire schema of a registered type: record types travel with their
+    full shape (fields, declared supertypes) so a peer WITHOUT the
+    defining dataclass can still install, store, query and index atoms of
+    the type; everything else is named only (builtins exist everywhere)."""
+    from hypergraphdb_tpu.types.record import RecordType
+
+    ts = graph.typesystem
+    t = ts._by_name.get(name)
+    if t is None:
+        return None
+    if isinstance(t, RecordType):
+        return {
+            "schema": "record",
+            "name": name,
+            "fields": list(t.fields),
+            "supertype_names": list(t.supertype_names),
+            "supertypes": sorted(ts._supertypes.get(name, ())),
+        }
+    return {"schema": "builtin", "name": name}
+
+
+def install_type(graph, desc: dict) -> int:
+    """Install a remote type schema locally (the receiving half of
+    SyncTypes): record schemas register a class-less :class:`RecordType`
+    (values revive as field dicts — the reference degrades the same way
+    when the Java class is off the classpath); builtin names must already
+    exist. Idempotent; returns the local type-atom handle."""
+    from hypergraphdb_tpu.core.errors import TypeError_
+    from hypergraphdb_tpu.types.record import RecordType
+
+    ts = graph.typesystem
+    name = desc["name"]
+    if name in ts._by_name:
+        return int(ts.handle_of(name))
+    if desc.get("schema") != "record":
+        raise TypeError_(
+            f"cannot install remote type {name!r}: schema "
+            f"{desc.get('schema')!r} has no local implementation"
+        )
+    rt = RecordType(
+        name, None,
+        tuple(desc.get("fields", ())),
+        tuple(desc.get("supertype_names", ())),
+    )
+    return int(ts.register(rt, supertypes=tuple(desc.get("supertypes", ()))))
+
+
 def serialize_atom(graph, h: int, origin_peer: str) -> dict:
     """One atom → wire dict; the atom and its targets are referenced by
-    their global ids (existing mappings reused, see ``gid_of``)."""
+    their global ids (existing mappings reused, see ``gid_of``). Record
+    types ride along as schemas; type ATOMS are flagged so receivers map
+    them onto their own type atoms instead of duplicating them."""
     h = int(h)
     rec = graph.store.get_link(h)
     if rec is None:
@@ -81,15 +134,27 @@ def serialize_atom(graph, h: int, origin_peer: str) -> dict:
     type_handle, value_handle, flags = rec[0], rec[1], rec[2]
     targets = rec[3:]
     data = graph.store.get_data(value_handle) if value_handle >= 0 else None
-    return {
+    ts = graph.typesystem
+    type_name = ts.name_of(type_handle)
+    wire = {
         "gid": gid_of(graph, h, origin_peer),
-        "type": graph.typesystem.name_of(type_handle),
+        "type": type_name,
         "value_b64": (
             base64.b64encode(data).decode("ascii") if data is not None else None
         ),
         "is_link": bool(flags & 1),
         "targets": [gid_of(graph, t, origin_peer) for t in targets],
     }
+    schema = describe_type(graph, type_name)
+    if schema is not None and schema["schema"] != "builtin":
+        wire["type_schema"] = schema
+    named = ts._type_atom_name(h)
+    if named is not None:
+        wire["is_type_atom"] = True
+        atom_schema = describe_type(graph, named)
+        if atom_schema is not None:
+            wire["atom_schema"] = atom_schema
+    return wire
 
 
 def serialize_closure(graph, h: int, origin_peer: str) -> list[dict]:
@@ -124,8 +189,43 @@ def lookup_local(graph, gid: str) -> Optional[int]:
 def store_atom(graph, wire: dict) -> int:
     """Write one transferred atom (write-through, ``HGStore.attachOverlayGraph``
     analogue): create or replace the local twin of ``wire['gid']``.
-    Targets must already be mapped (send closures dependencies-first)."""
+    Targets must already be mapped (send closures dependencies-first).
+
+    Type handling (SyncTypes semantics): a transferred TYPE ATOM maps onto
+    the receiver's own type atom for that name (never duplicated — links
+    targeting it, e.g. Subsumes, land on the local type atom); an atom
+    whose record type is unknown locally installs the schema shipped in
+    ``type_schema`` first."""
+    from hypergraphdb_tpu.core.errors import TypeError_
+
     gid = wire["gid"]
+    ts = graph.typesystem
+    if wire.get("is_type_atom"):
+        name = (
+            ts.top.make(base64.b64decode(wire["value_b64"]))
+            if wire.get("value_b64") is not None else None
+        )
+        if name is None:
+            raise TypeError_(f"type atom {gid} carries no name")
+        if wire.get("atom_schema") is not None:
+            local_t = install_type(graph, wire["atom_schema"])
+        elif name in ts._by_name:
+            local_t = int(ts.handle_of(name))
+        else:
+            raise TypeError_(
+                f"transferred type atom {name!r} has no local "
+                "implementation and no wire schema"
+            )
+        prev = lookup_local(graph, gid)
+        if prev is None:
+            graph.txman.ensure_transaction(
+                lambda: _atom_map(graph).add_entry(
+                    gid.encode("utf-8"), local_t
+                )
+            )
+        return local_t
+    if wire["type"] not in ts._by_name and wire.get("type_schema") is not None:
+        install_type(graph, wire["type_schema"])
     atype = graph.typesystem.get_type(wire["type"])
     value = (
         atype.make(base64.b64decode(wire["value_b64"]))

@@ -62,7 +62,17 @@ class RecordType(HGAtomType):
 
     # -- serialization ----------------------------------------------------------
     def store(self, value: Any) -> bytes:
-        d = {f.name: getattr(value, f.name) for f in dataclasses.fields(value)}
+        if isinstance(value, dict):
+            # schema-only binding: a peer that installed this record type
+            # over the wire (SyncTypes) has no dataclass class; values
+            # round-trip as field dicts (the reference likewise degrades
+            # when the Java class is off the classpath)
+            d = {f: value.get(f) for f in self.fields} if self.fields else value
+        else:
+            d = {
+                f.name: getattr(value, f.name)
+                for f in dataclasses.fields(value)
+            }
         return msgpack.packb(d, use_bin_type=True, default=_pack_default)
 
     def make(self, data: bytes) -> Any:

@@ -478,3 +478,129 @@ def test_catchup_pages_through_large_log(two_peers):
     )
     assert p2.replication.last_seen.get("peer-1") >= 30
     assert "peer-1" not in p2.replication.needs_full_sync
+
+
+# --------------------------------------------------------------------------
+# CACT breadth: SyncTypes / ReplaceAtom / GetAtomType / TransferGraph
+# (VERDICT r4 missing #2 — ref peer/cact/SyncTypes.java, ReplaceAtom.java,
+# GetAtomType.java, TransferGraph.java)
+# --------------------------------------------------------------------------
+
+from dataclasses import dataclass as _dataclass
+
+
+@_dataclass(frozen=True)
+class _Person:
+    name: str = ""
+    age: int = 0
+
+
+def test_sync_types_installs_record_schema(two_peers):
+    p1, p2 = two_peers
+    p1.graph.add(_Person("ada", 36))  # auto-binds the record type on A
+    tname = p1.graph.typesystem.infer(_Person()).name
+    assert tname not in p2.graph.typesystem._by_name
+
+    installed = p1.sync_types_to("peer-2")
+    assert tname in installed
+    t2 = p2.graph.typesystem.get_type(tname)
+    assert tuple(t2.fields) == ("name", "age")
+
+
+def test_record_atom_pushes_with_schema(two_peers):
+    """A record atom defined only on A transfers to B: the wire schema
+    installs the type, the value revives as a field dict."""
+    p1, p2 = two_peers
+    h = p1.graph.add(_Person("grace", 47))
+    handles = p1.define_remote("peer-2", h)
+    got = p2.graph.get(handles[-1])
+    assert got == {"name": "grace", "age": 47} or getattr(
+        got, "name", None
+    ) == "grace"
+    # and B can query it by type
+    tname = p1.graph.typesystem.infer(_Person()).name
+    th2 = p2.graph.typesystem.handle_of(tname)
+    assert handles[-1] in {int(x) for x in q.find_all(
+        p2.graph, q.type_(int(th2))
+    )}
+
+
+def test_replace_remote_and_get_type(two_peers):
+    p1, p2 = two_peers
+    a = p2.graph.add("before")
+    gid = transfer.global_id("peer-2", int(a))
+    transfer._atom_map(p2.graph).add_entry(gid.encode(), int(a))
+
+    info = p1.get_remote_type("peer-2", gid)
+    assert info["type"] == "string"
+    assert p1.replace_remote("peer-2", gid, "after")
+    assert p2.graph.get(int(a)) == "after"
+    # missing gid → replaced False
+    assert not p1.replace_remote("peer-2", "peer-2:999999", "x")
+
+
+def test_transfer_graph_bootstraps_empty_peer(two_peers):
+    """The VERDICT done-criterion: B starts empty, TransferGraph +
+    catch-up converge it to A's graph INCLUDING a dataclass record type
+    defined only on A."""
+    p1, p2 = two_peers
+    g1 = p1.graph
+    nodes = [g1.add(f"n{i}") for i in range(12)]
+    links = [
+        g1.add_link((nodes[i], nodes[(i + 1) % 12]), value=i)
+        for i in range(12)
+    ]
+    person = g1.add(_Person("ada", 36))
+    g1.add_link((person, nodes[0]), value="author-of")
+    assert p1.replication.flush()
+
+    before = p2.graph.atom_count()
+    stored = p2.transfer_graph_from("peer-1", page=7)
+    assert stored >= 12 + 12 + 2
+
+    # structure converged: every A-atom resolves by gid with same topology
+    for l in links:
+        gid = transfer.gid_of(g1, int(l), "peer-1")
+        lb = transfer.lookup_local(p2.graph, gid)
+        assert lb is not None
+        ta = [transfer.gid_of(g1, t, "peer-1") for t in g1.get_targets(int(l))]
+        tb = [
+            transfer.gid_of(p2.graph, t, "peer-2")
+            for t in p2.graph.get_targets(int(lb))
+        ]
+        assert ta == tb
+    # the record atom arrived with its type installed
+    pgid = transfer.gid_of(g1, int(person), "peer-1")
+    pb = transfer.lookup_local(p2.graph, pgid)
+    got = p2.graph.get(int(pb))
+    assert got == {"name": "ada", "age": 36} or getattr(
+        got, "name", None
+    ) == "ada"
+
+    # post-transfer mutations converge via CATCH-UP ONLY (clock jumped to
+    # the server's log head at snapshot time — no full replay)
+    seen_at_transfer = p2.replication.last_seen.get("peer-1")
+    assert seen_at_transfer >= p1.replication.log.head - 1
+    extra = g1.add("late-arrival")
+    assert p1.replication.flush()
+    p2.replication.catch_up("peer-1")
+    egid = transfer.global_id("peer-1", int(extra))
+    assert _wait(lambda: transfer.lookup_local(p2.graph, egid) is not None)
+    assert "peer-1" not in p2.replication.needs_full_sync
+
+
+def test_transfer_graph_maps_type_atoms_not_duplicates(two_peers):
+    """Transferred TYPE atoms map onto the receiver's own type atoms:
+    no duplicate 'string' type atom after a full bootstrap."""
+    p1, p2 = two_peers
+    p1.graph.add("x")
+    p2.transfer_graph_from("peer-1")
+
+    def type_atoms(g, name):
+        ts = g.typesystem
+        return [
+            h for h in g.atoms()
+            if ts._type_atom_name(int(h)) == name
+        ]
+
+    assert len(type_atoms(p2.graph, "string")) == 1
