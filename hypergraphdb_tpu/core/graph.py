@@ -192,6 +192,9 @@ class HyperGraph:
         if self._snapshot_mgr is not None:
             self._snapshot_mgr.close()
             self._snapshot_mgr = None
+        if getattr(self, "_type_column", None) is not None:
+            self._type_column.close()
+            self._type_column = None
         self.backend.shutdown()
         self._open = False
 
@@ -738,6 +741,16 @@ class HyperGraph:
     def incremental(self):
         """The active SnapshotManager, or None (exact-snapshot mode)."""
         return self._snapshot_mgr
+
+    def type_column(self):
+        """The hot host-side handle→type column (lazily built; see
+        ``utils/typecolumn.py`` — the typed-incidence annotation of the
+        reference's bdb-native extension)."""
+        if getattr(self, "_type_column", None) is None:
+            from hypergraphdb_tpu.utils.typecolumn import TypeColumn
+
+            self._type_column = TypeColumn(self)
+        return self._type_column
 
     def snapshot(self, refresh: bool = False):
         """Pack (or return the cached) immutable device CSR snapshot — a

@@ -203,6 +203,39 @@ class IncidentPlan(Plan):
 
 
 @dataclass
+class TypedIncidencePlan(Plan):
+    """``And(Incident(t), AtomType(T))`` answered from the incidence set
+    plus ONE vectorized gather into the hot host type column — no store
+    record read per candidate link and no full type-set materialization
+    (the reference's typed-incidence annotation,
+    ``storage/bdb-native/.../TypeAndPositionIncidenceAnnotator.java``)."""
+
+    target: int
+    type_handle: int
+
+    def run(self, graph):
+        arr = graph.get_incidence_set(self.target).array()
+        if not len(arr):
+            return np.asarray(arr, dtype=np.int64)
+        tcol = graph.type_column()
+        return np.asarray(
+            arr[tcol.types_of(arr) == self.type_handle], dtype=np.int64
+        )
+
+    def estimate(self, graph):
+        from hypergraphdb_tpu.core.graph import IDX_BY_TYPE, _type_key
+
+        inc = graph.store.incidence_count(self.target)
+        tcnt = graph.store.get_index(IDX_BY_TYPE).count(
+            _type_key(self.type_handle)
+        )
+        return float(min(inc, tcnt))
+
+    def describe(self):
+        return f"typed-incident({self.target}, type({self.type_handle}))"
+
+
+@dataclass
 class TargetSetPlan(Plan):
     """The (sorted, deduped) targets of a link."""
 
@@ -1029,7 +1062,27 @@ def _residual_predicate(cond: c.HGQueryCondition) -> Optional[c.HGQueryCondition
 
 
 def _translate_and(graph, clauses: Sequence[c.HGQueryCondition]) -> Plan:
-    sets: list[Plan] = []
+    clauses = list(clauses)
+    # typed-incidence fusion: one AtomType + ≥1 Incident → answer the type
+    # constraint from the hot type column over the SMALLEST incidence row
+    # instead of materializing the whole type set (TypedIncidencePlan)
+    types = [cl for cl in clauses if isinstance(cl, c.AtomType)]
+    incs = [cl for cl in clauses if isinstance(cl, c.Incident)]
+    fused: Optional[Plan] = None
+    if len(types) == 1 and incs:
+        try:
+            th = int(types[0].type_handle(graph))
+            best = min(
+                incs,
+                key=lambda i: graph.store.incidence_count(int(i.target)),
+            )
+            fused = TypedIncidencePlan(int(best.target), th)
+            clauses = [
+                cl for cl in clauses if cl is not types[0] and cl is not best
+            ]
+        except Exception:
+            fused = None  # e.g. unknown type name: generic planning decides
+    sets: list[Plan] = [fused] if fused is not None else []
     preds: list[c.HGQueryCondition] = []
     for cl in clauses:
         p = _leaf_plan(graph, cl)

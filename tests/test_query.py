@@ -175,7 +175,9 @@ def test_plan_shapes(populated):
     g, strings, ints, people, (l1, l2, l3) = populated
     q = compile_query(g, hg.and_(hg.type_("string"), hg.incident(ints[0])))
     d = q.analyze()
-    assert "type" in d and "incident" in d and "∩" in d
+    # type+incident now FUSES into the typed-incidence plan (the
+    # bdb-native annotation analogue) instead of a two-set intersection
+    assert "typed-incident" in d and "type" in d
     q2 = compile_query(g, hg.eq("apple"))
     assert "value" in q2.analyze()
     q3 = compile_query(g, hg.predicate(lambda gr, h: True))

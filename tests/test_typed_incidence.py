@@ -1,0 +1,96 @@
+"""Typed-incidence annotation (VERDICT r4 missing #4): And(Incident,
+AtomType) answered from the incidence set + the hot host type column —
+no store record read per candidate link (ref
+``storage/bdb-native/.../TypeAndPositionIncidenceAnnotator.java``)."""
+
+import numpy as np
+import pytest
+
+from hypergraphdb_tpu import HyperGraph
+from hypergraphdb_tpu.query import dsl as hg
+from hypergraphdb_tpu.query.compiler import (
+    TypedIncidencePlan,
+    compile_query,
+)
+
+
+@pytest.fixture
+def tdb():
+    g = HyperGraph()
+    anchor = g.add("anchor")
+    others = [g.add(f"o{i}") for i in range(6)]
+    links = []
+    for i, o in enumerate(others):
+        # alternate int-valued and string-valued links → two link types
+        v = i if i % 2 == 0 else f"s{i}"
+        links.append(g.add_link((anchor, o), value=v))
+    yield g, anchor, others, links
+    g.close()
+
+
+def test_plan_shape_fuses_type_into_incidence(tdb):
+    g, anchor, *_ = tdb
+    q = compile_query(g, hg.and_(hg.type_("int"), hg.incident(anchor)))
+    assert isinstance(q.plan, TypedIncidencePlan), q.analyze()
+
+
+def test_typed_incidence_differential(tdb):
+    g, anchor, others, links = tdb
+    got = sorted(g.find_all(hg.and_(hg.type_("int"), hg.incident(anchor))))
+    want = sorted(
+        int(l) for i, l in enumerate(links) if i % 2 == 0
+    )
+    assert got == want
+
+
+def test_no_store_reads_per_candidate(tdb, monkeypatch):
+    """The annotation's whole point: once the column is hot, candidate
+    links are classified WITHOUT loading their records."""
+    g, anchor, *_ = tdb
+    g.type_column()  # build while get_link is unpatched
+    calls = []
+    orig = g.store.get_link
+    monkeypatch.setattr(
+        g.store, "get_link", lambda h: (calls.append(h), orig(h))[1]
+    )
+    got = g.find_all(hg.and_(hg.type_("int"), hg.incident(anchor)))
+    assert len(got) == 3
+    assert not calls, f"candidate links were loaded: {calls}"
+
+
+def test_column_tracks_add_remove_replace(tdb):
+    g, anchor, others, links = tdb
+    cond = hg.and_(hg.type_("int"), hg.incident(anchor))
+    before = set(g.find_all(cond))
+
+    nl = g.add_link((anchor, others[0]), value=99)       # new int link
+    g.remove(int(links[0]))                              # drop an int link
+    g.replace(int(links[2]), "now-a-string")             # int → string
+    got = set(g.find_all(cond))
+    assert int(nl) in got
+    assert int(links[0]) not in got
+    assert int(links[2]) not in got
+    assert got == (before | {int(nl)}) - {int(links[0]), int(links[2])}
+
+
+def test_column_cold_start_falls_back_to_store(tdb):
+    """Handles beyond the built column (or unknown) re-check the store —
+    staleness costs time, never correctness."""
+    g, anchor, others, _ = tdb
+    tc = g.type_column()
+    # shrink the column artificially: everything is "unknown"
+    tc._col = np.full(2, -1, dtype=np.int32)
+    got = sorted(g.find_all(hg.and_(hg.type_("int"), hg.incident(anchor))))
+    want = sorted(
+        int(h) for h in g.get_incidence_set(int(anchor)).array()
+        if isinstance(g.get(int(h)).value, int)
+    )
+    assert got == want
+
+
+def test_three_way_conjunction_still_exact(tdb):
+    g, anchor, others, links = tdb
+    got = sorted(g.find_all(hg.and_(
+        hg.type_("int"), hg.incident(anchor), hg.incident(others[0])
+    )))
+    assert got == [int(links[0])]
