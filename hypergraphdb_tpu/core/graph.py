@@ -85,6 +85,9 @@ class HyperGraph:
             backend = self._make_backend(self.config)
         self.backend = backend
         backend.startup()
+        # captured BEFORE bootstrap creates type atoms: a truly fresh store
+        # is stamped with the current format, never migrated
+        self._fresh_store = backend.max_handle() == 0
         self.txman = HGTransactionManager(backend, enabled=self.config.transactional)
         self.store = HGStore(
             backend, self.txman,
@@ -122,6 +125,12 @@ class HyperGraph:
                 self._memwatch.add_listener(self.store._inc_cache.clear)
             self._memwatch.start()
         self._open = True
+        # on-disk format check + migration chain (the reference's
+        # maintenance upgrades) — BEFORE the loaders below, so a migration
+        # may rewrite registry formats they then read
+        from hypergraphdb_tpu.maintenance.migration import migrate
+
+        migrate(self)
         # restore the database's self-knowledge from the store (the
         # reference's HGIndexManager.loadIndexers + class↔type index
         # recovery at open, HGTypeSystem.java:97-98): registered indexers

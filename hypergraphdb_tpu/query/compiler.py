@@ -1051,6 +1051,11 @@ def _leaf_plan(graph, cond: c.HGQueryCondition) -> Optional[Plan]:
         return AllAtomsPlan()
     if isinstance(cond, c.Nothing):
         return EmptyPlan()
+    if isinstance(cond, c.MapCondition):
+        return ResultMapPlan(
+            translate(graph, simplify(graph, expand(graph, cond.condition))),
+            cond.mapping,
+        )
     return None
 
 

@@ -552,6 +552,30 @@ class SubgraphContains(HGQueryCondition):
 
 
 @dataclass(frozen=True)
+class MapCondition(HGQueryCondition):
+    """First-class result-mapping condition (``query/MapCondition.java``):
+    the result set of ``condition`` passed through ``mapping`` (an object
+    with ``apply(graph, np.ndarray) -> np.ndarray``, e.g.
+    ``LinkProjectionMapping``). COMPOSABLE inside And/Or — the mapped set
+    intersects/unions like any other set — which the ``result_map`` API
+    (top-level only) could not do. Inside a composition the mapping must
+    return handles; value-producing mappings (Deref) stay top-level."""
+
+    mapping: Any
+    condition: Any
+
+    def satisfies(self, graph, h):
+        # membership of h in a mapped set has no per-handle form (the
+        # mapping is not invertible in general) — same stance as the
+        # reference's MapCondition, which only exists as a query
+        from hypergraphdb_tpu.core.errors import QueryError
+
+        raise QueryError(
+            "MapCondition has no per-atom satisfies(); use it as a query"
+        )
+
+
+@dataclass(frozen=True)
 class Predicate(HGQueryCondition):
     """Arbitrary predicate over (graph, handle) (``MapCondition`` /
     user ``HGAtomPredicate``). Opaque to the planner: always a filter."""

@@ -137,6 +137,20 @@ def pipe(graph, producer_condition, key_condition):
     return _pipe(graph, producer_condition, key_condition)
 
 
+def mapped(condition, mapping=None, position: Optional[int] = None
+           ) -> c.MapCondition:
+    """First-class ``MapCondition`` — composable inside and_/or_ (the
+    ``result_map`` API is top-level only). ``position=n`` is shorthand for
+    the LinkProjectionMapping at target position n."""
+    if mapping is None:
+        if position is None:
+            raise ValueError("mapped() needs a mapping or a position")
+        from hypergraphdb_tpu.query.compiler import LinkProjectionMapping
+
+        mapping = LinkProjectionMapping(position)
+    return c.MapCondition(mapping, condition)
+
+
 def subsumes(specific) -> c.Subsumes:
     """Atoms more general than ``specific`` (``SubsumesCondition``)."""
     return c.Subsumes(_h(specific))

