@@ -19,7 +19,14 @@ class QueryConfig:
 
     parallel_or: bool = False          # async union of Or branches
     prefer_device: bool = True         # plan onto TPU snapshot when possible
-    device_min_batch: int = 64         # below this, host cursors win (planner duality)
+    #: smallest-child estimate below which ONE-SHOT dispatches stay on
+    #: host cursors (planner duality). MEASURED on tunneled TPU hardware
+    #: (CALIBRATION.md §2): a single ad-hoc device dispatch costs
+    #: 130-800 ms there, so the host wins through at least 262K rows.
+    #: Batched serving (plan_pattern/execute_pattern) is NOT gated by
+    #: this. Co-located chips should lower it (re-run
+    #: tools/calibrate_duality.py).
+    device_min_batch: int = 262_144
     contract_conjunctions: bool = True
     #: cost cap for range-scan cardinality estimates: counts are exact up
     #: to this many entries, then clamped (HGIndexStats.java:37 analogue)

@@ -721,15 +721,21 @@ def pipe(graph, producer_condition, key_condition):
 # ============================================================ helpers
 
 
+#: zig-zag/merge crossover, MEASURED (CALIBRATION.md §1): probing wins
+#: from 4× size disparity at every tested small size (1K–100K over the
+#: 10M id space); the old 32 made 4×–32× intersections pay the merge
+ZIGZAG_RATIO = 4
+
+
 def intersect_sorted(graph, a: np.ndarray, b: np.ndarray) -> np.ndarray:
-    """Vectorized sorted intersection. For wildly different sizes use
+    """Vectorized sorted intersection. For different-enough sizes use
     searchsorted probing (the zig-zag/leapfrog analogue); otherwise a
     merge (``np.intersect1d``) — mirroring the reference's
     ZigZag-vs-SortedIntersection choice by size ratio."""
     if len(a) == 0 or len(b) == 0:
         return _EMPTY
     small, large = (a, b) if len(a) <= len(b) else (b, a)
-    if len(large) > 32 * len(small):
+    if len(large) > ZIGZAG_RATIO * len(small):
         pos = np.searchsorted(large, small)
         pos = np.minimum(pos, len(large) - 1)
         return small[large[pos] == small]
