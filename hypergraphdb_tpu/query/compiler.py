@@ -543,6 +543,14 @@ class DeviceValueConjPlan(Plan):
 
 @dataclass
 class UnionPlan(Plan):
+    """Sorted union of children; the merge is vectorized (``np.unique``
+    over the concatenated child arrays) regardless of ``parallel``.
+
+    ``parallel`` mirrors ``OrToParellelQuery``/``UnionResultAsync`` for
+    API parity but is OFF by default: index-read children are GIL-bound,
+    and the measured thread-pool 'speedup' is 0.9× — a slight loss
+    (CALIBRATION.md §3)."""
+
     children: list[Plan]
     parallel: bool = False
 
