@@ -474,8 +474,14 @@ def bench_c5():
     # ≥2 LIVE compactions must fire inside the timed window (VERDICT r4
     # item 5 — r4's stream never crossed 0.5×base, so "incremental re-pack
     # under load" was demonstrated only at toy scale in tests).
-    # pack_pad_multiple 1<<21 keeps base device shapes identical across
-    # swaps → the cached XLA executable survives every compaction.
+    # pack_pad_multiple 1<<19 keeps base device shapes identical across
+    # MOST swaps (cached executable reuse); when the growing capacity
+    # crosses a 512K bucket boundary mid-run — it does once at these
+    # stream sizes — that swap pays one XLA recompile, and the reported
+    # query_latency_ms_over_swap_max deliberately INCLUDES it: that is the
+    # real worst-case serving cost of a base swap. (A coarser multiple
+    # would avoid it but at 1<<21 the dense per-seed state overflowed the
+    # 16 GB chip.)
     mgr = g.enable_incremental(
         headroom=1.8, background=True, delta_bucket_min=1 << 18,
         compact_ratio=float(os.environ.get("BENCH_C5_COMPACT_RATIO", "0.1")),

@@ -125,22 +125,34 @@ class HyperGraph:
                 self._memwatch.add_listener(self.store._inc_cache.clear)
             self._memwatch.start()
         self._open = True
-        # on-disk format check + migration chain (the reference's
-        # maintenance upgrades) — BEFORE the loaders below, so a migration
-        # may rewrite registry formats they then read
-        from hypergraphdb_tpu.maintenance.migration import migrate
+        try:
+            # on-disk format check + migration chain (the reference's
+            # maintenance upgrades) — BEFORE the loaders below, so a
+            # migration may rewrite registry formats they then read
+            from hypergraphdb_tpu.maintenance.migration import migrate
 
-        migrate(self)
-        # restore the database's self-knowledge from the store (the
-        # reference's HGIndexManager.loadIndexers + class↔type index
-        # recovery at open, HGTypeSystem.java:97-98): registered indexers
-        # answer queries and the subtype closure is intact after reopen
-        from hypergraphdb_tpu.indexing.manager import load_indexers
+            migrate(self)
+            # restore the database's self-knowledge from the store (the
+            # reference's HGIndexManager.loadIndexers + class↔type index
+            # recovery at open, HGTypeSystem.java:97-98): registered
+            # indexers answer queries and the subtype closure is intact
+            # after reopen
+            from hypergraphdb_tpu.indexing.manager import load_indexers
 
-        load_indexers(self)
-        from hypergraphdb_tpu.atom.utilities import load_subsumptions
+            load_indexers(self)
+            from hypergraphdb_tpu.atom.utilities import load_subsumptions
 
-        load_subsumptions(self)
+            load_subsumptions(self)
+        except BaseException:
+            # the deliberately-reachable refuse-to-open path (e.g. a
+            # NEWER-format database) must not leak the started backend's
+            # store lock or the memwatch thread
+            if self._memwatch is not None:
+                self._memwatch.stop()
+                self._memwatch = None
+            self._open = False
+            self.backend.shutdown()
+            raise
         self.events.dispatch(self, ev.HGOpenedEvent(graph=self))
 
     @staticmethod

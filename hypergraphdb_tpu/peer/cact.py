@@ -125,7 +125,10 @@ class RemoteOpServer(Activity):
             atype.make(base64.b64decode(op["value_b64"]))
             if op.get("value_b64") is not None else None
         )
-        g.replace(int(h), value)
+        # type passed EXPLICITLY: a class-less RecordType revives the value
+        # as a dict, which inference would silently retype to 'dict',
+        # unindexing the atom from its real type (review r5 finding 1)
+        g.replace(int(h), value, type=op["type"])
         return {"replaced": True}
 
     def _op_get_atom_type(self, op: dict) -> Any:

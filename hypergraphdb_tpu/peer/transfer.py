@@ -242,7 +242,9 @@ def store_atom(graph, wire: dict) -> int:
     local = lookup_local(graph, gid)
     if local is not None:
         if graph.contains(local):
-            graph.replace(local, value)
+            # explicit type: a dict-revived record value must not be
+            # re-inferred as 'dict' (review r5 finding 1)
+            graph.replace(local, value, type=wire["type"])
             return int(local)
         _atom_map(graph).remove_entry(gid.encode("utf-8"), local)
     if wire["is_link"]:

@@ -123,6 +123,26 @@ class TypeSetPlan(Plan):
         return f"type({self.type_handle})"
 
 
+def _capped_range_estimate(graph, idx, stats_name: str, bounds) -> float:
+    """Shared range-scan cardinality policy (HGIndexStats.java:37
+    semantics): cost-capped EXACT count where ordering decisions live; a
+    saturated count falls back to the persisted whole-index stats so
+    'big' ranges stay ordered among themselves. One implementation for
+    the by-value system index and user indexes — the policy must not
+    drift between them (review r5 finding 7)."""
+    lo, hi, lo_inc, hi_inc = bounds
+    cap = graph.config.query.range_estimate_cap
+    n = idx.count_range(
+        lo=lo, hi=hi, lo_inclusive=lo_inc, hi_inclusive=hi_inc, cap=cap,
+    )
+    if n >= cap:
+        from hypergraphdb_tpu.indexing.manager import index_stats
+
+        stats = index_stats(graph, stats_name)
+        return float(max(cap, stats["entries"] // 2))
+    return float(n)
+
+
 @dataclass
 class ValueSetPlan(Plan):
     """Atoms by value via the by-value system index; eq or ordered range."""
@@ -166,21 +186,9 @@ class ValueSetPlan(Plan):
         idx = graph.store.get_index(IDX_BY_VALUE)
         if self.op == "eq":
             return float(idx.count(self.key))
-        # cost-capped exact range count (HGIndexStats.java:37 semantics):
-        # exact where ordering decisions live; a saturated count falls back
-        # to the persisted whole-index stats to stay ordered among "big"s
-        lo, hi, lo_inc, hi_inc = self._bounds()
-        cap = graph.config.query.range_estimate_cap
-        n = idx.count_range(
-            lo=lo, hi=hi, lo_inclusive=lo_inc, hi_inclusive=hi_inc, cap=cap,
+        return _capped_range_estimate(
+            graph, idx, IDX_BY_VALUE, self._bounds()
         )
-        if n >= cap:
-            from hypergraphdb_tpu.core.graph import IDX_BY_VALUE
-            from hypergraphdb_tpu.indexing.manager import index_stats
-
-            stats = index_stats(graph, IDX_BY_VALUE)
-            return float(max(cap, stats["entries"] // 2))
-        return float(n)
 
     def describe(self):
         return f"value[{self.op}]"
@@ -285,22 +293,13 @@ class IndexSetPlan(Plan):
         idx = get_index(graph, self.name)
         if self.op == "eq":
             return float(idx.count(self.key))
-        lo, hi, lo_inc, hi_inc = {
+        bounds = {
             "lt": (None, self.key, True, False),
             "lte": (None, self.key, True, True),
             "gt": (self.key, None, False, False),
             "gte": (self.key, None, True, False),
         }[self.op]
-        cap = graph.config.query.range_estimate_cap
-        n = idx.count_range(
-            lo=lo, hi=hi, lo_inclusive=lo_inc, hi_inclusive=hi_inc, cap=cap,
-        )
-        if n >= cap:
-            from hypergraphdb_tpu.indexing.manager import index_stats
-
-            stats = index_stats(graph, self.name)
-            return float(max(cap, stats["entries"] // 2))
-        return float(n)
+        return _capped_range_estimate(graph, idx, self.name, bounds)
 
     def describe(self):
         return f"index({self.name})[{self.op}]"
@@ -609,6 +608,9 @@ class LinkProjectionMapping:
 
     position: int
 
+    #: output is a handle set → composable inside MapCondition/And/Or
+    returns_handles = True
+
     def __post_init__(self):
         if int(self.position) < 0:
             raise QueryError(
@@ -654,7 +656,10 @@ class LinkProjectionMapping:
 @dataclass(frozen=True)
 class DerefMapping:
     """Map each result handle to its VALUE (``query/impl/DerefMapping``);
-    the output is a python list, not a handle set."""
+    the output is a python list, not a handle set — top-level
+    ``result_map``/``deref`` only, never inside MapCondition."""
+
+    returns_handles = False
 
     def apply(self, graph, arr: np.ndarray) -> list:
         return [graph.get(int(h)) for h in arr.tolist()]
@@ -1066,6 +1071,14 @@ def _leaf_plan(graph, cond: c.HGQueryCondition) -> Optional[Plan]:
     if isinstance(cond, c.Nothing):
         return EmptyPlan()
     if isinstance(cond, c.MapCondition):
+        if not getattr(cond.mapping, "returns_handles", False):
+            # a value-producing mapping (Deref) would feed a python list
+            # into the surrounding set algebra — fail at compile time,
+            # not deep inside an intersection (review r5 finding 6)
+            raise QueryError(
+                f"MapCondition mapping {type(cond.mapping).__name__} does "
+                "not return handles; use result_map()/deref() at top level"
+            )
         return ResultMapPlan(
             translate(graph, simplify(graph, expand(graph, cond.condition))),
             cond.mapping,

@@ -58,7 +58,15 @@ class TypeColumn:
         with self._lock:
             self._grow(peek)
             if len(ids):
-                self._col[ids] = flat[offsets[:-1]].astype(np.int32)
+                # fill ONLY still-unknown slots: the listeners registered
+                # before this scan, so a commit landing between the locked
+                # extraction and this write may already have recorded a
+                # NEWER type — overwriting it with the scanned (older)
+                # value would leave a permanently stale non-(-1) entry
+                # (review r5 finding 2)
+                vals = flat[offsets[:-1]].astype(np.int32)
+                unknown = self._col[ids] == -1
+                self._col[ids[unknown]] = vals[unknown]
 
     def _grow(self, n: int) -> None:
         if n < len(self._col):

@@ -108,3 +108,28 @@ def test_index_stats_persist_across_reopen(tmp_path):
     s3 = ixm.index_stats(g2, IDX_BY_VALUE, refresh=True)
     assert s3["entries"] >= 50
     g2.close()
+
+
+def test_stats_recount_when_index_changed_across_reopen(tmp_path):
+    """Review r5 finding 3: the session mutation counter resets at reopen,
+    so a negative drift must not validate a stale record — the live key
+    count is the cross-session authority."""
+    pytest.importorskip("hypergraphdb_tpu.storage.native")
+    import hypergraphdb_tpu as hgm
+    from hypergraphdb_tpu.core.graph import IDX_BY_VALUE
+
+    loc = str(tmp_path / "db")
+    g = HyperGraph(hgm.HGConfiguration(store_backend="native", location=loc))
+    for i in range(40):
+        g.add(i)
+    s1 = ixm.index_stats(g, IDX_BY_VALUE)
+    g.close()
+
+    g2 = HyperGraph(hgm.HGConfiguration(store_backend="native", location=loc))
+    # grow the index far past the 25% key-drift window, with FEWER session
+    # mutations than the recorded version
+    for i in range(4000):
+        g2.add(10_000 + i)
+    s2 = ixm.index_stats(g2, IDX_BY_VALUE)
+    assert s2["entries"] > s1["entries"], (s1, s2)
+    g2.close()

@@ -414,15 +414,21 @@ def test_ack_driven_truncation(two_peers):
     p1.replication.truncate_batch = 8
     for i in range(40):
         p1.graph.add(f"t{i}")
-    assert p1.replication.flush()
-    assert p2.replication.flush()
+    # generous timeouts: under full-suite CPU contention the push→apply→
+    # ack round trips legitimately take longer than the defaults
+    assert p1.replication.flush(30)
+    assert p2.replication.flush(30)
     # p2's acks flowed back and let p1 reclaim acknowledged entries
-    assert _wait(lambda: p1.replication.peer_acks.get("peer-2", 0) >= 30)
-    assert _wait(lambda: p1.replication.log.floor > 0)
+    assert _wait(
+        lambda: p1.replication.peer_acks.get("peer-2", 0) >= 30, timeout=15
+    )
+    assert _wait(lambda: p1.replication.log.floor > 0, timeout=15)
     # a catch-up from before the floor flags the full-sync path
     p2.replication.last_seen._map["peer-1"] = 0
     p2.replication.catch_up("peer-1")
-    assert _wait(lambda: "peer-1" in p2.replication.needs_full_sync)
+    assert _wait(
+        lambda: "peer-1" in p2.replication.needs_full_sync, timeout=15
+    )
 
 
 def test_slow_apply_does_not_stall_dispatch(two_peers):
@@ -604,3 +610,30 @@ def test_transfer_graph_maps_type_atoms_not_duplicates(two_peers):
         ]
 
     assert len(type_atoms(p2.graph, "string")) == 1
+
+
+def test_replace_remote_keeps_record_type_on_schemaless_peer(two_peers):
+    """Review r5 finding 1: replacing a record atom on a peer that holds
+    only the wire schema must NOT retype it to 'dict'."""
+    p1, p2 = two_peers
+    h = p1.graph.add(_Person("ada", 36))
+    handles = p1.define_remote("peer-2", h)
+    tname = p1.graph.typesystem.infer(_Person()).name
+    hb = handles[-1]
+    assert p2.graph.typesystem.name_of(
+        p2.graph.get_type_handle_of(hb)
+    ) == tname
+
+    gid = transfer.gid_of(p1.graph, int(h), "peer-1")
+    assert p1.replace_remote("peer-2", gid, _Person("ada", 37))
+    # still the record type, still findable by it, new value visible
+    assert p2.graph.typesystem.name_of(
+        p2.graph.get_type_handle_of(hb)
+    ) == tname
+    th2 = p2.graph.typesystem.handle_of(tname)
+    assert int(hb) in {int(x) for x in q.find_all(
+        p2.graph, q.type_(int(th2))
+    )}
+    got = p2.graph.get(int(hb))
+    age = got["age"] if isinstance(got, dict) else got.age
+    assert age == 37

@@ -175,3 +175,22 @@ def test_map_condition_has_no_satisfies(graph):
     mc = c.MapCondition(LinkProjectionMapping(0), c.AnyAtom())
     with pytest.raises(QueryError):
         mc.satisfies(graph, 0)
+
+
+def test_map_condition_rejects_value_mappings(graph):
+    """Review r5 finding 6: Deref inside MapCondition fails at compile
+    time, not deep inside set algebra."""
+    from hypergraphdb_tpu.core.errors import QueryError
+    from hypergraphdb_tpu.query import conditions as c
+    from hypergraphdb_tpu.query import dsl as hg
+    from hypergraphdb_tpu.query.compiler import DerefMapping, compile_query
+
+    graph.add("x")
+    with pytest.raises(QueryError, match="handles"):
+        compile_query(
+            graph,
+            hg.and_(
+                c.MapCondition(DerefMapping(), c.AnyAtom()),
+                hg.type_("string"),
+            ),
+        )
