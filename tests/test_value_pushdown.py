@@ -228,3 +228,39 @@ def test_string_range_ties_verified_host_side():
     )
     assert got == want
     g.close()
+
+
+def test_value_columns_row_pack_matches_default(valued_db):
+    """The optional (N+1, 4) row-packed rank layout (CALIBRATION.md §4)
+    must agree bit-for-bit with the default column gathers."""
+    import jax.numpy as jnp
+
+    from hypergraphdb_tpu.ops.setops import (
+        _bucket,
+        ell_targets,
+        incident_value_range,
+        value_columns,
+    )
+    from hypergraphdb_tpu.utils.ordered_bytes import rank64
+
+    g, nodes, rels = valued_db
+    snap = g.snapshot()
+    ell = ell_targets(snap)
+    vcols = value_columns(snap)
+    vt = g.typesystem.infer(11)
+    r_lo = rank64(vt.to_key(11)[1:])
+    r_hi = rank64(vt.to_key(37)[1:])
+    kind = vt.to_key(11)[0]
+    anchors = np.asarray([[int(nodes[0])], [int(nodes[4])]], dtype=np.int32)
+    lens = snap.inc_offsets[anchors[:, 0] + 1] - snap.inc_offsets[anchors[:, 0]]
+    pad = _bucket(int(lens.max()))
+    args = (
+        snap.device, ell, jnp.asarray(anchors), pad, jnp.uint8(kind),
+        jnp.uint32(r_lo >> 32), jnp.uint32(r_lo & 0xFFFFFFFF),
+        jnp.uint32(r_hi >> 32), jnp.uint32(r_hi & 0xFFFFFFFF),
+        "gte", "lt", True, None,
+    )
+    _, keep0, _, counts0 = incident_value_range(*args)
+    _, keep1, _, counts1 = incident_value_range(*args, vcols)
+    np.testing.assert_array_equal(np.asarray(keep0), np.asarray(keep1))
+    np.testing.assert_array_equal(np.asarray(counts0), np.asarray(counts1))
