@@ -10,6 +10,7 @@ from hypergraphdb_tpu.parallel.sharded import (
     ShardedDelta,
     ShardedSnapshot,
     and_incident_pattern_sharded,
+    and_incident_pattern_sharded_delta,
     bfs_levels_sharded,
     bfs_levels_sharded_delta,
     bfs_packed_sharded,
@@ -24,6 +25,7 @@ __all__ = [
     "ShardedDelta",
     "ShardedSnapshot",
     "and_incident_pattern_sharded",
+    "and_incident_pattern_sharded_delta",
     "bfs_levels_sharded",
     "bfs_levels_sharded_delta",
     "bfs_packed_sharded",

@@ -657,3 +657,43 @@ def and_incident_pattern_sharded(
         sdev, jnp.asarray(cands), jnp.asarray(padded)
     )
     return np.asarray(cands)[np.asarray(mask)]
+
+
+def and_incident_pattern_sharded_delta(
+    mgr, sdev: ShardedSnapshot, type_handle: int, anchors: list[int],
+) -> np.ndarray:
+    """(base, delta)-aware sharded conjunctive pattern: the mesh answers
+    the BASE (candidate-sharded membership over the immutable sharded
+    snapshot) and the host merges the LSM memtable — tombstoned candidates
+    drop, post-base atoms are evaluated against the live graph. The
+    pattern twin of :func:`bfs_packed_sharded_delta` (VERDICT r4 item 3's
+    'BFS/pattern path'); read semantics match
+    ``query/compiler.DeviceValueConjPlan``'s single-device merge.
+
+    ``mgr`` is the graph's :class:`ops.incremental.SnapshotManager`; its
+    base must be the snapshot ``sdev`` was sharded from (same epoch).
+    """
+    base, dead, new_atoms, revalued = mgr.read_view()
+    if base.num_atoms != sdev.num_atoms:
+        raise ValueError(
+            "sharded base and manager epoch diverged: re-shard the base"
+        )
+    out = and_incident_pattern_sharded(base, sdev, type_handle, anchors)
+    if dead and len(out):
+        out = out[~np.isin(out, np.fromiter(dead, dtype=np.int64))]
+    g = mgr.graph
+    fresh = []
+    for h in set(new_atoms) - dead:
+        try:
+            if int(g.get_type_handle_of(h)) != int(type_handle):
+                continue
+            ts = {int(t) for t in g.get_targets(h)}
+        except Exception:
+            continue
+        if all(int(a) in ts for a in anchors):
+            fresh.append(h)
+    if fresh:
+        out = np.union1d(
+            out.astype(np.int64), np.asarray(fresh, dtype=np.int64)
+        ).astype(out.dtype if len(out) else np.int64)
+    return out

@@ -866,6 +866,8 @@ def expand(graph, cond: c.HGQueryCondition) -> c.HGQueryCondition:
         return c.And(*(c.Incident(t) for t in cond.targets), cond)
     if isinstance(cond, c.TypedValue):
         return c.And(c.AtomType(cond.type), c.AtomValue(cond.value, cond.op))
+    if isinstance(cond, c.TypedIncident):
+        return c.And(c.Incident(cond.target), c.AtomType(cond.type))
     return cond
 
 

@@ -247,6 +247,29 @@ class Incident(HGQueryCondition):
 
 
 @dataclass(frozen=True)
+class TypedIncident(HGQueryCondition):
+    """Links of a given TYPE pointing at ``target`` — the first-class form
+    of the reference's bdb-native typed-incidence query
+    (``storage/incidence/TypedIncidentCondition.java`` answered by
+    ``QueryByTypedIncident`` off the annotated incidence index alone).
+    Expanded to ``And(Incident, AtomType)`` at compile time, which the
+    planner fuses onto the hot host type column
+    (``compiler.TypedIncidencePlan``) — same no-record-loads execution."""
+
+    target: HGHandle
+    type: Any  # type name or type-atom handle
+
+    def satisfies(self, graph, h):
+        if int(h) not in graph.get_incidence_set(self.target):
+            return False
+        th = (
+            graph.typesystem.handle_of(self.type)
+            if isinstance(self.type, str) else int(self.type)
+        )
+        return int(graph.get_type_handle_of(h)) == int(th)
+
+
+@dataclass(frozen=True)
 class PositionedIncident(HGQueryCondition):
     """Links having ``target`` at position ``position``
     (``PositionedIncidentCondition``)."""

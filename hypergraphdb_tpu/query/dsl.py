@@ -89,6 +89,12 @@ def incident(target) -> c.Incident:
     return c.Incident(_h(target))
 
 
+def typed_incident(target, t) -> c.TypedIncident:
+    """Links of type ``t`` incident to ``target`` (the bdb-native
+    typed-incidence query as a first-class condition)."""
+    return c.TypedIncident(_h(target), t)
+
+
 def incident_at(target, position: int) -> c.PositionedIncident:
     return c.PositionedIncident(_h(target), position)
 

@@ -94,3 +94,25 @@ def test_three_way_conjunction_still_exact(tdb):
         hg.type_("int"), hg.incident(anchor), hg.incident(others[0])
     )))
     assert got == [int(links[0])]
+
+
+def test_first_class_typed_incident_condition(tdb):
+    """TypedIncident (bdb-native TypedIncidentCondition parity): compiles
+    to the fused plan, matches the And form, survives the wire."""
+    from hypergraphdb_tpu.query import serialize as qser
+
+    g, anchor, others, links = tdb
+    cond = hg.typed_incident(anchor, "int")
+    q = compile_query(g, cond)
+    assert isinstance(q.plan, TypedIncidencePlan), q.analyze()
+    got = sorted(g.find_all(cond))
+    want = sorted(g.find_all(hg.and_(hg.type_("int"), hg.incident(anchor))))
+    assert got == want and len(got) == 3
+
+    # per-atom predicate form agrees
+    assert all(cond.satisfies(g, h) for h in got)
+    assert not cond.satisfies(g, int(links[1]))  # string-valued link
+
+    # remote-query serialization round-trips
+    back = qser.from_json(qser.to_json(cond))
+    assert back == cond
