@@ -673,17 +673,29 @@ def and_incident_pattern_sharded_delta(
     ``mgr`` is the graph's :class:`ops.incremental.SnapshotManager`; its
     base must be the snapshot ``sdev`` was sharded from (same epoch).
     """
+    if not anchors:
+        # an anchorless conjunction degenerates to a plain by-type query —
+        # silently answering with only the post-base memtable subset would
+        # be a wrong hybrid; make callers say what they mean
+        raise ValueError(
+            "and_incident_pattern_sharded_delta needs ≥1 anchor; use a "
+            "type query for the anchorless form"
+        )
     base, dead, new_atoms, revalued = mgr.read_view()
     if base.num_atoms != sdev.num_atoms:
         raise ValueError(
             "sharded base and manager epoch diverged: re-shard the base"
         )
     out = and_incident_pattern_sharded(base, sdev, type_handle, anchors)
-    if dead and len(out):
-        out = out[~np.isin(out, np.fromiter(dead, dtype=np.int64))]
+    # LSM merge, same semantics as DeviceValueConjPlan: drop dead AND
+    # revalued from the device result (a replace may have changed the
+    # type), then host-evaluate new ∪ revalued against the live graph
+    drop = dead | revalued
+    if drop and len(out):
+        out = out[~np.isin(out, np.fromiter(drop, dtype=np.int64))]
     g = mgr.graph
     fresh = []
-    for h in set(new_atoms) - dead:
+    for h in (set(new_atoms) | revalued) - dead:
         try:
             if int(g.get_type_handle_of(h)) != int(type_handle):
                 continue

@@ -260,13 +260,11 @@ class TypedIncident(HGQueryCondition):
     type: Any  # type name or type-atom handle
 
     def satisfies(self, graph, h):
-        if int(h) not in graph.get_incidence_set(self.target):
-            return False
-        th = (
-            graph.typesystem.handle_of(self.type)
-            if isinstance(self.type, str) else int(self.type)
-        )
-        return int(graph.get_type_handle_of(h)) == int(th)
+        # compose the two primitives, mirroring the expand() rewrite —
+        # type resolution lives in ONE place (AtomType.type_handle)
+        return Incident(self.target).satisfies(graph, h) and AtomType(
+            self.type
+        ).satisfies(graph, h)
 
 
 @dataclass(frozen=True)

@@ -276,3 +276,33 @@ def test_sharded_delta_pattern_merges_memtable(graph, mesh):
     if doomed is not None:
         assert doomed not in got
     mgr.close()
+
+
+def test_sharded_delta_pattern_handles_revalued_and_anchorless(graph, mesh):
+    """Review r5 finding: a replace() that changes an atom's TYPE must
+    drop it from (or surface it into) the sharded delta pattern result;
+    anchorless calls are rejected loudly."""
+    from hypergraphdb_tpu.ops.incremental import SnapshotManager
+    from hypergraphdb_tpu.parallel import and_incident_pattern_sharded_delta
+
+    a = graph.add("a")
+    b = graph.add("b")
+    l_int = graph.add_link((a, b), value=7)
+    l_str = graph.add_link((a, b), value="s")
+    mgr = SnapshotManager(graph, headroom=3.0, compact_ratio=50.0)
+    sdev = ShardedSnapshot.from_host(mgr.base, mesh)
+    th_int = int(graph.get_type_handle_of(l_int))
+
+    graph.replace(int(l_int), "now-a-string")   # int → string post-base
+    graph.replace(int(l_str), 42)               # string → int post-base
+    got = sorted(int(x) for x in and_incident_pattern_sharded_delta(
+        mgr, sdev, th_int, [int(a), int(b)]
+    ))
+    want = sorted(q.find_all(graph, q.and_(
+        q.type_(th_int), q.incident(int(a)), q.incident(int(b))
+    )))
+    assert got == want == [int(l_str)]
+
+    with pytest.raises(ValueError, match="anchor"):
+        and_incident_pattern_sharded_delta(mgr, sdev, th_int, [])
+    mgr.close()
