@@ -142,6 +142,41 @@ class RemoteOpServer(Activity):
         name = g.typesystem.name_of(rec[0])
         return {"type": name, "schema": transfer.describe_type(g, name)}
 
+    def _op_add_atom(self, op: dict) -> Any:
+        """Create an atom on THIS peer from a wire value + target global
+        ids (the ``PeerHyperNode.add`` server half): targets resolve
+        through the atom map; returns the new atom's global id."""
+        import base64
+
+        g = self.peer.graph
+        if op["type"] not in g.typesystem._by_name and op.get("type_schema"):
+            transfer.install_type(g, op["type_schema"])
+        atype = g.typesystem.get_type(op["type"])
+        value = (
+            atype.make(base64.b64decode(op["value_b64"]))
+            if op.get("value_b64") is not None else None
+        )
+        tg = []
+        for gid in op.get("targets", ()):
+            h = transfer.lookup_local(g, gid)
+            if h is None:
+                raise KeyError(f"unmapped target {gid}")
+            tg.append(int(h))
+        if tg:
+            h = g.add_link(tg, value=value, type=op["type"])
+        else:
+            h = g.add_node(value, type=op["type"])
+        return {"gid": transfer.gid_of(g, int(h), self.peer.identity)}
+
+    def _op_peek_atom(self, op: dict) -> Any:
+        """One serialized atom, WITHOUT the closure — the read half of the
+        remote view (the caller is a window, not a replica)."""
+        g = self.peer.graph
+        h = transfer.lookup_local(g, op["gid"])
+        if h is None or not g.contains(int(h)):
+            raise KeyError(f"atom not found: {op['gid']}")
+        return {"atom": transfer.serialize_atom(g, int(h), self.peer.identity)}
+
     def _op_sync_types(self, op: dict) -> Any:
         """SyncTypes (ref ``peer/cact/SyncTypes.java``): install a batch of
         remote type schemas so subsequently pushed/pulled atoms of those
@@ -157,6 +192,8 @@ class RemoteOpServer(Activity):
 RemoteOpServer.OPS = {
     "define_atom": RemoteOpServer._op_define_atom,
     "get_atom": RemoteOpServer._op_get_atom,
+    "add_atom": RemoteOpServer._op_add_atom,
+    "peek_atom": RemoteOpServer._op_peek_atom,
     "remove_atom": RemoteOpServer._op_remove_atom,
     "replace_atom": RemoteOpServer._op_replace_atom,
     "get_atom_type": RemoteOpServer._op_get_atom_type,

@@ -637,3 +637,47 @@ def test_replace_remote_keeps_record_type_on_schemaless_peer(two_peers):
     got = p2.graph.get(int(hb))
     age = got["age"] if isinstance(got, dict) else got.age
     assert age == 37
+
+
+def test_remote_graph_view(two_peers):
+    """RemoteGraphView (PeerHyperNode analogue): CRUD + queries execute on
+    the remote peer; nothing is replicated into the local graph."""
+    from hypergraphdb_tpu.peer.remote_view import remote_view
+
+    p1, p2 = two_peers
+    view = remote_view(p1, "peer-2")
+
+    # create remote nodes + a link between them
+    ga = view.add("alpha")
+    gb = view.add("beta")
+    gl = view.add("bond", targets=(ga, gb))
+    assert view.get(ga) == "alpha"
+    assert view.get_targets(gl) == [ga, gb]
+    assert view.get_type_name(ga) == "string"
+
+    # the atoms live ONLY on peer-2
+    before = p1.graph.atom_count()
+    assert transfer.lookup_local(p1.graph, ga) is None
+    assert p1.graph.atom_count() == before
+    assert len(q.find_all(p2.graph, q.value("alpha"))) == 1
+
+    # remote query through the view sees them
+    rows = view.find_all(q.value("alpha"))
+    assert len(rows) == 1
+    assert view.count(q.type_("string")) >= 3
+    lb = transfer.lookup_local(p2.graph, gl)
+    ab = transfer.lookup_local(p2.graph, ga)
+    assert view.incidence(int(ab)) == [int(lb)]
+
+    # replace + remove round-trip
+    assert view.replace(ga, "alpha2")
+    assert view.get(ga) == "alpha2"
+    assert view.remove(gl)
+    assert view.incidence(int(ab)) == []
+
+    # a record value defined only locally still round-trips (schema rides)
+    view2 = remote_view(p2, "peer-1")
+    gp = view2.add(_Person("lin", 29))
+    got = view2.get(gp)
+    name = got["name"] if isinstance(got, dict) else got.name
+    assert name == "lin"
