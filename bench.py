@@ -299,11 +299,6 @@ def bench_c3(snap, info):
 
     exec_qps = best_of(exec_window, n=3)
 
-    host_n = min(256, K)
-    host_qps = best_of(lambda: host_pattern_vectorized(
-        snap, pairs[:host_n].tolist(), th
-    ))
-
     # value-predicate pushdown leg (VERDICT r2 item 3): the SAME anchor
     # pairs constrained by property rank in [16, 48) — the device rank
     # window rides the plan's bucketing (one bucket at this scale, so two
@@ -358,6 +353,14 @@ def bench_c3(snap, info):
         return K / ((time.perf_counter() - t0) / vreps)
 
     value_exec_qps = best_of(value_exec_window, n=3)
+
+    # host baselines LAST, after every device window: the windows then run
+    # back-to-back, so a mid-c3 contention shift cannot hit only the value
+    # leg while the ~minutes of host loops sit between them
+    host_n = min(256, K)
+    host_qps = best_of(lambda: host_pattern_vectorized(
+        snap, pairs[:host_n].tolist(), th
+    ))
     host_value_qps = best_of(lambda: host_value_pattern_vectorized(
         snap, pairs[:host_n].tolist(), lo, hi
     ))
