@@ -269,40 +269,36 @@ def bench_c3(snap, info):
     b = snap.tgt_flat[starts + 1].astype(np.int64)
     pairs = np.stack([a, b], axis=1).astype(np.int32)
 
-    # plan once (compile + anchor staging — the HGQuery.make analogue),
-    # then measure steady-state executes: results (counts + matches)
-    # download every rep; batches pipeline so dispatch latency amortizes
+    # plan once (compile + anchor staging — the HGQuery.make analogue).
+    # MEASUREMENT ORDER IS LOAD-BEARING: the first bulk device_get through
+    # the axon tunnel degrades this process's launch path ~100× for good
+    # (measured: the identical exec window runs at 10.1M q/s before any
+    # download and 109K q/s after one serving window), so the DOWNLOADLESS
+    # execution-mode windows run first, then the serving windows (which
+    # measure the tunnel as much as the engine), then result collection
+    # and host baselines.
     plan = plan_pattern(snap, pairs, th)
-    out = collect_pattern(plan, execute_pattern(plan))  # warmup + results
-    _ = execute_pattern(plan, top_r=4)  # warmup the compact variant too
     reps = int(os.environ.get("BENCH_C3_REPS", 64))
-    # serving mode: per-rep result download (counts + top-4 matches, which
-    # covers every real result set in this workload)
-    def serving_window():
-        t0 = time.perf_counter()
-        all_pending = [execute_pattern(plan, top_r=4) for _ in range(reps)]
-        jax.device_get([(c, f) for p in all_pending for _, c, f in p])
-        return K / ((time.perf_counter() - t0) / reps)
-
-    device_qps = best_of(serving_window, n=3)
+    jax.block_until_ready([
+        x for _, c_, f in execute_pattern(plan, top_r=4) for x in (c_, f)
+    ])  # warmup, no download
 
     # execution mode: results stay in HBM (what the chip sustains when the
-    # host link is not the bottleneck — the axon tunnel's ~1-2 MB/s would
-    # otherwise dominate the serving number on a bad day)
+    # host link is not the bottleneck)
     def exec_window():
         t0 = time.perf_counter()
         last = None
         for _ in range(reps):
             last = execute_pattern(plan, top_r=4)
-        jax.block_until_ready([x for _, c, f in last for x in (c, f)])
+        jax.block_until_ready([x for _, c_, f in last for x in (c_, f)])
         return K / ((time.perf_counter() - t0) / reps)
 
     exec_qps = best_of(exec_window, n=3)
 
     # value-predicate pushdown leg (VERDICT r2 item 3): the SAME anchor
     # pairs constrained by property rank in [16, 48) — the device rank
-    # window rides the plan's bucketing (one bucket at this scale, so two
-    # dispatches per rep), vs the host doing intersection + rank filter
+    # window rides the plan's bucketing, vs the host doing intersection +
+    # rank filter
     import jax.numpy as jnp
 
     from hypergraphdb_tpu.ops.setops import (
@@ -317,7 +313,7 @@ def bench_c3(snap, info):
         # [16, 48) == gte lo AND lt hi, fused: ONE launch per bucket does
         # the membership pass once and compares both bounds (the r4 form
         # paid two full incident_value_pattern passes per window — exactly
-        # the 2× VERDICT item 4 pointed at); only (K,) counts download
+        # the 2× VERDICT item 4 pointed at)
         outs = []
         for _, anchors_dev, pad in plan.buckets:
             _, _, _, counts = incident_value_range(
@@ -330,20 +326,9 @@ def bench_c3(snap, info):
             outs.append(counts)  # per-query counts
         return outs
 
-    jax.block_until_ready(value_exec()[0])  # warmup
+    jax.block_until_ready(value_exec())  # warmup, no download
     vreps = reps
 
-    def value_window():
-        t0 = time.perf_counter()
-        pend = [value_exec() for _ in range(vreps)]
-        jax.device_get(pend)
-        return K / ((time.perf_counter() - t0) / vreps)
-
-    value_qps = best_of(value_window, n=3)
-
-    # execution mode for the value leg too: counts stay in HBM, so a
-    # congested tunnel day cannot masquerade as kernel slowness (same
-    # rationale as exec_queries_per_sec above)
     def value_exec_window():
         t0 = time.perf_counter()
         last = None
@@ -354,9 +339,28 @@ def bench_c3(snap, info):
 
     value_exec_qps = best_of(value_exec_window, n=3)
 
-    # host baselines LAST, after every device window: the windows then run
-    # back-to-back, so a mid-c3 contention shift cannot hit only the value
-    # leg while the ~minutes of host loops sit between them
+    # serving mode: per-rep result download (counts + top-4 matches, which
+    # covers every real result set in this workload). These windows pay
+    # the host link — on tunneled hardware that IS the bottleneck, which
+    # is the point of reporting them separately from exec mode.
+    def serving_window():
+        t0 = time.perf_counter()
+        all_pending = [execute_pattern(plan, top_r=4) for _ in range(reps)]
+        jax.device_get([(c_, f) for p in all_pending for _, c_, f in p])
+        return K / ((time.perf_counter() - t0) / reps)
+
+    device_qps = best_of(serving_window, n=3)
+
+    def value_window():
+        t0 = time.perf_counter()
+        pend = [value_exec() for _ in range(vreps)]
+        jax.device_get(pend)
+        return K / ((time.perf_counter() - t0) / vreps)
+
+    value_qps = best_of(value_window, n=3)
+
+    # result collection (downloads) + host baselines LAST
+    out = collect_pattern(plan, execute_pattern(plan))
     host_n = min(256, K)
     host_qps = best_of(lambda: host_pattern_vectorized(
         snap, pairs[:host_n].tolist(), th
@@ -660,18 +664,86 @@ def bench_c5():
     }
 
 
-def main() -> None:
-    c2 = bench_c2()
+def _config_c2() -> dict:
+    return bench_c2()
+
+
+def _config_c3() -> dict:
+    snap, info, _ = _build_10m()
+    return bench_c3(snap, info)
+
+
+def _config_c4() -> dict:
     snap, info, build_s = _build_10m()
-    # c4 first: its 4096-wide working set fills most of HBM, so it must
-    # not share the chip with c3's device CSR/ELL arrays. Afterwards its
-    # device-side plans are dropped to hand the space to c3.
-    c4 = bench_c4(snap, info)
-    for attr in ("_pull_device",):
-        if hasattr(snap, attr):
-            object.__delattr__(snap, attr)
-    c3 = bench_c3(snap, info)
-    c5 = bench_c5()
+    out = bench_c4(snap, info)
+    out["_graph"] = {
+        "n_atoms": info["n_atoms"],
+        "total_arity": info["total_arity"],
+        "build_s": round(build_s, 1),
+    }
+    return out
+
+
+def _config_c5() -> dict:
+    return bench_c5()
+
+
+def _run_isolated(name: str) -> dict:
+    """Run one config in a FRESH python subprocess.
+
+    Why process isolation: measured head-to-head, the identical exec
+    window runs the c3 pattern kernel at ~11.2M q/s in a fresh process and
+    ~95K q/s after EITHER c2's or c4's scan-heavy executables have been on
+    the chip — small-kernel launch latency degrades ~100× for the rest of
+    the process even with all buffers freed, and in-process ordering can
+    only protect ONE config. Each config now gets pristine launch state;
+    the duplicated 10M build is absorbed by the persistent XLA-compile and
+    plan caches."""
+    import subprocess
+    import sys
+
+    code = (
+        "import json, bench\n"
+        f"r = bench._config_{name}()\n"
+        "print('BENCH_RESULT ' + json.dumps(r), flush=True)\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        timeout=int(os.environ.get("BENCH_CONFIG_TIMEOUT_S", 1800)),
+    )
+    for line in proc.stdout.splitlines():
+        if line.startswith("BENCH_RESULT "):
+            return json.loads(line[len("BENCH_RESULT "):])
+    raise RuntimeError(
+        f"config {name} subprocess failed (rc={proc.returncode}):\n"
+        f"{proc.stderr[-4000:]}"
+    )
+
+
+def main() -> None:
+    if os.environ.get("BENCH_ISOLATE", "1") != "0":
+        c3 = _run_isolated("c3")
+        c4 = _run_isolated("c4")
+        c2 = _run_isolated("c2")
+        c5 = _run_isolated("c5")
+        graph = c4.pop("_graph")
+    else:  # legacy in-process path (BENCH_ISOLATE=0): order still matters
+        snap, info, build_s = _build_10m()
+        c3 = bench_c3(snap, info)
+        snap.__dict__.pop("device", None)  # cached_property storage
+        for attr in ("_tgt_ell", "_value_cols"):
+            if hasattr(snap, attr):
+                object.__delattr__(snap, attr)
+        c4 = bench_c4(snap, info)
+        c2 = bench_c2()
+        c5 = bench_c5()
+        graph = {
+            "n_atoms": info["n_atoms"],
+            "total_arity": info["total_arity"],
+            "build_s": round(build_s, 1),
+        }
     print(json.dumps({
         "metric": "bfs_3hop_4kseed_10m_edges_per_sec",
         "value": c4["edges_per_sec"],
@@ -683,11 +755,7 @@ def main() -> None:
             "c4_bfs_3hop_10m": c4,
             "c5_streaming": c5,
         },
-        "graph": {
-            "n_atoms": info["n_atoms"],
-            "total_arity": info["total_arity"],
-            "build_s": round(build_s, 1),
-        },
+        "graph": graph,
     }))
 
 
