@@ -77,7 +77,11 @@ def popcount(packed: jax.Array, axis=-1) -> jax.Array:
 
 def valid_word_mask(n_valid: int, w: int, offset: int = 0) -> np.ndarray:
     """(w,) uint32 mask with bit j of word i set iff
-    ``offset + i*32 + j < n_valid`` — clears the dummy row and pad bits."""
+    ``offset + i*32 + j < n_valid`` — clears the dummy row and pad bits.
+
+    Host-side (numpy) reference for the device mask ``bfs_packed_block``
+    builds inline with ``pack_bits`` — kept for host callers/tests; not on
+    the traced BFS path (hglint HG103)."""
     ids = offset + np.arange(w * WORD, dtype=np.int64)
     bits = ids < n_valid
     return np.packbits(
@@ -120,7 +124,9 @@ def _scatter_relation(
         jnp.zeros((K, m_dest), dtype=bool),
         jnp.zeros((K,), dtype=jnp.int32),
     )
-    if varying_axis is not None:
+    if varying_axis is not None and hasattr(jax.lax, "pcast"):
+        # jax >= 0.6 tracks varying-ness explicitly; 0.4.x shard_map has no
+        # varying type system, so a replicated init is accepted as-is
         init = jax.lax.pcast(init, (varying_axis,), to="varying")
     (dest, cnt), _ = jax.lax.scan(body, init, (src, dst))
     return pack_bits(dest), cnt
@@ -171,7 +177,9 @@ def bfs_packed_block(
     tgt_src = chunked(dev.tgt_src)
     tgt_flat = chunked(dev.tgt_flat)
 
-    valid = jnp.asarray(valid_word_mask(N, w))  # clears dummy slot N + pad
+    # clears dummy slot N + pad bits; built with jnp so tracing stays free
+    # of host numpy work (hglint HG103) — XLA folds it to a constant
+    valid = pack_bits(jnp.arange(m, dtype=jnp.int32) < N)
 
     frontier = jnp.zeros((K, w), dtype=jnp.uint32)
     bitv = jnp.left_shift(jnp.uint32(1), (seeds & 31).astype(jnp.uint32))

@@ -46,6 +46,15 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+try:  # DMA priorities landed after 0.4.x; harmless to drop when absent
+    import inspect
+
+    _COPY_PRIORITY = "priority" in inspect.signature(
+        pltpu.AsyncCopyDescriptor.start
+    ).parameters
+except Exception:  # pragma: no cover - defensive: API moved
+    _COPY_PRIORITY = False
+
 #: indices per pallas_call: 512 KB of the 1 MB SMEM budget
 SEG = 1 << 17
 #: output chunks per grid step
@@ -63,11 +72,15 @@ def _kernel(idx_ref, values, out_ref, rows, sems, *, w, Kw):
         base = g * G * w + c * w
         rbase = slot * w
         for j in range(w):
-            pltpu.make_async_copy(
+            copy = pltpu.make_async_copy(
                 values.at[pl.ds(idx_ref[base + j], 1), :],
                 rows.at[pl.ds(rbase + j, 1), :],
                 sems.at[slot],
-            ).start(priority=j % 2)
+            )
+            if _COPY_PRIORITY:
+                copy.start(priority=j % 2)
+            else:
+                copy.start()
 
     for p in range(D):
         start(p, p)

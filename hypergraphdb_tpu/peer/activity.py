@@ -58,8 +58,10 @@ class Activity:
         # transitions of ONE activity must serialize: the manager's worker
         # pool can otherwise run two messages of the same conversation
         # concurrently, racing FSM state (the reference serializes through
-        # per-activity action queues; our heap pops can interleave)
-        self._handle_lock = threading.Lock()
+        # per-activity action queues; our heap pops can interleave).
+        # RLock: complete()/fail() take it too, and transitions call them
+        # from inside handle() with the lock already held.
+        self._handle_lock = threading.RLock()
 
     @classmethod
     def _collect_transitions(cls) -> list:
@@ -91,17 +93,22 @@ class Activity:
                       f"for {msg.get('performative')}")
 
     def complete(self, result: Any = None) -> None:
-        self.state = COMPLETED
-        if not self.future.done():
-            self.future.set_result(result)
+        # state writes race handle()'s state reads when a caller (timeout
+        # path, peer shutdown) terminates the activity from another thread
+        # (hglint HG402) — reentrant from within a transition
+        with self._handle_lock:
+            self.state = COMPLETED
+            if not self.future.done():
+                self.future.set_result(result)
 
     def fail(self, reason: Any) -> None:
-        self.state = FAILED
-        if not self.future.done():
-            self.future.set_exception(
-                reason if isinstance(reason, Exception)
-                else RuntimeError(str(reason))
-            )
+        with self._handle_lock:
+            self.state = FAILED
+            if not self.future.done():
+                self.future.set_exception(
+                    reason if isinstance(reason, Exception)
+                    else RuntimeError(str(reason))
+                )
 
     # -- conveniences --------------------------------------------------------
     def send(self, target: str, performative: str, content: Any = None) -> None:

@@ -17,7 +17,11 @@ from __future__ import annotations
 from typing import Iterator, Optional, Sequence
 
 import numpy as np
-from sortedcontainers import SortedDict, SortedList
+
+try:  # soft dependency: slim images run the pure-Python shim
+    from sortedcontainers import SortedDict, SortedList
+except ImportError:  # pragma: no cover - exercised on images without it
+    from hypergraphdb_tpu.utils.sortedshim import SortedDict, SortedList
 
 from hypergraphdb_tpu.core.handles import HGHandle
 from hypergraphdb_tpu.storage.api import (

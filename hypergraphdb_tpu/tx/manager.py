@@ -226,7 +226,10 @@ class HGTransactionManager:
         tx.active = False
         if tx.parent is None:
             self._active.pop(id(tx), None)
-        self.aborted += 1
+        with self._commit_lock:
+            # += on a shared counter is load/add/store — concurrent aborts
+            # lose counts without the lock (hglint HG402)
+            self.aborted += 1
 
     def commit(self, tx: HGTransaction) -> None:
         st = self._stack()
@@ -239,7 +242,10 @@ class HGTransactionManager:
             return
         try:
             if tx.readonly or tx.is_empty():
-                self.committed += 1
+                with self._commit_lock:
+                    # same torn-increment hazard as `aborted` (hglint HG402);
+                    # the write path below already counts under the lock
+                    self.committed += 1
                 self._run_commit_hooks(tx)
                 return
             with self._commit_lock:

@@ -1,0 +1,162 @@
+"""Pure-Python fallback for the ``sortedcontainers`` API surface we use.
+
+``storage/memstore.py`` keeps its sorted key/handle structures in
+``SortedList`` / ``SortedDict``. The real package is a soft dependency: when
+it is absent (slim CI images, the growth container), these bisect-backed
+drop-ins provide the exact subset of the API the storage layer touches —
+``SortedList.add/remove/__contains__/__iter__/__len__`` and
+``SortedDict.get/__getitem__/__setitem__/__delitem__/pop/irange/keys/
+__iter__/__len__``.
+
+Asymptotics differ (``list.insert`` is O(n) vs sortedcontainers' O(√n))
+but the hot read paths are served from cached numpy snapshots and immutable
+CSR device snapshots, so insert cost on the host write path is acceptable
+for the fallback.
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_left, bisect_right, insort
+from typing import Any, Iterator, Optional
+
+
+class SortedList:
+    """Sorted sequence with O(log n) membership and O(n) insert/remove."""
+
+    __slots__ = ("_items",)
+
+    def __init__(self, iterable=()):
+        self._items = sorted(iterable)
+
+    def add(self, value) -> None:
+        insort(self._items, value)
+
+    def remove(self, value) -> None:
+        i = bisect_left(self._items, value)
+        if i == len(self._items) or self._items[i] != value:
+            raise ValueError(f"{value!r} not in list")
+        del self._items[i]
+
+    def discard(self, value) -> None:
+        try:
+            self.remove(value)
+        except ValueError:
+            pass
+
+    def __contains__(self, value) -> bool:
+        i = bisect_left(self._items, value)
+        return i < len(self._items) and self._items[i] == value
+
+    def __iter__(self) -> Iterator:
+        return iter(self._items)
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def __getitem__(self, index):
+        return self._items[index]
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"SortedList({self._items!r})"
+
+
+class SortedDict:
+    """Dict iterated in key order, with ``irange`` range scans.
+
+    Keys are kept in a parallel sorted list; the list is rebuilt lazily
+    after deletions (tombstone-free, amortized via a dirty flag) and
+    maintained incrementally on inserts.
+    """
+
+    __slots__ = ("_data", "_keys", "_dirty")
+
+    def __init__(self, *args, **kwargs):
+        self._data = dict(*args, **kwargs)
+        self._keys = sorted(self._data)
+        self._dirty = False
+
+    # -- key list maintenance -------------------------------------------------
+    def _klist(self) -> list:
+        if self._dirty:
+            self._keys = sorted(self._data)
+            self._dirty = False
+        return self._keys
+
+    # -- mapping protocol -----------------------------------------------------
+    def __getitem__(self, key):
+        return self._data[key]
+
+    def __setitem__(self, key, value) -> None:
+        if key not in self._data:
+            if self._dirty:
+                self._data[key] = value
+                return  # key list rebuilds on next read
+            insort(self._keys, key)
+        self._data[key] = value
+
+    def __delitem__(self, key) -> None:
+        del self._data[key]
+        self._dirty = True
+
+    def __contains__(self, key) -> bool:
+        return key in self._data
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def __iter__(self) -> Iterator:
+        return iter(self._klist())
+
+    def get(self, key, default=None):
+        return self._data.get(key, default)
+
+    def pop(self, key, *default):
+        if key in self._data:
+            self._dirty = True
+        return self._data.pop(key, *default)
+
+    def setdefault(self, key, default=None):
+        if key not in self._data:
+            self[key] = default
+        return self._data[key]
+
+    def keys(self):
+        return list(self._klist())
+
+    def items(self):
+        return [(k, self._data[k]) for k in self._klist()]
+
+    def values(self):
+        return [self._data[k] for k in self._klist()]
+
+    # -- range scans ----------------------------------------------------------
+    def irange(
+        self,
+        minimum: Optional[Any] = None,
+        maximum: Optional[Any] = None,
+        inclusive=(True, True),
+        reverse: bool = False,
+    ) -> Iterator:
+        """Iterate keys in ``[minimum, maximum]`` honoring the per-bound
+        inclusivity pair — the sortedcontainers signature."""
+        keys = self._klist()
+        lo_inc, hi_inc = inclusive
+        start = 0
+        if minimum is not None:
+            start = (
+                bisect_left(keys, minimum)
+                if lo_inc
+                else bisect_right(keys, minimum)
+            )
+        end = len(keys)
+        if maximum is not None:
+            end = (
+                bisect_right(keys, maximum)
+                if hi_inc
+                else bisect_left(keys, maximum)
+            )
+        sel = keys[start:end]
+        return iter(reversed(sel)) if reverse else iter(sel)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"SortedDict({self._data!r})"

@@ -1,0 +1,44 @@
+"""Seeded HG1xx hazards — host syncs inside traced code.
+
+NEVER imported by tests; hglint analyzes the AST only.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def bad_item(x):
+    return x.sum().item()  # HG101: .item() under trace
+
+
+@partial(jax.jit, static_argnames=("n",))
+def bad_float(x, n):
+    s = float(x[0])  # HG102: float() on a traced element
+    return x * s + n
+
+
+@jax.jit
+def bad_numpy(x):
+    return jnp.asarray(np.asarray(x) + 1)  # HG103: numpy under trace
+
+
+@jax.jit
+def bad_device_get(x):
+    host = jax.device_get(x)  # HG104: blocking transfer under trace
+    return x + host
+
+
+def _helper_sync(x):
+    # HG105, but only because bad_transitive below jits a caller — the
+    # taint must flow through the call graph, not the decorator list
+    jax.block_until_ready(x)
+    return x
+
+
+@jax.jit
+def bad_transitive(x):
+    return _helper_sync(x) * 2

@@ -1,0 +1,32 @@
+"""Seeded HG4xx hazards — a deliberate A->B / B->A lock-order cycle plus an
+unlocked shared-state mutation."""
+
+import threading
+
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+
+
+def transfer_ab(src, dst):
+    with lock_a:
+        with lock_b:  # order: a -> b
+            dst.append(src.pop())
+
+
+def transfer_ba(src, dst):
+    with lock_b:
+        with lock_a:  # HG401: order b -> a closes the cycle
+            dst.append(src.pop())
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def bump_unsafe(self):
+        self.value = self.value + 1  # HG402: mutation outside self._lock
+
+    def bump(self):
+        with self._lock:
+            self.value = self.value + 1

@@ -1,0 +1,50 @@
+"""Seeded HG3xx hazards — Pallas kernel contract violations."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _cast_kernel(x_ref, o_ref):
+    o_ref[:] = x_ref[:].astype(jnp.float16)  # HG304: out_shape says float32
+
+
+def misaligned(x):
+    return pl.pallas_call(
+        _cast_kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((8, 100), lambda i: (i, 0))],  # HG301: lane 100
+        out_specs=pl.BlockSpec((5, 128), lambda i: (i, 0)),   # HG301: sublane 5
+        out_shape=jax.ShapeDtypeStruct((20, 128), jnp.float32),
+    )(x)
+
+
+def _copy2(x_ref, o_ref):
+    o_ref[:] = x_ref[:]
+
+
+def bad_index_map(x):
+    return pl.pallas_call(
+        _copy2,
+        grid=(4, 2),
+        # HG302: index_map takes 1 arg, grid has rank 2
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+        # HG302: block index i+1 reaches 4 -> rows up to 40 > 16
+        out_specs=pl.BlockSpec((8, 128), lambda i, j: (i + 1, 0)),
+        out_shape=jax.ShapeDtypeStruct((16, 128), jnp.float32),
+    )(x)
+
+
+def _copy3(x_ref, o_ref):
+    o_ref[:] = x_ref[:]
+
+
+def bad_dtype_tile(x):
+    return pl.pallas_call(
+        _copy3,
+        grid=(2,),
+        in_specs=[pl.BlockSpec((16, 128), lambda i: (i, 0))],
+        # HG303: bfloat16 needs sublane % 16, block says 8
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((16, 128), jnp.bfloat16),
+    )(x)

@@ -1,0 +1,35 @@
+"""Seeded HG2xx hazards — retrace/recompile traps."""
+
+from functools import partial
+
+import jax
+
+_REGISTRY = {}  # mutable module global
+
+
+def retrace_loop(fns, xs):
+    outs = []
+    for f in fns:
+        jf = jax.jit(f)  # HG201: fresh jit per iteration
+        outs.append(jf(xs))
+    return outs
+
+
+@jax.jit
+def branch_on_traced(x, flag):
+    if flag:  # HG202: Python branch on traced param
+        return x + 1
+    return x - 1
+
+
+@jax.jit
+def global_capture(x):
+    scale = len(_REGISTRY)  # HG203: mutable global baked in at trace time
+    return x * scale
+
+
+def make_jitted(fn):
+    return jax.jit(fn, static_argnums={"n": 1})  # HG204: dict is unhashable
+
+
+make_partial = partial(jax.jit, static_argnames={"mode"})  # HG204 via partial

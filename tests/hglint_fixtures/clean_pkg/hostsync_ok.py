@@ -1,0 +1,36 @@
+"""Known-good traced code + host wrappers — hglint must stay silent.
+
+Host-side syncs (np.asarray, block_until_ready) are DELIBERATE here: they
+live in plain host functions, where they belong.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("n",))
+def scale(x, n):
+    if n > 2:  # branch on a STATIC param: fine
+        return x * n
+    return x + n
+
+
+@jax.jit
+def device_sum(x):
+    k = int(x.shape[0])  # shape access is concrete under trace: fine
+    return jnp.sum(x) * k
+
+
+@jax.jit
+def masked(x):
+    return jnp.where(x > 0, x, 0)  # data-dependent select, no Python branch
+
+
+def host_wrapper(xs):
+    arr = np.asarray(xs)  # host side: allowed
+    out = device_sum(jnp.asarray(arr))
+    jax.block_until_ready(out)  # host side: allowed
+    return float(np.asarray(out).sum())

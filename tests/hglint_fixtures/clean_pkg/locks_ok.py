@@ -1,0 +1,33 @@
+"""Two locks always taken in one consistent order, and a lock-owning class
+whose mutations all happen under the lock — hglint must stay silent."""
+
+import threading
+
+outer = threading.Lock()
+inner = threading.Lock()
+
+
+def update_both(items, extra):
+    with outer:
+        with inner:  # consistent order: outer -> inner, everywhere
+            items.extend(extra)
+
+
+def read_both(items):
+    with outer:
+        with inner:
+            return list(items)
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rev = 0
+
+    def bump(self):
+        with self._lock:
+            self._rev = self._rev + 1
+
+    def reset_locked(self):
+        # *_locked suffix documents the caller-holds-the-lock contract
+        self._rev = 0

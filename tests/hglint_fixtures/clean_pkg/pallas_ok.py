@@ -1,0 +1,19 @@
+"""A correctly-tiled pallas_call — hglint must stay silent."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[:] = x_ref[:].astype(jnp.float32)
+
+
+def tiled_copy(x):
+    return pl.pallas_call(
+        _copy_kernel,
+        grid=(2, 1),
+        in_specs=[pl.BlockSpec((8, 128), lambda i, j: (i, j))],
+        out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((16, 128), jnp.float32),
+    )(x)

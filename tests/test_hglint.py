@@ -1,0 +1,185 @@
+"""Tier-1 gate + precision pins for the hglint static analyzer.
+
+Two jobs:
+
+1. pin analyzer precision against the checked-in fixture sets —
+   ``hglint_fixtures/bad_pkg`` (every seeded hazard must be flagged) and
+   ``hglint_fixtures/clean_pkg`` (zero findings allowed);
+2. act as the repo gate: ``hypergraphdb_tpu`` linted against
+   ``tools/hglint/baseline.json`` must produce no NEW findings, so a PR
+   that introduces a fresh host-sync/retrace/Pallas/lock hazard fails
+   tier-1.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.hglint import (  # noqa: E402
+    RULES,
+    apply_baseline,
+    baseline_counts,
+    load_baseline,
+    run_lint,
+    write_baseline,
+)
+
+FIXTURES = Path(__file__).parent / "hglint_fixtures"
+BASELINE = REPO / "tools" / "hglint" / "baseline.json"
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+# ------------------------------------------------------------ bad fixtures
+
+
+def test_bad_fixture_flags_every_family():
+    findings = run_lint([str(FIXTURES / "bad_pkg")])
+    rules = _rules(findings)
+    # family 1: host-sync-in-traced-code, every spelling
+    assert {"HG101", "HG102", "HG103", "HG104", "HG105"} <= rules
+    # family 2: retrace hazards
+    assert {"HG201", "HG202", "HG203", "HG204"} <= rules
+    # family 3: Pallas contracts
+    assert {"HG301", "HG302", "HG303", "HG304"} <= rules
+    # family 4: lock order
+    assert {"HG401", "HG402"} <= rules
+    assert len(findings) >= 8  # acceptance floor; actual seed is larger
+
+
+def test_taint_flows_through_call_graph():
+    """block_until_ready lives in an UNdecorated helper; it must be flagged
+    because a jit root calls the helper."""
+    findings = run_lint([str(FIXTURES / "bad_pkg" / "hostsync.py")])
+    hits = [f for f in findings if f.rule == "HG105"]
+    assert len(hits) == 1
+    assert hits[0].scope == "_helper_sync"
+    assert "bad_transitive" in hits[0].message
+
+
+def test_pallas_out_of_bounds_and_arity():
+    findings = run_lint([str(FIXTURES / "bad_pkg" / "pallas_bad.py")])
+    msgs = [f.message for f in findings if f.rule == "HG302"]
+    assert any("out of bounds" in m for m in msgs)
+    assert any("grid has rank 2" in m for m in msgs)
+
+
+# ------------------------------------------------------------ lock fixtures
+
+
+def test_lock_cycle_flagged():
+    findings = run_lint([str(FIXTURES / "bad_pkg" / "locks_cycle.py")])
+    cycles = [f for f in findings if f.rule == "HG401"]
+    assert len(cycles) == 1
+    assert "lock_a" in cycles[0].message and "lock_b" in cycles[0].message
+
+
+def test_clean_two_lock_module_not_flagged():
+    findings = run_lint([str(FIXTURES / "clean_pkg" / "locks_ok.py")])
+    assert [f for f in findings if f.rule.startswith("HG4")] == []
+
+
+# ------------------------------------------------------------ clean fixtures
+
+
+def test_clean_fixture_is_silent():
+    findings = run_lint([str(FIXTURES / "clean_pkg")])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# ------------------------------------------------------------- repo gate
+
+
+def test_repo_gate_passes_with_baseline(monkeypatch):
+    """The tier-1 contract: hypergraphdb_tpu linted against the checked-in
+    baseline reports zero NEW findings."""
+    monkeypatch.chdir(REPO)  # baseline keys are repo-root-relative
+    findings = run_lint(["hypergraphdb_tpu"])
+    baseline = load_baseline(str(BASELINE))
+    fresh = apply_baseline(findings, baseline)
+    assert fresh == [], (
+        "new hglint findings (fix them or regenerate the baseline via "
+        "`python -m tools.hglint hypergraphdb_tpu --write-baseline "
+        "tools/hglint/baseline.json`):\n"
+        + "\n".join(f.render() for f in fresh)
+    )
+
+
+def test_repo_baseline_is_not_stale(monkeypatch):
+    """Every baseline entry must still correspond to a live finding —
+    otherwise fixed hazards stay suppressed forever."""
+    monkeypatch.chdir(REPO)
+    live = baseline_counts(run_lint(["hypergraphdb_tpu"]))
+    baseline = load_baseline(str(BASELINE))
+    stale = {
+        k: (v, live.get(k, 0))
+        for k, v in baseline.items()
+        if live.get(k, 0) < v
+    }
+    assert stale == {}, f"baseline entries with no live finding: {stale}"
+
+
+# ------------------------------------------------------------- baseline io
+
+
+def test_baseline_roundtrip(tmp_path):
+    findings = run_lint([str(FIXTURES / "bad_pkg")])
+    path = tmp_path / "baseline.json"
+    write_baseline(findings, str(path))
+    loaded = load_baseline(str(path))
+    assert loaded == baseline_counts(findings)
+    # everything baselined -> nothing new
+    assert apply_baseline(findings, loaded) == []
+    # dropping one entry resurfaces exactly that finding count
+    key, n = next(iter(sorted(loaded.items())))
+    partial = dict(loaded)
+    partial[key] = n - 1
+    fresh = apply_baseline(findings, partial)
+    assert len(fresh) == 1 and fresh[0].baseline_key == key
+
+
+def test_rule_registry_consistency():
+    findings = run_lint([str(FIXTURES / "bad_pkg")])
+    assert _rules(findings) <= set(RULES), "finding with unregistered rule id"
+
+
+# ------------------------------------------------------------------- CLI
+
+
+def test_cli_exit_codes(tmp_path):
+    env = dict(os.environ)
+    env.setdefault("PYTHONPATH", str(REPO))
+    bad = subprocess.run(
+        [sys.executable, "-m", "tools.hglint",
+         str(FIXTURES / "bad_pkg")],
+        cwd=REPO, capture_output=True, text=True, env=env,
+    )
+    assert bad.returncode == 1
+    assert "HG101" in bad.stdout
+    clean = subprocess.run(
+        [sys.executable, "-m", "tools.hglint",
+         str(FIXTURES / "clean_pkg")],
+        cwd=REPO, capture_output=True, text=True, env=env,
+    )
+    assert clean.returncode == 0
+
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.hglint", str(FIXTURES / "bad_pkg"),
+         "--json"],
+        cwd=REPO, capture_output=True, text=True, env=env,
+    )
+    data = json.loads(out.stdout)
+    assert isinstance(data, list) and len(data) >= 8
+    assert {"rule", "severity", "path", "line", "scope", "message"} <= set(
+        data[0]
+    )

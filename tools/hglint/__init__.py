@@ -1,0 +1,36 @@
+"""hglint — AST-based JAX/TPU hazard analyzer for the hypergraphdb_tpu
+codebase.
+
+Four rule families (see ``tools.hglint.model.RULES``):
+
+- HG1xx  host syncs reachable from traced (jit/pjit/shard_map/pallas) code
+- HG2xx  retrace/recompile hazards
+- HG3xx  Pallas kernel contracts ((8,128) tiling, index maps, dtypes)
+- HG4xx  lock-order cycles and unlocked shared-state mutation
+
+Run ``python -m tools.hglint <paths>``; the repo gate is
+``tools/lint.sh`` (baseline-filtered, exits nonzero on new findings).
+Pure AST analysis: target code is never imported or executed.
+"""
+
+from tools.hglint.engine import (
+    apply_baseline,
+    baseline_counts,
+    load_baseline,
+    run_lint,
+    summarize,
+    write_baseline,
+)
+from tools.hglint.model import RULES, Finding, sort_findings
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "apply_baseline",
+    "baseline_counts",
+    "load_baseline",
+    "run_lint",
+    "sort_findings",
+    "summarize",
+    "write_baseline",
+]

@@ -1,0 +1,72 @@
+"""CLI: ``python -m tools.hglint [paths...] [--baseline FILE]``.
+
+Exit status: 0 when no (post-baseline) findings, 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tools.hglint import engine
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="hglint",
+        description="AST-based JAX/TPU hazard analyzer "
+                    "(host-sync, retrace, Pallas tiling, lock-order)",
+    )
+    p.add_argument("paths", nargs="*", default=["hypergraphdb_tpu"],
+                   help="package dirs / files to analyze "
+                        "(default: hypergraphdb_tpu)")
+    p.add_argument("--baseline", metavar="FILE", default=None,
+                   help="suppress findings recorded in this baseline json")
+    p.add_argument("--write-baseline", metavar="FILE", default=None,
+                   help="write current findings as the new baseline and "
+                        "exit 0")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit findings as json")
+    p.add_argument("--severity", choices=("error", "warning", "info"),
+                   default=None,
+                   help="only report findings at this severity")
+    args = p.parse_args(argv)
+
+    findings = engine.run_lint(args.paths)
+
+    if args.write_baseline:
+        engine.write_baseline(findings, args.write_baseline)
+        print(f"wrote {len(findings)} findings to {args.write_baseline}")
+        return 0
+
+    if args.baseline:
+        baseline = engine.load_baseline(args.baseline)
+        findings = engine.apply_baseline(findings, baseline)
+        label = "new finding(s) beyond baseline"
+    else:
+        label = "finding(s)"
+
+    if args.severity:
+        findings = [f for f in findings if f.severity == args.severity]
+
+    if args.as_json:
+        print(json.dumps(
+            [
+                {
+                    "rule": f.rule, "severity": f.severity, "path": f.path,
+                    "line": f.line, "scope": f.scope, "message": f.message,
+                }
+                for f in findings
+            ],
+            indent=2,
+        ))
+    else:
+        for f in findings:
+            print(f.render())
+        print(f"hglint: {len(findings)} {label}; {engine.summarize(findings)}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,274 @@
+"""Function index, jit-root discovery, call graph, and traced-taint pass.
+
+The taint model: a function is *traced* when JAX may execute its body under
+tracing — it is decorated with (or passed to) ``jax.jit`` / ``pjit`` /
+``shard_map`` / ``pl.pallas_call``, it is (transitively) called from such a
+function, or it is defined inside one (closures handed to ``lax.fori_loop``
+/ ``scan`` / ``vmap``). Host-side wrappers that merely *call* jitted
+functions are not traced — taint flows root -> callee, never callee ->
+caller.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tools.hglint.loader import ModuleInfo, literal_value, resolve_fqn
+
+JIT_FQNS = {
+    "jax.jit",
+    "jax.pjit",
+    "jax.experimental.pjit.pjit",
+}
+SHARD_FQNS = {
+    "jax.shard_map",
+    "jax.experimental.shard_map.shard_map",
+}
+PALLAS_FQNS = {
+    "jax.experimental.pallas.pallas_call",
+}
+PARTIAL_FQNS = {"functools.partial"}
+WRAPPER_FQNS = JIT_FQNS | SHARD_FQNS | PALLAS_FQNS
+
+
+@dataclass
+class FunctionInfo:
+    key: str                 # "<module>.<qualpath>"
+    mod: ModuleInfo
+    qualpath: str            # "Class.method", "func", "outer.inner"
+    node: ast.AST            # FunctionDef | AsyncFunctionDef | Lambda
+    cls_name: Optional[str]
+    params: list
+    lineno: int
+    parent: Optional[str] = None          # enclosing function key
+    children: dict = field(default_factory=dict)  # local def name -> key
+    static_params: set = field(default_factory=set)
+    root_kind: Optional[str] = None       # "jit" | "shard_map" | "pallas_call"
+
+    @property
+    def is_root(self) -> bool:
+        return self.root_kind is not None
+
+
+@dataclass
+class CallSite:
+    node: ast.Call
+    fn_key: Optional[str]    # enclosing function (None at module level)
+    mod: ModuleInfo
+
+
+class CallGraph:
+    def __init__(self):
+        self.functions: dict[str, FunctionInfo] = {}
+        self.calls: list[CallSite] = []
+        self.edges: dict[str, set] = {}
+        self.traced: dict[str, str] = {}   # fn key -> root key it's traced via
+
+    # -- construction ---------------------------------------------------------
+
+    @classmethod
+    def build(cls, modules: list[ModuleInfo]) -> "CallGraph":
+        cg = cls()
+        for mod in modules:
+            _index_functions(cg, mod)
+        cg._mark_wrapper_callsite_roots()
+        cg._build_edges()
+        cg._propagate_taint()
+        return cg
+
+    # -- resolution -----------------------------------------------------------
+
+    def resolve_callable(
+        self, expr: ast.AST, site: CallSite
+    ) -> Optional[str]:
+        """Resolve a callable expression at a call site to a function key,
+        searching enclosing local defs, same-class methods, module-level
+        functions, then imports."""
+        fn = self.functions.get(site.fn_key) if site.fn_key else None
+        if isinstance(expr, ast.Name):
+            cur = fn
+            while cur is not None:
+                if expr.id in cur.children:
+                    return cur.children[expr.id]
+                cur = self.functions.get(cur.parent) if cur.parent else None
+            local = f"{site.mod.name}.{expr.id}"
+            if local in self.functions:
+                return local
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id in ("self", "cls")
+            and fn is not None
+            and fn.cls_name
+        ):
+            cand = f"{site.mod.name}.{fn.cls_name}.{expr.attr}"
+            if cand in self.functions:
+                return cand
+        fqn = resolve_fqn(expr, site.mod)
+        if fqn and fqn in self.functions:
+            return fqn
+        return None
+
+    # -- roots ----------------------------------------------------------------
+
+    def _mark_wrapper_callsite_roots(self) -> None:
+        for site in self.calls:
+            fqn = resolve_fqn(site.node.func, site.mod)
+            if fqn is None:
+                continue
+            kind = None
+            if fqn in JIT_FQNS:
+                kind = "jit"
+            elif fqn in SHARD_FQNS:
+                kind = "shard_map"
+            elif fqn in PALLAS_FQNS:
+                kind = "pallas_call"
+            if kind is None or not site.node.args:
+                continue
+            target = _unwrap_partial(site.node.args[0], site.mod)
+            key = self.resolve_callable(target, site)
+            if key is None:
+                continue
+            fi = self.functions[key]
+            if fi.root_kind is None:
+                fi.root_kind = kind
+            fi.static_params |= _static_params(site.node, fi)
+
+    # -- edges + taint --------------------------------------------------------
+
+    def _build_edges(self) -> None:
+        for site in self.calls:
+            if site.fn_key is None:
+                continue
+            callee = self.resolve_callable(site.node.func, site)
+            if callee is not None:
+                self.edges.setdefault(site.fn_key, set()).add(callee)
+            # a function passed as an argument to another *known* function
+            # (e.g. a body handed to lax.fori_loop, a predicate to a local
+            # combinator) is conservatively reachable from the caller
+            for arg in list(site.node.args) + [k.value for k in site.node.keywords]:
+                tgt = _unwrap_partial(arg, site.mod)
+                if isinstance(tgt, (ast.Name, ast.Attribute)):
+                    k = self.resolve_callable(tgt, site)
+                    if k is not None:
+                        self.edges.setdefault(site.fn_key, set()).add(k)
+
+    def _propagate_taint(self) -> None:
+        from collections import deque
+
+        q = deque()
+        for key, fi in self.functions.items():
+            if fi.is_root:
+                self.traced[key] = key
+                q.append(key)
+        while q:
+            key = q.popleft()
+            root = self.traced[key]
+            fi = self.functions[key]
+            nxt = set(self.edges.get(key, ()))
+            nxt |= set(fi.children.values())  # closures trace with the parent
+            for n in nxt:
+                if n not in self.traced:
+                    self.traced[n] = root
+                    q.append(n)
+
+    def traced_functions(self) -> list[FunctionInfo]:
+        return [self.functions[k] for k in self.traced]
+
+
+# ------------------------------------------------------------------- indexing
+
+
+def _index_functions(cg: CallGraph, mod: ModuleInfo) -> None:
+    def walk(node, qual: list, cls_name: Optional[str],
+             fn_stack: list):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qp = ".".join(qual + [child.name])
+                key = f"{mod.name}.{qp}"
+                params = [a.arg for a in (
+                    child.args.posonlyargs + child.args.args
+                    + child.args.kwonlyargs
+                )]
+                # same-named modules from DIFFERENT lint roots (e.g.
+                # ``hglint dirA/pkg dirB/pkg``) would collide on key and
+                # silently drop the second tree's functions/findings —
+                # uniquify instead (cross-tree name resolution then binds
+                # to the first tree, an accepted imprecision)
+                while key in cg.functions:
+                    key += "'"
+                fi = FunctionInfo(
+                    key=key, mod=mod, qualpath=qp, node=child,
+                    cls_name=cls_name, params=params, lineno=child.lineno,
+                    parent=fn_stack[-1].key if fn_stack else None,
+                )
+                _decorator_roots(fi, mod)
+                cg.functions[key] = fi
+                if fn_stack:
+                    fn_stack[-1].children[child.name] = key
+                walk(child, qual + [child.name], None, fn_stack + [fi])
+            elif isinstance(child, ast.ClassDef):
+                walk(child, qual + [child.name], child.name, fn_stack)
+            else:
+                if isinstance(child, ast.Call):
+                    fn_key = fn_stack[-1].key if fn_stack else None
+                    cg.calls.append(
+                        CallSite(node=child, fn_key=fn_key, mod=mod)
+                    )
+                walk(child, qual, cls_name, fn_stack)
+
+    walk(mod.tree, [], None, [])
+
+
+def _decorator_roots(fi: FunctionInfo, mod: ModuleInfo) -> None:
+    node = fi.node
+    for dec in getattr(node, "decorator_list", ()):
+        base = dec.func if isinstance(dec, ast.Call) else dec
+        fqn = resolve_fqn(base, mod)
+        if fqn in JIT_FQNS:
+            fi.root_kind = "jit"
+        elif fqn in SHARD_FQNS:
+            fi.root_kind = "shard_map"
+        elif fqn in PARTIAL_FQNS and isinstance(dec, ast.Call) and dec.args:
+            inner = resolve_fqn(dec.args[0], mod)
+            if inner in JIT_FQNS:
+                fi.root_kind = "jit"
+            elif inner in SHARD_FQNS:
+                fi.root_kind = "shard_map"
+            else:
+                continue
+        else:
+            continue
+        if isinstance(dec, ast.Call):
+            fi.static_params |= _static_params(dec, fi)
+
+
+def _static_params(call: ast.Call, fi: FunctionInfo) -> set:
+    out: set = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            v = literal_value(kw.value)
+            if isinstance(v, str):
+                out.add(v)
+            elif isinstance(v, tuple):
+                out |= {s for s in v if isinstance(s, str)}
+        elif kw.arg == "static_argnums":
+            v = literal_value(kw.value)
+            nums = [v] if isinstance(v, int) else (
+                [n for n in v if isinstance(n, int)]
+                if isinstance(v, tuple) else []
+            )
+            for n in nums:
+                if 0 <= n < len(fi.params):
+                    out.add(fi.params[n])
+    return out
+
+
+def _unwrap_partial(expr: ast.AST, mod: ModuleInfo) -> ast.AST:
+    if isinstance(expr, ast.Call):
+        fqn = resolve_fqn(expr.func, mod)
+        if fqn in PARTIAL_FQNS and expr.args:
+            return expr.args[0]
+    return expr

@@ -1,0 +1,103 @@
+"""Lint orchestration + baseline filtering."""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter, defaultdict
+
+from tools.hglint import (
+    rules_hostsync,
+    rules_locks,
+    rules_pallas,
+    rules_retrace,
+)
+from tools.hglint.callgraph import CallGraph
+from tools.hglint.loader import discover_modules
+from tools.hglint.model import Finding, sort_findings
+
+BASELINE_VERSION = 1
+
+
+def run_lint(paths: list) -> list:
+    """Analyze every ``*.py`` under the given paths (analyzed together so
+    cross-module call edges resolve) and return sorted findings."""
+    modules = []
+    for p in paths:
+        modules.extend(discover_modules(p))
+    cg = CallGraph.build(modules)
+    findings = []
+    findings += rules_hostsync.check(cg)
+    findings += rules_retrace.check(cg, modules)
+    findings += rules_pallas.check(cg, modules)
+    findings += rules_locks.check(cg, modules)
+    return sort_findings(findings)
+
+
+# ------------------------------------------------------------------ baseline
+
+
+def load_baseline(path: str) -> dict:
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path}: version {data.get('version')} != "
+            f"{BASELINE_VERSION}"
+        )
+    return dict(data.get("counts", {}))
+
+
+def baseline_counts(findings: list) -> dict:
+    return dict(sorted(Counter(f.baseline_key for f in findings).items()))
+
+
+def write_baseline(findings: list, path: str) -> None:
+    data = {
+        "version": BASELINE_VERSION,
+        "comment": "hglint suppression baseline — keys are "
+                   "rule:path:function with pre-existing counts. The gate "
+                   "fails only when a key's live count EXCEEDS its entry. "
+                   "Regenerate with: python -m tools.hglint <paths> "
+                   "--write-baseline",
+        "counts": baseline_counts(findings),
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def apply_baseline(findings: list, baseline: dict) -> list:
+    """Return only findings beyond the baselined count per key. Within a
+    key, later (higher-line) findings are treated as the new ones."""
+    by_key = defaultdict(list)
+    for f in findings:
+        by_key[f.baseline_key].append(f)
+    out = []
+    for key, fs in by_key.items():
+        allowed = baseline.get(key, 0)
+        if len(fs) > allowed:
+            fs = sorted(fs, key=lambda f: f.line)
+            out.extend(fs[allowed:])
+    return sort_findings(out)
+
+
+def summarize(findings: list) -> str:
+    fam = Counter(f.rule[:3] + "xx" for f in findings)
+    rules = Counter(f.rule for f in findings)
+    parts = [f"{n} findings" if (n := len(findings)) != 1
+             else "1 finding"]
+    if findings:
+        parts.append(
+            "by family: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(fam.items())
+            )
+        )
+        parts.append(
+            "by rule: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(rules.items())
+            )
+        )
+    return "; ".join(parts)

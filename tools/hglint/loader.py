@@ -1,0 +1,309 @@
+"""Module loading and name/constant resolution for hglint.
+
+Everything here is pure AST work — target code is never imported, so
+fixture files may contain deliberately broken or device-only code.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class ModuleInfo:
+    name: str                     # dotted module name, e.g. "pkg.ops.frontier"
+    path: str                     # path as reported in findings
+    tree: ast.Module
+    imports: dict = field(default_factory=dict)   # local alias -> dotted fqn
+    toplevel: set = field(default_factory=set)    # names def'd at module level
+    consts: dict = field(default_factory=dict)    # module-level literal consts
+    mutable_globals: dict = field(default_factory=dict)  # name -> lineno
+
+
+def discover_modules(root: str) -> list[ModuleInfo]:
+    """Load every ``*.py`` under ``root`` (a package dir or plain dir, or a
+    single file). Module names are derived from the path below the root's
+    parent; when two lint roots contain same-named packages, the call graph
+    uniquifies colliding function keys (see ``callgraph._index_functions``)
+    so no tree's findings are dropped."""
+    mods: list[ModuleInfo] = []
+    if os.path.isfile(root):
+        files = [root]
+        base = os.path.dirname(root) or "."
+    else:
+        base = os.path.dirname(os.path.abspath(root))
+        files = []
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if d not in ("__pycache__", ".git")
+            )
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    files.append(os.path.join(dirpath, fn))
+    for path in files:
+        with open(path, "r", encoding="utf-8") as fh:
+            src = fh.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            continue  # not our job; flake8/py_compile own syntax errors
+        name = _module_name(path, base)
+        rel = os.path.relpath(path)
+        shown = rel if not rel.startswith("..") else path
+        mod = ModuleInfo(name=name, path=shown, tree=tree)
+        _index_module(mod)
+        mods.append(mod)
+    return mods
+
+
+def _module_name(path: str, base: str) -> str:
+    rel = os.path.relpath(os.path.abspath(path), base)
+    parts = rel[:-3].split(os.sep)  # strip .py
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+# --------------------------------------------------------------- module index
+
+
+def _module_stmts(tree: ast.Module):
+    """Module-level statements, descending into try/except/if bodies so
+    guarded imports (``try: import fast except ImportError: import shim``)
+    register like plain ones."""
+    stack = list(reversed(tree.body))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, ast.Try):
+            stack.extend(reversed(
+                node.body + node.orelse + node.finalbody
+                + [s for h in node.handlers for s in h.body]
+            ))
+        elif isinstance(node, ast.If):
+            stack.extend(reversed(node.body + node.orelse))
+
+
+def _index_module(mod: ModuleInfo) -> None:
+    pkg_parts = mod.name.split(".")[:-1]  # package containing this module
+    for node in _module_stmts(mod.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                local = alias.asname or alias.name.split(".")[0]
+                target = alias.name if alias.asname else alias.name.split(".")[0]
+                mod.imports[local] = target
+        elif isinstance(node, ast.ImportFrom):
+            src = _resolve_from(node, pkg_parts)
+            if src is None:
+                continue
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                local = alias.asname or alias.name
+                mod.imports[local] = f"{src}.{alias.name}" if src else alias.name
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            mod.toplevel.add(node.name)
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            value = node.value
+            for t in targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                mod.toplevel.add(t.id)
+                if value is None:
+                    continue
+                cv = literal_value(value)
+                if cv is not NOT_CONST:
+                    mod.consts[t.id] = cv
+                if _is_mutable_literal(value):
+                    mod.mutable_globals[t.id] = t.lineno
+
+
+def _resolve_from(node: ast.ImportFrom, pkg_parts: list[str]) -> Optional[str]:
+    if node.level == 0:
+        return node.module or ""
+    # relative import: climb level-1 packages up from the containing package
+    up = node.level - 1
+    if up > len(pkg_parts):
+        return None
+    head = pkg_parts[: len(pkg_parts) - up]
+    if node.module:
+        head = head + node.module.split(".")
+    return ".".join(head)
+
+
+def _is_mutable_literal(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        return node.func.id in ("dict", "list", "set", "defaultdict",
+                                "OrderedDict", "deque")
+    return False
+
+
+# ----------------------------------------------------------- name resolution
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` attribute chain -> "a.b.c"; None for anything else."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def resolve_fqn(node: ast.AST, mod: ModuleInfo) -> Optional[str]:
+    """Resolve an expression to a fully-qualified dotted name using the
+    module's import map. ``jnp.asarray`` -> "jax.numpy.asarray";
+    a module-level symbol ``f`` -> "<modname>.f"; an unknown bare name is
+    returned as-is (so builtins read as "float", "int", ...)."""
+    dn = dotted_name(node)
+    if dn is None:
+        return None
+    head, _, rest = dn.partition(".")
+    if head in mod.imports:
+        base = mod.imports[head]
+        return f"{base}.{rest}" if rest else base
+    if head in mod.toplevel:
+        return f"{mod.name}.{dn}"
+    return dn
+
+
+# ------------------------------------------------------ constant evaluation
+
+NOT_CONST = object()
+
+_DTYPE_HEADS = ("jax.numpy.", "numpy.", "jnp.", "np.")
+
+
+def literal_value(node: ast.AST):
+    """Evaluate compile-time literals: ints, floats, strings, bools, None,
+    and tuples/lists of them. Unresolvable leaves inside a tuple become
+    ``None`` elements (rank survives, value doesn't); anything else returns
+    ``NOT_CONST``."""
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            v = literal_value(e)
+            out.append(None if v is NOT_CONST else v)
+        return tuple(out)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = literal_value(node.operand)
+        if isinstance(v, (int, float)):
+            return -v
+        return NOT_CONST
+    return NOT_CONST
+
+
+class ConstEnv:
+    """Best-effort integer/tuple constant environment: module-level literal
+    assignments plus (optionally) straight-line function-local assignments.
+    ``eval_node`` returns an int/float/str/tuple or None when unknown."""
+
+    def __init__(self, mod: ModuleInfo, local: Optional[dict] = None):
+        self.mod = mod
+        self.env: dict = dict(mod.consts)
+        if local:
+            self.env.update(local)
+
+    @classmethod
+    def for_function(cls, mod: ModuleInfo, fn: ast.AST) -> "ConstEnv":
+        ce = cls(mod)
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                    isinstance(stmt.targets[0], ast.Name):
+                v = ce.eval_node(stmt.value)
+                name = stmt.targets[0].id
+                # later unknown assignment shadows an earlier known one
+                ce.env[name] = v
+        return ce
+
+    def eval_node(self, node: ast.AST):
+        if node is None:
+            return None
+        if isinstance(node, ast.Constant):
+            return node.value
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return tuple(self.eval_node(e) for e in node.elts)
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+            v = self.eval_node(node.operand)
+            return -v if isinstance(v, (int, float)) else None
+        if isinstance(node, ast.BinOp):
+            lhs = self.eval_node(node.left)
+            rhs = self.eval_node(node.right)
+            if not isinstance(lhs, (int, float)) or \
+                    not isinstance(rhs, (int, float)):
+                return None
+            try:
+                if isinstance(node.op, ast.Add):
+                    return lhs + rhs
+                if isinstance(node.op, ast.Sub):
+                    return lhs - rhs
+                if isinstance(node.op, ast.Mult):
+                    return lhs * rhs
+                if isinstance(node.op, ast.FloorDiv):
+                    return lhs // rhs
+                if isinstance(node.op, ast.Mod):
+                    return lhs % rhs
+                if isinstance(node.op, ast.LShift):
+                    return lhs << rhs
+                if isinstance(node.op, ast.RShift):
+                    return lhs >> rhs
+                if isinstance(node.op, ast.Pow):
+                    return lhs ** rhs
+            except Exception:
+                return None
+        return None
+
+
+def dtype_name(node: ast.AST, mod: ModuleInfo) -> Optional[str]:
+    """"jnp.int32" / "np.float32" / '"uint32"' -> canonical dtype string."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    fqn = resolve_fqn(node, mod)
+    if fqn is None:
+        return None
+    for head in _DTYPE_HEADS:
+        if fqn.startswith(head):
+            return fqn[len(head):]
+    return None
+
+
+def own_nodes(fn_node: ast.AST):
+    """Yield every descendant of a function node that belongs to the
+    function's own scope — nested function/class definitions are not
+    entered (they are analyzed as their own scopes). Lambdas ARE entered:
+    they trace with their parent."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+#: dtype -> required sublane multiple on TPU (second-to-last block dim)
+DTYPE_SUBLANE = {
+    "float32": 8, "int32": 8, "uint32": 8,
+    "bfloat16": 16, "float16": 16, "int16": 16, "uint16": 16,
+    "int8": 32, "uint8": 32, "float8_e4m3fn": 32, "float8_e5m2": 32,
+    "bool": 8, "bool_": 8,
+}

@@ -1,0 +1,92 @@
+"""Core data model for hglint findings."""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+#: severity ordering for sorting/filtering
+SEVERITIES = ("error", "warning", "info")
+
+#: one-line summaries, keyed by rule id (also serves as the rule registry)
+RULES = {
+    # -- family 1: host sync inside traced code ------------------------------
+    "HG101": "`.item()` forces a device->host sync inside traced code",
+    "HG102": "float()/int()/bool() on a traced value concretizes it on host",
+    "HG103": "numpy call inside traced code materializes a host value",
+    "HG104": "jax.device_get inside traced code is a blocking transfer",
+    "HG105": "block_until_ready inside traced code defeats async dispatch",
+    # -- family 2: retrace hazards -------------------------------------------
+    "HG201": "jax.jit(...) constructed inside a loop retraces every iteration",
+    "HG202": "Python branch on a traced parameter (shape-independent control "
+             "flow must use lax.cond/select)",
+    "HG203": "traced function captures a mutable module-level global",
+    "HG204": "static_argnums/static_argnames given a non-hashable value",
+    # -- family 3: Pallas kernel contracts -----------------------------------
+    "HG301": "Pallas block shape is not a multiple of the (8,128) TPU tile",
+    "HG302": "Pallas index_map arity/rank/bounds disagree with grid/block",
+    "HG303": "Pallas block sublane count violates the dtype tiling rule",
+    "HG304": "Pallas kernel writes a dtype that disagrees with out_shape",
+    # -- family 4: lock order -------------------------------------------------
+    "HG401": "lock acquisition cycle (potential deadlock)",
+    "HG402": "shared attribute mutated outside the instance lock",
+}
+
+#: rule id -> default severity
+RULE_SEVERITY = {
+    "HG101": "error",
+    "HG102": "warning",
+    "HG103": "error",
+    "HG104": "error",
+    "HG105": "error",
+    "HG201": "warning",
+    "HG202": "warning",
+    "HG203": "warning",
+    "HG204": "warning",
+    "HG301": "error",
+    "HG302": "error",
+    "HG303": "error",
+    "HG304": "error",
+    "HG401": "error",
+    "HG402": "warning",
+}
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # repo-relative (or as-given) file path
+    line: int
+    message: str
+    scope: str = "<module>"   # enclosing function qualname — baseline key part
+    severity: str = field(default="")
+
+    def __post_init__(self):
+        if not self.severity:
+            object.__setattr__(
+                self, "severity", RULE_SEVERITY.get(self.rule, "warning")
+            )
+
+    @property
+    def baseline_key(self) -> str:
+        """Line-number-free key so baselines survive unrelated edits."""
+        return f"{self.rule}:{_norm(self.path)}:{self.scope}"
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line} {self.rule} {self.severity}: "
+            f"{self.message}"
+        )
+
+
+def _norm(path: str) -> str:
+    return path.replace(os.sep, "/")
+
+
+def sort_findings(findings):
+    sev_rank = {s: i for i, s in enumerate(SEVERITIES)}
+    return sorted(
+        findings,
+        key=lambda f: (_norm(f.path), f.line, sev_rank.get(f.severity, 9),
+                       f.rule),
+    )

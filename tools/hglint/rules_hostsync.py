@@ -1,0 +1,115 @@
+"""HG1xx — host-sync calls reachable from traced (jit/pjit/shard_map/
+pallas_call) code.
+
+Every rule here fires only inside functions the taint pass marked as
+traced; host-side wrappers may sync freely (that is where syncs belong).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.hglint.callgraph import CallGraph
+from tools.hglint.loader import own_nodes, resolve_fqn
+from tools.hglint.model import Finding
+
+SHAPE_ATTRS = {"shape", "ndim", "size", "dtype"}
+
+#: fqns that are host syncs no matter how they are spelled
+_DEVICE_GET = {"jax.device_get"}
+_BLOCK_READY = {"jax.block_until_ready"}
+
+#: numpy prefixes — a call into numpy inside traced code materializes host
+#: data (np.asarray, np.array, np.nonzero, ...)
+_NUMPY_HEADS = ("numpy.",)
+
+
+def check(cg: CallGraph) -> list:
+    findings = []
+    for fi in cg.traced_functions():
+        root = cg.traced[fi.key]
+        via = "" if root == fi.key else f" (traced via {_short(root)})"
+        for node in own_nodes(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            # -- .item() -----------------------------------------------------
+            if isinstance(func, ast.Attribute) and func.attr == "item" \
+                    and not node.args and not node.keywords:
+                findings.append(_f("HG101", fi, node,
+                                   f"`.item()` in traced code{via}"))
+                continue
+            # -- .block_until_ready() / jax.block_until_ready(x) ------------
+            if isinstance(func, ast.Attribute) \
+                    and func.attr == "block_until_ready":
+                fqn = resolve_fqn(func, fi.mod)
+                msg = (f"`{fqn or 'block_until_ready'}` in traced "
+                       f"code{via}")
+                findings.append(_f("HG105", fi, node, msg))
+                continue
+            fqn = resolve_fqn(func, fi.mod)
+            if fqn is None:
+                continue
+            if fqn in _BLOCK_READY:
+                findings.append(_f("HG105", fi, node,
+                                   f"`jax.block_until_ready` in traced "
+                                   f"code{via}"))
+            elif fqn in _DEVICE_GET:
+                findings.append(_f("HG104", fi, node,
+                                   f"`jax.device_get` in traced code{via}"))
+            elif fqn.startswith(_NUMPY_HEADS):
+                findings.append(_f("HG103", fi, node,
+                                   f"`{_np_spelling(func)}` call in traced "
+                                   f"code{via} — use jnp or hoist to host"))
+            elif fqn in ("float", "int", "bool") and len(node.args) == 1 \
+                    and not node.keywords:
+                if not _shape_derived(node.args[0], fi):
+                    findings.append(_f(
+                        "HG102", fi, node,
+                        f"`{fqn}()` on a possibly-traced value{via} — "
+                        f"concretizes under trace",
+                    ))
+    return findings
+
+
+def _np_spelling(func: ast.AST) -> str:
+    try:
+        return ast.unparse(func)
+    except Exception:  # pragma: no cover
+        return "numpy call"
+
+
+def _shape_derived(expr: ast.AST, fi) -> bool:
+    """True when the cast argument is statically concrete under tracing:
+    literals, len(...), shape/ndim/size attributes, static params, or
+    arithmetic thereof."""
+    if isinstance(expr, ast.Constant):
+        return True
+    if isinstance(expr, ast.Attribute) and expr.attr in SHAPE_ATTRS:
+        return True
+    if isinstance(expr, ast.Subscript):
+        # x.shape[0]
+        return _shape_derived(expr.value, fi)
+    if isinstance(expr, ast.Call):
+        fn = expr.func
+        if isinstance(fn, ast.Name) and fn.id == "len":
+            return True
+        return False
+    if isinstance(expr, ast.Name):
+        return expr.id in fi.static_params
+    if isinstance(expr, ast.BinOp):
+        return _shape_derived(expr.left, fi) and \
+            _shape_derived(expr.right, fi)
+    if isinstance(expr, ast.UnaryOp):
+        return _shape_derived(expr.operand, fi)
+    return False
+
+
+def _short(key: str) -> str:
+    return key.rsplit(".", 1)[-1] if "." in key else key
+
+
+def _f(rule: str, fi, node: ast.AST, msg: str) -> Finding:
+    return Finding(rule=rule, path=fi.mod.path,
+                   line=getattr(node, "lineno", fi.lineno),
+                   message=msg, scope=fi.qualpath)

@@ -1,0 +1,341 @@
+"""HG4xx — lock-order and unlocked-shared-state analysis.
+
+Lock identities are the *attribute slots* locks are stored in
+(``module.Class.attr`` for ``self.attr = threading.Lock()``, ``module.name``
+for module-level locks). The acquire graph has an edge A -> B when code
+acquires B (directly, or transitively through a call) while holding A.
+
+HG401  a cycle in the acquire graph (two lock orders that can deadlock),
+       including re-entrant acquisition of a non-reentrant ``Lock``.
+HG402  a method of a lock-owning class assigns ``self.<attr>`` outside any
+       ``with <lock>`` block (methods named ``*_locked`` and constructors
+       are exempt — they document the caller-holds-the-lock contract).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tools.hglint.callgraph import CallGraph, CallSite
+from tools.hglint.loader import ModuleInfo, resolve_fqn
+from tools.hglint.model import Finding
+
+LOCK_CTORS = {"threading.Lock", "threading.RLock",
+              "multiprocessing.Lock", "multiprocessing.RLock"}
+EXEMPT_METHODS = {"__init__", "__new__", "__enter__", "__exit__", "__del__",
+                  "__post_init__"}
+
+
+@dataclass
+class LockRegistry:
+    kinds: dict = field(default_factory=dict)      # lock id -> "Lock"|"RLock"
+    class_attrs: dict = field(default_factory=dict)  # "mod.Cls" -> {attr}
+    sites: dict = field(default_factory=dict)      # lock id -> (path, line)
+
+
+def check(cg: CallGraph, modules: list) -> list:
+    reg = _collect_locks(modules)
+    if not reg.kinds:
+        return []
+    acquires, edges = _acquire_analysis(cg, reg)
+    findings = _cycles(edges, reg)
+    findings += _unlocked_mutations(cg, reg)
+    return findings
+
+
+# -------------------------------------------------------------- lock registry
+
+
+def _collect_locks(modules: list) -> LockRegistry:
+    reg = LockRegistry()
+
+    def record(lock_id: str, ctor_fqn: str, mod: ModuleInfo, node):
+        reg.kinds[lock_id] = ctor_fqn.rsplit(".", 1)[-1]
+        reg.sites[lock_id] = (mod.path, node.lineno)
+
+    for mod in modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Assign) or \
+                    not isinstance(node.value, ast.Call):
+                continue
+            fqn = resolve_fqn(node.value.func, mod)
+            if fqn not in LOCK_CTORS:
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    record(f"{mod.name}.{tgt.id}", fqn, mod, node)
+                elif isinstance(tgt, ast.Attribute) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id == "self":
+                    cls = _enclosing_class(mod, node)
+                    if cls:
+                        lock_id = f"{mod.name}.{cls}.{tgt.attr}"
+                        record(lock_id, fqn, mod, node)
+                        reg.class_attrs.setdefault(
+                            f"{mod.name}.{cls}", set()
+                        ).add(tgt.attr)
+    return reg
+
+
+def _enclosing_class(mod: ModuleInfo, target: ast.AST) -> Optional[str]:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef):
+            if any(n is target for n in ast.walk(node)):
+                return node.name
+    return None
+
+
+def _resolve_lock(expr: ast.AST, fi, reg: LockRegistry) -> Optional[str]:
+    """Map a ``with``-item / ``.acquire()`` receiver to a lock id."""
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+            and fi is not None and fi.cls_name:
+        cand = f"{fi.mod.name}.{fi.cls_name}.{expr.attr}"
+        if cand in reg.kinds:
+            return cand
+    if isinstance(expr, ast.Name) and fi is not None:
+        cand = f"{fi.mod.name}.{expr.id}"
+        if cand in reg.kinds:
+            return cand
+    fqn = resolve_fqn(expr, fi.mod) if fi is not None else None
+    if fqn in reg.kinds:
+        return fqn
+    return None
+
+
+# ---------------------------------------------------------- acquire analysis
+
+
+def _acquire_analysis(cg: CallGraph, reg: LockRegistry):
+    """Per-function direct acquires + held-call records, then a transitive
+    fixpoint over the call graph to produce lock-order edges."""
+    direct: dict[str, set] = {}          # fn key -> lock ids acquired
+    held_calls: dict[str, list] = {}     # fn key -> [(lock, callee, site)]
+    held_acquires: dict[str, list] = {}  # fn key -> [(lock, lock2, site)]
+
+    for key, fi in cg.functions.items():
+        d: set = set()
+        hc: list = []
+        ha: list = []
+        _scan_body(cg, fi, fi.node, [], d, hc, ha, reg)
+        direct[key] = d
+        held_calls[key] = hc
+        held_acquires[key] = ha
+
+    # transitive acquires: T(f) = direct(f) U union T(callee)
+    trans = {k: set(v) for k, v in direct.items()}
+    changed = True
+    while changed:
+        changed = False
+        for key in trans:
+            for callee in cg.edges.get(key, ()):
+                tc = trans.get(callee)
+                if tc and not tc <= trans[key]:
+                    trans[key] |= tc
+                    changed = True
+
+    edges: dict[tuple, tuple] = {}   # (A, B) -> (path, line, via)
+    for key in cg.functions:
+        for lock, other, site in held_acquires[key]:
+            edges.setdefault((lock, other), site + (None,))
+        for lock, callee, site in held_calls[key]:
+            for other in trans.get(callee, ()):
+                edges.setdefault((lock, other), site + (callee,))
+    return trans, edges
+
+
+def _lock_method_stmt(stmt: ast.AST, fi, reg, method: str):
+    """``X.acquire()`` / ``X.release()`` as a bare statement -> lock id."""
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call) and \
+            isinstance(stmt.value.func, ast.Attribute) and \
+            stmt.value.func.attr == method:
+        return _resolve_lock(stmt.value.func.value, fi, reg)
+    return None
+
+
+def _scan_stmts(cg, fi, stmts, held, direct, held_calls, held_acquires,
+                reg):
+    """Scan a statement list in order, tracking holds from BOTH ``with``
+    blocks and bare ``X.acquire()`` statements (held until a matching
+    ``X.release()`` in the same list, else to the end of it — the
+    acquire/try/finally-release idiom over-approximates safely)."""
+    cur = list(held)
+    for stmt in stmts:
+        lock = _lock_method_stmt(stmt, fi, reg, "acquire")
+        if lock is not None:
+            direct.add(lock)
+            site = (fi.mod.path, stmt.lineno)
+            for h in cur:
+                held_acquires.append((h, lock, site))
+            cur.append(lock)
+            continue
+        lock = _lock_method_stmt(stmt, fi, reg, "release")
+        if lock is not None:
+            if lock in cur:
+                cur.remove(lock)
+            continue
+        _scan_body(cg, fi, stmt, cur, direct, held_calls, held_acquires,
+                   reg)
+
+
+def _scan_body(cg, fi, node, held, direct, held_calls, held_acquires, reg):
+    """Walk a function body tracking the held-lock stack. ``node`` itself is
+    examined (so directly nested With/Call statements are seen), then its
+    children; nested defs are skipped (they run later, not under the
+    current hold)."""
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef, ast.Lambda)) and node is not fi.node:
+        return
+    if isinstance(node, ast.With):
+        got = []
+        for item in node.items:
+            # only Name/Attribute contexts can be lock slots; calls
+            # (``with open(...)``) resolve to None naturally
+            lock = _resolve_lock(item.context_expr, fi, reg)
+            if lock is not None:
+                direct.add(lock)
+                site = (fi.mod.path, node.lineno)
+                for h in held:
+                    held_acquires.append((h, lock, site))
+                got.append(lock)
+        _scan_stmts(cg, fi, node.body, held + got, direct, held_calls,
+                    held_acquires, reg)
+        return
+    if isinstance(node, ast.Call):
+        # non-statement .acquire() (e.g. ``if lk.acquire(timeout=..)``):
+        # still an acquire event, though no hold scope can be inferred
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "acquire":
+            lock = _resolve_lock(node.func.value, fi, reg)
+            if lock is not None:
+                direct.add(lock)
+                site = (fi.mod.path, node.lineno)
+                for h in held:
+                    held_acquires.append((h, lock, site))
+        elif held:
+            site_obj = CallSite(node=node, fn_key=fi.key, mod=fi.mod)
+            callee = cg.resolve_callable(node.func, site_obj)
+            if callee is not None:
+                site = (fi.mod.path, node.lineno)
+                for h in held:
+                    held_calls.append((h, callee, site))
+    # statement lists of compound statements scan sequentially so bare
+    # acquire/release pairs bound their holds; everything else recurses
+    handled = set()
+    for attr in ("body", "orelse", "finalbody"):
+        stmts = getattr(node, attr, None)
+        if isinstance(stmts, list) and stmts and \
+                isinstance(stmts[0], ast.stmt):
+            _scan_stmts(cg, fi, stmts, held, direct, held_calls,
+                        held_acquires, reg)
+            handled.update(id(s) for s in stmts)
+    for h in getattr(node, "handlers", ()) or ():
+        _scan_stmts(cg, fi, h.body, held, direct, held_calls,
+                    held_acquires, reg)
+        handled.update(id(s) for s in h.body)
+    for child in ast.iter_child_nodes(node):
+        if id(child) in handled or isinstance(child, ast.ExceptHandler):
+            continue
+        _scan_body(cg, fi, child, held, direct, held_calls, held_acquires,
+                   reg)
+
+
+# ------------------------------------------------------------------- HG401
+
+
+def _cycles(edges: dict, reg: LockRegistry) -> list:
+    graph: dict[str, set] = {}
+    for (a, b) in edges:
+        if a == b:
+            continue  # self-edges handled below
+        graph.setdefault(a, set()).add(b)
+
+    findings = []
+    seen_cycles = set()
+
+    # self-edges: re-acquiring a non-reentrant Lock deadlocks immediately
+    for (a, b), (path, line, via) in sorted(edges.items()):
+        if a == b and reg.kinds.get(a) == "Lock":
+            findings.append(Finding(
+                rule="HG401", path=path, line=line, scope=a,
+                message=f"non-reentrant Lock `{a}` re-acquired while "
+                        f"already held"
+                        + (f" (via call to {via})" if via else ""),
+            ))
+
+    def dfs(start, node, path_nodes):
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start:
+                cyc = tuple(sorted(path_nodes))
+                if cyc in seen_cycles:
+                    continue
+                seen_cycles.add(cyc)
+                first_edge = (start, path_nodes[1]) if len(path_nodes) > 1 \
+                    else (start, start)
+                site = edges.get(first_edge) or next(iter(edges.values()))
+                order = " -> ".join(path_nodes + [start])
+                findings.append(Finding(
+                    rule="HG401", path=site[0], line=site[1], scope=start,
+                    message=f"lock acquisition cycle: {order}",
+                ))
+            elif nxt not in path_nodes and len(path_nodes) < 8:
+                dfs(start, nxt, path_nodes + [nxt])
+
+    for start in sorted(graph):
+        dfs(start, start, [start])
+    return findings
+
+
+# ------------------------------------------------------------------- HG402
+
+
+def _unlocked_mutations(cg: CallGraph, reg: LockRegistry) -> list:
+    findings = []
+    for key, fi in cg.functions.items():
+        if fi.cls_name is None:
+            continue
+        cls_key = f"{fi.mod.name}.{fi.cls_name}"
+        lock_attrs = reg.class_attrs.get(cls_key)
+        if not lock_attrs:
+            continue
+        method = fi.qualpath.rsplit(".", 1)[-1]
+        if method in EXEMPT_METHODS or method.endswith("_locked"):
+            continue
+        hits: list = []
+        _scan_mutations(fi, fi.node, False, lock_attrs, reg, hits)
+        for attr, line in hits:
+            findings.append(Finding(
+                rule="HG402", path=fi.mod.path, line=line,
+                scope=fi.qualpath,
+                message=f"`self.{attr}` assigned outside `with "
+                        f"self.{sorted(lock_attrs)[0]}` in a lock-owning "
+                        f"class",
+            ))
+    return findings
+
+
+def _scan_mutations(fi, node, locked, lock_attrs, reg, hits):
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef, ast.Lambda)) and node is not fi.node:
+        return
+    if isinstance(node, ast.With):
+        now_locked = locked or any(
+            _resolve_lock(item.context_expr, fi, reg) is not None
+            for item in node.items
+        )
+        for stmt in node.body:
+            _scan_mutations(fi, stmt, now_locked, lock_attrs, reg, hits)
+        return
+    if not locked and isinstance(node, (ast.Assign, ast.AugAssign)):
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for tgt in targets:
+            if isinstance(tgt, ast.Attribute) and \
+                    isinstance(tgt.value, ast.Name) and \
+                    tgt.value.id == "self" and \
+                    tgt.attr not in lock_attrs:
+                hits.append((tgt.attr, tgt.lineno))
+    for child in ast.iter_child_nodes(node):
+        _scan_mutations(fi, child, locked, lock_attrs, reg, hits)

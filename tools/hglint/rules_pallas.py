@@ -1,0 +1,321 @@
+"""HG3xx — static contracts for ``pl.pallas_call`` sites.
+
+Checked per call site, from literals and best-effort constant folding only
+(unresolvable values are skipped, never guessed):
+
+HG301  block shapes: last dim % 128, second-to-last dim % 8 (== 1 allowed
+       — Mosaic accepts singleton sublane blocks when the dim is full).
+HG302  index_map contracts: lambda arity == grid rank (+ scalar-prefetch
+       operands), returned tuple rank == block rank, and — when grid,
+       block, and array dims all fold to ints — the mapped block stays in
+       bounds.
+HG303  dtype-dependent sublane tiling: 16-bit dtypes need sublane % 16,
+       8-bit need % 32 (checked on out_specs, where out_shape names the
+       dtype).
+HG304  kernel writes to an output ref with an explicit dtype that differs
+       from the declared out_shape dtype.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tools.hglint.callgraph import PALLAS_FQNS, CallGraph, CallSite, \
+    _unwrap_partial
+from tools.hglint.loader import (
+    DTYPE_SUBLANE,
+    ConstEnv,
+    ModuleInfo,
+    dtype_name,
+    resolve_fqn,
+)
+from tools.hglint.model import Finding
+
+LANE = 128
+SUBLANE = 8
+
+
+def check(cg: CallGraph, modules: list) -> list:
+    findings = []
+    for site in cg.calls:
+        fqn = resolve_fqn(site.node.func, site.mod)
+        if fqn not in PALLAS_FQNS:
+            continue
+        findings += _check_call(cg, site)
+    return findings
+
+
+# ----------------------------------------------------------------- per call
+
+
+def _check_call(cg: CallGraph, site: CallSite) -> list:
+    call, mod = site.node, site.mod
+    fi = cg.functions.get(site.fn_key) if site.fn_key else None
+    env = ConstEnv.for_function(mod, fi.node) if fi else ConstEnv(mod)
+    scope = fi.qualpath if fi else "<module>"
+
+    kw = {k.arg: k.value for k in call.keywords if k.arg}
+    n_scalar = 0
+    grid_node = kw.get("grid")
+    in_specs = kw.get("in_specs")
+    out_specs = kw.get("out_specs")
+    gs = kw.get("grid_spec")
+    if isinstance(gs, ast.Call):
+        gkw = {k.arg: k.value for k in gs.keywords if k.arg}
+        grid_node = gkw.get("grid", grid_node)
+        in_specs = gkw.get("in_specs", in_specs)
+        out_specs = gkw.get("out_specs", out_specs)
+        v = env.eval_node(gkw.get("num_scalar_prefetch"))
+        if isinstance(v, int):
+            n_scalar = v
+
+    grid = env.eval_node(grid_node)
+    if isinstance(grid, int):
+        grid = (grid,)
+    grid_rank = len(grid) if isinstance(grid, tuple) else None
+
+    out_shape_dims, out_dtype = _parse_out_shape(kw.get("out_shape"), env, mod)
+
+    findings = []
+    specs = []
+    for spec, is_out in _iter_specs(in_specs, out_specs):
+        specs.append((spec, is_out))
+        findings += _check_spec(
+            spec, is_out, env, mod, scope, grid, grid_rank, n_scalar,
+            out_shape_dims, out_dtype,
+        )
+    findings += _check_kernel_dtype(
+        cg, site, env, scope, n_scalar, in_specs, out_specs, out_dtype
+    )
+    return findings
+
+
+def _iter_specs(in_specs, out_specs):
+    if isinstance(in_specs, (ast.List, ast.Tuple)):
+        for e in in_specs.elts:
+            yield e, False
+    elif isinstance(in_specs, ast.Call):
+        yield in_specs, False
+    if isinstance(out_specs, (ast.List, ast.Tuple)):
+        for e in out_specs.elts:
+            yield e, True
+    elif isinstance(out_specs, ast.Call):
+        yield out_specs, True
+
+
+def _parse_out_shape(node, env: ConstEnv, mod: ModuleInfo):
+    """``jax.ShapeDtypeStruct(shape, dtype)`` -> (dims tuple | None, dtype
+    name | None)."""
+    if not isinstance(node, ast.Call):
+        return None, None
+    fqn = resolve_fqn(node.func, mod) or ""
+    if not fqn.endswith("ShapeDtypeStruct"):
+        return None, None
+    dims = env.eval_node(node.args[0]) if node.args else None
+    if not isinstance(dims, tuple):
+        dims = None
+    dt = None
+    if len(node.args) > 1:
+        dt = dtype_name(node.args[1], mod)
+    for k in node.keywords:
+        if k.arg == "dtype":
+            dt = dtype_name(k.value, mod)
+        elif k.arg == "shape":
+            d = env.eval_node(k.value)
+            dims = d if isinstance(d, tuple) else dims
+    return dims, dt
+
+
+# ------------------------------------------------------------- spec checks
+
+
+def _check_spec(spec, is_out, env, mod, scope, grid, grid_rank, n_scalar,
+                out_shape_dims, out_dtype) -> list:
+    if not isinstance(spec, ast.Call):
+        return []
+    fqn = resolve_fqn(spec.func, mod) or ""
+    if not fqn.endswith("BlockSpec"):
+        return []
+    block_node = spec.args[0] if spec.args else None
+    index_map = spec.args[1] if len(spec.args) > 1 else None
+    for k in spec.keywords:
+        if k.arg == "block_shape":
+            block_node = k.value
+        elif k.arg == "index_map":
+            index_map = k.value
+    if block_node is None or isinstance(block_node, ast.keyword):
+        return []
+    block = env.eval_node(block_node)
+    if not isinstance(block, tuple):
+        return []
+    findings = []
+    which = "out_specs" if is_out else "in_specs"
+
+    # -- HG301 / HG303: tile alignment --------------------------------------
+    if len(block) >= 2:
+        last, sub = block[-1], block[-2]
+        if isinstance(last, int) and last % LANE:
+            findings.append(_f("HG301", mod, block_node, scope,
+                               f"{which} block lane dim {last} is not a "
+                               f"multiple of {LANE}"))
+        if isinstance(sub, int) and sub != 1 and sub % SUBLANE:
+            findings.append(_f("HG301", mod, block_node, scope,
+                               f"{which} block sublane dim {sub} is not a "
+                               f"multiple of {SUBLANE}"))
+        req = DTYPE_SUBLANE.get(out_dtype or "", SUBLANE) if is_out \
+            else SUBLANE
+        if req > SUBLANE and isinstance(sub, int) and sub != 1 \
+                and sub % SUBLANE == 0 and sub % req:
+            findings.append(_f("HG303", mod, block_node, scope,
+                               f"{which} block sublane dim {sub} must be a "
+                               f"multiple of {req} for dtype {out_dtype}"))
+
+    # -- HG302: index_map contracts -----------------------------------------
+    if isinstance(index_map, ast.Lambda):
+        params = [a.arg for a in index_map.args.args]
+        if grid_rank is not None and len(params) != grid_rank + n_scalar:
+            findings.append(_f(
+                "HG302", mod, index_map, scope,
+                f"{which} index_map takes {len(params)} args but the grid "
+                f"has rank {grid_rank}"
+                + (f" (+{n_scalar} scalar-prefetch)" if n_scalar else ""),
+            ))
+        ret = index_map.body
+        ret_elts = list(ret.elts) if isinstance(ret, ast.Tuple) else [ret]
+        if len(ret_elts) != len(block):
+            findings.append(_f(
+                "HG302", mod, index_map, scope,
+                f"{which} index_map returns {len(ret_elts)} indices for a "
+                f"rank-{len(block)} block",
+            ))
+        elif is_out and out_shape_dims is not None \
+                and isinstance(grid, tuple):
+            findings += _bounds_check(
+                ret_elts, params, grid, grid_rank, block, out_shape_dims,
+                env, mod, index_map, scope, which,
+            )
+    return findings
+
+
+def _bounds_check(ret_elts, params, grid, grid_rank, block, dims, env, mod,
+                  where, scope, which) -> list:
+    """Affine bound check: for return element a*g + b over grid var g with
+    everything integer-resolvable, require (max_index + 1) * block_dim <=
+    array_dim."""
+    findings = []
+    for d, (elt, bdim) in enumerate(zip(ret_elts, block)):
+        if d >= len(dims):
+            break
+        adim = dims[d]
+        if not isinstance(adim, int) or not isinstance(bdim, int):
+            continue
+        max_idx = _affine_max(elt, params, grid, grid_rank, env)
+        if max_idx is None:
+            continue
+        if (max_idx + 1) * bdim > adim:
+            findings.append(_f(
+                "HG302", mod, where, scope,
+                f"{which} index_map dim {d} reaches block index {max_idx} "
+                f"-> elements up to {(max_idx + 1) * bdim} > array dim "
+                f"{adim} (out of bounds for the declared grid)",
+            ))
+    return findings
+
+
+def _affine_max(elt, params, grid, grid_rank, env) -> Optional[int]:
+    """Max value of an index expression over the grid, for constants,
+    bare grid vars, and +/-/* combinations thereof. None when unknown."""
+    if isinstance(elt, ast.Constant):
+        return elt.value if isinstance(elt.value, int) else None
+    if isinstance(elt, ast.Name):
+        if elt.id in params:
+            pos = params.index(elt.id)
+            if grid_rank is not None and pos < grid_rank and \
+                    isinstance(grid[pos], int):
+                return grid[pos] - 1
+            return None
+        v = env.eval_node(elt)
+        return v if isinstance(v, int) else None
+    if isinstance(elt, ast.BinOp) and isinstance(
+            elt.op, (ast.Add, ast.Sub, ast.Mult)):
+        lhs = _affine_max(elt.left, params, grid, grid_rank, env)
+        rhs = _affine_max(elt.right, params, grid, grid_rank, env)
+        if lhs is None or rhs is None:
+            return None
+        # monotone in both operands for non-negative index arithmetic
+        if isinstance(elt.op, ast.Add):
+            return lhs + rhs
+        if isinstance(elt.op, ast.Sub):
+            return lhs - 0 if rhs == 0 else None  # conservative
+        return lhs * rhs
+    return None
+
+
+# ------------------------------------------------------------------ HG304
+
+
+def _check_kernel_dtype(cg, site, env, scope, n_scalar, in_specs, out_specs,
+                        out_dtype) -> list:
+    if out_dtype is None:
+        return []
+    n_in = _spec_count(in_specs)
+    n_out = _spec_count(out_specs)
+    if n_in is None or n_out != 1:
+        return []
+    kernel_expr = _unwrap_partial(site.node.args[0], site.mod) \
+        if site.node.args else None
+    if kernel_expr is None:
+        return []
+    key = cg.resolve_callable(kernel_expr, site)
+    if key is None:
+        return []
+    kfi = cg.functions[key]
+    out_pos = n_scalar + n_in
+    if out_pos >= len(kfi.params):
+        return []
+    out_param = kfi.params[out_pos]
+    findings = []
+    for node in ast.walk(kfi.node):
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Subscript) and \
+                    isinstance(tgt.value, ast.Name) and \
+                    tgt.value.id == out_param:
+                written = _written_dtype(node.value, kfi.mod)
+                if written is not None and written != out_dtype:
+                    findings.append(_f(
+                        "HG304", kfi.mod, node, kfi.qualpath,
+                        f"kernel writes dtype {written} to `{out_param}` "
+                        f"but out_shape declares {out_dtype}",
+                    ))
+    return findings
+
+
+def _spec_count(specs) -> Optional[int]:
+    if isinstance(specs, (ast.List, ast.Tuple)):
+        return len(specs.elts)
+    if isinstance(specs, ast.Call):
+        return 1
+    return None
+
+
+def _written_dtype(value: ast.AST, mod: ModuleInfo) -> Optional[str]:
+    """Explicit dtype evidence in the written expression: a top-level
+    ``.astype(d)`` or a constructor with ``dtype=d``. Deliberately shallow
+    — only the outermost expression counts, so mixed-arithmetic interiors
+    don't mislead."""
+    if isinstance(value, ast.Call):
+        if isinstance(value.func, ast.Attribute) and \
+                value.func.attr in ("astype", "view") and value.args:
+            return dtype_name(value.args[0], mod)
+        for k in value.keywords:
+            if k.arg == "dtype":
+                return dtype_name(k.value, mod)
+    return None
+
+
+def _f(rule, mod, node, scope, msg) -> Finding:
+    return Finding(rule=rule, path=mod.path,
+                   line=getattr(node, "lineno", 1), message=msg, scope=scope)

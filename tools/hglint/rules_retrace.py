@@ -1,0 +1,230 @@
+"""HG2xx — retrace / recompile hazards.
+
+HG201  jax.jit(...) constructed inside a Python loop (fresh callable each
+       iteration -> full retrace per iteration).
+HG202  Python `if`/`while` on a traced (non-static) parameter of a jit
+       root — under trace this raises or bakes in one branch.
+HG203  traced function reads a mutable module-level global (dict/list/set)
+       — silently captured at trace time, later mutations are invisible.
+HG204  static_argnums/static_argnames given a non-hashable value.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.hglint.callgraph import (
+    JIT_FQNS,
+    PARTIAL_FQNS,
+    CallGraph,
+)
+from tools.hglint.loader import ModuleInfo, own_nodes, resolve_fqn
+from tools.hglint.model import Finding
+
+SHAPE_ATTRS = {"shape", "ndim", "size", "dtype"}
+
+
+def check(cg: CallGraph, modules: list) -> list:
+    findings = []
+    for mod in modules:
+        findings += _jit_in_loop(mod)
+        findings += _unhashable_static(mod)
+    for fi in cg.functions.values():
+        if fi.root_kind == "jit":
+            findings += _branch_on_traced(fi)
+    for fi in cg.traced_functions():
+        findings += _mutable_global_capture(fi)
+    return findings
+
+
+# ------------------------------------------------------------------- HG201
+
+
+def _jit_in_loop(mod: ModuleInfo) -> list:
+    findings = []
+    for loop in ast.walk(mod.tree):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        for node in _loop_own_nodes(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_jit_ctor(node, mod):
+                scope = _enclosing_scope(mod, loop)
+                findings.append(Finding(
+                    rule="HG201", path=mod.path, line=node.lineno,
+                    scope=scope,
+                    message="jax.jit(...) constructed inside a loop — hoist "
+                            "the jitted callable out of the loop",
+                ))
+    return findings
+
+
+def _loop_own_nodes(loop: ast.AST):
+    """Descendants of a loop body, not descending into nested defs (a def
+    inside the loop only traces when called)."""
+    stack = loop.body + getattr(loop, "orelse", [])
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_jit_ctor(call: ast.Call, mod: ModuleInfo) -> bool:
+    fqn = resolve_fqn(call.func, mod)
+    if fqn in JIT_FQNS:
+        return True
+    if fqn in PARTIAL_FQNS and call.args:
+        return resolve_fqn(call.args[0], mod) in JIT_FQNS
+    return False
+
+
+def _enclosing_scope(mod: ModuleInfo, target: ast.AST) -> str:
+    """qualname of the innermost def/class containing ``target``."""
+    best = "<module>"
+
+    def walk(node, qual):
+        nonlocal best
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                q = qual + [child.name]
+                if _contains(child, target):
+                    best = ".".join(q)
+                walk(child, q)
+            else:
+                walk(child, qual)
+
+    walk(mod.tree, [])
+    return best
+
+
+def _contains(root: ast.AST, target: ast.AST) -> bool:
+    return any(n is target for n in ast.walk(root))
+
+
+# ------------------------------------------------------------------- HG202
+
+
+def _branch_on_traced(fi) -> list:
+    traced_params = [p for p in fi.params if p not in fi.static_params]
+    if traced_params:
+        traced_params = set(traced_params)
+    else:
+        return []
+    findings = []
+    for node in own_nodes(fi.node):
+        if not isinstance(node, (ast.If, ast.While)):
+            continue
+        hit = _traced_name_in_test(node.test, traced_params)
+        if hit:
+            findings.append(Finding(
+                rule="HG202", path=fi.mod.path, line=node.lineno,
+                scope=fi.qualpath,
+                message=f"Python branch on traced parameter `{hit}` of jit "
+                        f"root `{fi.qualpath}` — use lax.cond/jnp.where or "
+                        f"mark it static",
+            ))
+    return findings
+
+
+def _traced_name_in_test(test: ast.AST, traced_params: set):
+    """First traced param name the branch condition concretizes, pruning
+    constructs that are static under tracing (shape/dtype access, len,
+    isinstance, `is [not] None`)."""
+    if isinstance(test, ast.Compare) and all(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+    ):
+        return None
+    if isinstance(test, ast.Attribute):
+        if test.attr in SHAPE_ATTRS:
+            return None
+        return _traced_name_in_test(test.value, traced_params)
+    if isinstance(test, ast.Call):
+        fn = test.func
+        if isinstance(fn, ast.Name) and fn.id in ("len", "isinstance",
+                                                  "hasattr", "getattr"):
+            return None
+        for sub in [fn] + list(test.args):
+            hit = _traced_name_in_test(sub, traced_params)
+            if hit:
+                return hit
+        return None
+    if isinstance(test, ast.Subscript):
+        return _traced_name_in_test(test.value, traced_params)
+    if isinstance(test, ast.Name):
+        return test.id if test.id in traced_params else None
+    for child in ast.iter_child_nodes(test):
+        hit = _traced_name_in_test(child, traced_params)
+        if hit:
+            return hit
+    return None
+
+
+# ------------------------------------------------------------------- HG203
+
+
+def _mutable_global_capture(fi) -> list:
+    mg = fi.mod.mutable_globals
+    if not mg:
+        return []
+    local_stores = set(fi.params)
+    loads: dict[str, int] = {}
+    for node in own_nodes(fi.node):
+        if isinstance(node, ast.Name):
+            if isinstance(node.ctx, ast.Store):
+                local_stores.add(node.id)
+            elif node.id in mg:
+                loads.setdefault(node.id, node.lineno)
+        elif isinstance(node, ast.Global):
+            local_stores.update(node.names)  # explicit opt-out of capture
+    findings = []
+    for name, lineno in sorted(loads.items()):
+        if name in local_stores:
+            continue
+        findings.append(Finding(
+            rule="HG203", path=fi.mod.path, line=lineno, scope=fi.qualpath,
+            message=f"traced function reads mutable module global `{name}` "
+                    f"(defined at line {mg[name]}) — captured at trace "
+                    f"time, later mutations are invisible",
+        ))
+    return findings
+
+
+# ------------------------------------------------------------------- HG204
+
+
+def _unhashable_static(mod: ModuleInfo) -> list:
+    findings = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not _is_jit_ctor(node, mod):
+            continue
+        for kw in node.keywords:
+            if kw.arg not in ("static_argnums", "static_argnames"):
+                continue
+            bad = _unhashable(kw.value)
+            if bad is not None:
+                findings.append(Finding(
+                    rule="HG204", path=mod.path, line=kw.value.lineno,
+                    scope=_enclosing_scope(mod, node),
+                    message=f"`{kw.arg}` given a non-hashable {bad} — jit "
+                            f"raises (or silently retraces) at call time",
+                ))
+    return findings
+
+
+def _unhashable(expr: ast.AST):
+    if isinstance(expr, (ast.Dict, ast.DictComp)):
+        return "dict"
+    if isinstance(expr, (ast.Set, ast.SetComp)):
+        return "set"
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        for e in expr.elts:
+            bad = _unhashable(e)
+            if bad:
+                return f"{bad} element"
+    return None

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# hglint repo gate: exits nonzero on any NEW hazard beyond the checked-in
+# baseline (tools/hglint/baseline.json). Tier-1 enforces the same check via
+# tests/test_hglint.py::test_repo_gate_passes_with_baseline.
+#
+# Usage: tools/lint.sh [extra hglint args]
+#   tools/lint.sh --severity error     # only hard errors
+#   tools/lint.sh --json               # machine-readable output
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m tools.hglint hypergraphdb_tpu \
+    --baseline tools/hglint/baseline.json "$@"
