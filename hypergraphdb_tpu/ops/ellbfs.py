@@ -202,7 +202,8 @@ def _reduce_level(
     Kw = values.shape[1]
     n_out = E // w
     if (use_pallas and Kw % 128 == 0 and E >= _pg.MIN_INDICES
-            and _pg.SEG % (_pg.G * w) == 0):
+            and _pg.SEG % (_pg.G * w) == 0
+            and _pg._vmem_bytes(w, Kw) <= _pg.VMEM_BUDGET):
         return _pg.gather_or(values, idx, w)
     if E <= chunk * w:
         g = values[idx]

@@ -236,14 +236,16 @@ class SnapshotManager:
                 # The flag (not a direct compact call) keeps lock order.
                 self._needs_recompact = True
                 return
-            if self._buffer_edges(g, h):
+            if self._buffer_edges_locked(g, h):
                 self._dead.discard(h)
                 self._delta_dirty = True
 
-    def _buffer_edges(self, g, h: int) -> bool:
+    def _buffer_edges_locked(self, g, h: int) -> bool:
         """Append atom h's incidence/target edge pairs to the memtable edge
-        buffers (caller holds the mgr lock). Returns False — and flags a
-        recompaction — when h or a target falls outside the bitmap."""
+        buffers (the ``_locked`` suffix documents the contract hglint
+        enforces: the caller holds the mgr lock). Returns False — and
+        flags a recompaction — when h or a target falls outside the
+        bitmap."""
         rec = g.store.get_link(h)
         if rec is None:
             return False
@@ -312,7 +314,7 @@ class SnapshotManager:
             self._tgt_src = []
             self._needs_recompact = False
             for h in self._new_atoms:
-                self._buffer_edges(g, h)
+                self._buffer_edges_locked(g, h)
             # removals/replaces recorded BEFORE extraction are baked into
             # the new base; later ones must survive the swap
             self._dead -= ext["dead_at_extract"]
@@ -362,10 +364,12 @@ class SnapshotManager:
                 with self._lock:
                     self._compacting = False
 
-        self._compact_thread = threading.Thread(
-            target=work, name="hgdb-compact", daemon=True
-        )
-        self._compact_thread.start()
+        t = threading.Thread(target=work, name="hgdb-compact", daemon=True)
+        with self._lock:
+            # close() joins whatever thread handle it sees; publishing the
+            # handle under the mgr lock keeps it from reading a stale None
+            self._compact_thread = t
+        t.start()
 
     def _maybe_compact(self) -> None:
         with self._lock:
@@ -415,11 +419,13 @@ class SnapshotManager:
                 )
                 stale = drift > max_lag_edges
             if stale:
-                self._refresh_device_delta(marker)
+                self._refresh_device_delta_locked(marker)
             return base.device, self._device_delta
 
-    def _refresh_device_delta(self, marker) -> None:
-        """Re-materialize the device delta (caller holds the mgr lock).
+    def _refresh_device_delta_locked(self, marker) -> None:
+        """Re-materialize the device delta (the ``_locked`` suffix
+        documents the contract hglint enforces: the caller holds the mgr
+        lock).
 
         Uploads are INCREMENTAL when possible: the edge buffers are
         append-only between compactions, so while the pad bucket is

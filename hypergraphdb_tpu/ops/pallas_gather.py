@@ -63,6 +63,19 @@ G = 256
 D = 16
 #: below this many indices the XLA gather's lower fixed cost wins
 MIN_INDICES = 1 << 15
+#: per-core VMEM budget the kernel's working set must fit (see
+#: ``_vmem_bytes``); matches hglint HG501's default budget
+VMEM_BUDGET = 16 << 20
+
+
+def _vmem_bytes(w: int, Kw: int) -> int:
+    """Static VMEM working set of one ``_call``: the (G, Kw) uint32 output
+    window double-buffered across grid steps + the (D*w, Kw) uint32 DMA
+    row scratch. ``w``/``Kw`` are runtime-chosen, so hglint HG502 cannot
+    fold this bound — this guard enforces it instead (the kernel would
+    otherwise die in Mosaic allocation with an opaque error, or only on
+    hardware while CPU interpret tests pass)."""
+    return 4 * Kw * (2 * G + D * w)
 
 
 def _kernel(idx_ref, values, out_ref, rows, sems, *, w, Kw):
@@ -111,7 +124,8 @@ def _call(seg_idx: jax.Array, values: jax.Array, w: int,
           interpret: bool) -> jax.Array:
     Kw = values.shape[1]
     n_out = seg_idx.shape[0] // w
-    return pl.pallas_call(
+    # budget enforced by gather_or's _vmem_bytes guard (runtime shapes)
+    return pl.pallas_call(  # hglint: disable=HG502
         functools.partial(_kernel, w=w, Kw=Kw),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
@@ -142,6 +156,12 @@ def gather_or(values: jax.Array, idx: jax.Array, w: int,
         # width that doesn't divide them would truncate the grid to zero
         # and return an unwritten buffer
         raise ValueError(f"gather_or: w={w} must divide SEG/G={SEG // G}")
+    if _vmem_bytes(w, Kw) > VMEM_BUDGET:
+        raise ValueError(
+            f"gather_or: VMEM working set {_vmem_bytes(w, Kw)} B "
+            f"(w={w}, Kw={Kw}) exceeds the {VMEM_BUDGET} B per-core "
+            f"budget; narrow the rows or fall back to the XLA gather"
+        )
     n_out = E // w
     # pad to whole G-chunk blocks (pad chunks gather row 0 and are sliced
     # off — chunks are independent, so garbage rows never mix in)

@@ -190,13 +190,16 @@ class TCPPeerInterface(PeerInterface):
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> None:
-        if self._thread:
-            return
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, name=f"tcp-{self.peer_id}",
-            daemon=True,
-        )
-        self._thread.start()
+        # check-and-set under the lock: two concurrent start() calls would
+        # otherwise both pass the None check and spawn two serve loops
+        with self._lock:
+            if self._thread:
+                return
+            self._thread = t = threading.Thread(
+                target=self._server.serve_forever,
+                name=f"tcp-{self.peer_id}", daemon=True,
+            )
+        t.start()
 
     def stop(self) -> None:
         self._server.shutdown()

@@ -56,7 +56,7 @@ class TypeColumn:
         offsets = np.asarray(offsets, dtype=np.int64)
         flat = np.asarray(flat, dtype=np.int64)
         with self._lock:
-            self._grow(peek)
+            self._grow_locked(peek)
             if len(ids):
                 # fill ONLY still-unknown slots: the listeners registered
                 # before this scan, so a commit landing between the locked
@@ -68,7 +68,9 @@ class TypeColumn:
                 unknown = self._col[ids] == -1
                 self._col[ids[unknown]] = vals[unknown]
 
-    def _grow(self, n: int) -> None:
+    def _grow_locked(self, n: int) -> None:
+        # the `_locked` suffix documents the contract hglint enforces:
+        # every caller already holds self._lock
         if n < len(self._col):
             return
         new = np.full(max(n + 1024, len(self._col) * 2), -1, dtype=np.int32)
@@ -79,13 +81,13 @@ class TypeColumn:
         h = int(event.handle)
         rec = g.store.get_link(h)
         with self._lock:
-            self._grow(h)
+            self._grow_locked(h)
             self._col[h] = int(rec[0]) if rec is not None else -1
 
     def _on_removed(self, g, event) -> None:
         h = int(event.handle)
         with self._lock:
-            self._grow(h)
+            self._grow_locked(h)
             self._col[h] = -1
 
     # -- reads -----------------------------------------------------------------

@@ -1,0 +1,22 @@
+"""Seeded HG107 hazards — host numpy silently uploaded in traced code."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_TABLE = np.arange(1024)
+
+
+@jax.jit
+def uses_global_table(x):
+    # HG107: a module-level host numpy array baked into the trace — a
+    # silent host->device transfer on every retrace
+    t = jnp.asarray(_TABLE)
+    return x + t
+
+
+@jax.jit
+def uses_local_numpy(x):
+    mask = np.zeros(8)       # HG103: numpy call in traced code
+    m = jnp.asarray(mask)    # HG107: ...and its upload
+    return x * m

@@ -1,0 +1,53 @@
+"""Seeded HG6xx hazards — shard_map collective inconsistencies."""
+
+import jax
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+AXIS = "data"
+
+
+def _ghost_body(x):
+    # HG601: axis 'ghost' does not exist in the ('data',) mesh
+    return jax.lax.psum(x, "ghost")
+
+
+def run_ghost(x):
+    mesh = Mesh(np.array(jax.devices()), (AXIS,))
+    return shard_map(
+        _ghost_body, mesh=mesh, in_specs=(P(AXIS),), out_specs=P(AXIS)
+    )(x)
+
+
+def _diverging_body(x):
+    d = jax.lax.axis_index(AXIS)
+    if d == 0:
+        # HG602: psum under a branch on a device value — devices taking
+        # different paths issue different collective sequences
+        x = jax.lax.psum(x, AXIS)
+    return x
+
+
+def run_diverging(x):
+    mesh = Mesh(np.array(jax.devices()), (AXIS,))
+    return shard_map(
+        _diverging_body, mesh=mesh, in_specs=(P(AXIS),), out_specs=P(AXIS)
+    )(x)
+
+
+def _mismatch_helper(x, axis):
+    # HG603: every call site passes axis='model', but the only region
+    # reaching this helper runs on a ('data',) mesh
+    return jax.lax.psum(x, axis)
+
+
+def _mismatch_body(x):
+    return _mismatch_helper(x, "model")
+
+
+def run_mismatch(x):
+    mesh = Mesh(np.array(jax.devices()), (AXIS,))
+    return shard_map(
+        _mismatch_body, mesh=mesh, in_specs=(P(AXIS),), out_specs=P(AXIS)
+    )(x)

@@ -1,0 +1,50 @@
+"""Seeded HG106 hazards — donated-buffer reuse after donate_argnums."""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _update(state, x):
+    return state + x
+
+
+def read_after_donate(state, x):
+    new = _update(state, x)
+    # HG106: state's buffer aliased into `new`; this read hits a deleted
+    # array on hardware
+    return new + state
+
+
+def _step(state, x):
+    return state * x
+
+
+apply_step = jax.jit(_step, donate_argnums=(0,))
+
+
+def loop_donate(state, xs):
+    out = None
+    for x in xs:
+        # HG106: `state` is donated on iteration 0 and re-read (re-donated)
+        # on iteration 1 — never rebound inside the loop
+        out = apply_step(state, x)
+    return out
+
+
+def branch_test_read(state, x):
+    new = _update(state, x)
+    # HG106: the branch CONDITION reads the donated buffer
+    if state.sum() > 0:
+        return new
+    return new * 2
+
+
+def iter_read(state, x):
+    new = _update(state, x)
+    acc = 0.0
+    # HG106: the loop ITERATOR reads the donated buffer
+    for row in state:
+        acc = acc + row
+    return new, acc

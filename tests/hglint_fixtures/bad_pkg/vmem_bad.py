@@ -1,0 +1,37 @@
+"""Seeded HG5xx hazards — VMEM budget violations."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _big_kernel(x_ref, o_ref):
+    o_ref[:] = x_ref[:]
+
+
+def overflow(x):
+    # HG501: (2048, 1024) f32 blocks are 8 MiB each; double-buffered in +
+    # out windows total 32 MiB against the 16 MiB per-core budget
+    return pl.pallas_call(
+        _big_kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((2048, 1024), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((2048, 1024), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((8192, 1024), jnp.float32),
+    )(x)
+
+
+def _copy(x_ref, o_ref):
+    o_ref[:] = x_ref[:]
+
+
+def unresolvable(x, rows):
+    # HG502: the block row count is a runtime argument — the budget cannot
+    # be folded and there is no pragma vouching for it
+    return pl.pallas_call(
+        _copy,
+        grid=(2,),
+        in_specs=[pl.BlockSpec((rows, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((16, 128), jnp.float32),
+    )(x)

@@ -1,0 +1,27 @@
+"""jnp.asarray on traced/literal values — HG107 must stay silent."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_HOST_TABLE = np.arange(64)
+
+
+@jax.jit
+def traced_asarray(x):
+    y = jnp.asarray(x)          # a traced value: legitimate no-op
+    z = jnp.asarray([1, 2, 3])  # a literal constant: fine
+    return y + z
+
+
+def host_upload():
+    # outside traced code a host->device transfer is exactly where it
+    # belongs
+    return jnp.asarray(_HOST_TABLE)
+
+
+@jax.jit
+def shadowed_param(_HOST_TABLE):
+    # the PARAMETER shadows the module-level numpy global: this is a
+    # traced array, not a host upload
+    return jnp.asarray(_HOST_TABLE) * 2

@@ -1,0 +1,35 @@
+"""Consistent shard_map collectives — HG6xx must stay silent."""
+
+import jax
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+AXIS = "rows"
+
+
+def _sum_helper(x, axis):
+    # axis constant-propagates to 'rows' from the single call site — in
+    # the region's mesh, so no HG603
+    return jax.lax.psum(x, axis)
+
+
+def _body(x, flag):
+    d = jax.lax.axis_index(AXIS)
+    shifted = x + d
+    total = _sum_helper(shifted, AXIS)
+    if flag:
+        # branch on a traced value is legal as long as NO collective is
+        # issued inside it — every device still runs the same sequence;
+        # axis_index is device-local (no communication), so divergent
+        # execution of it cannot deadlock either
+        shifted = shifted * jax.lax.axis_index(AXIS)
+    return total + shifted
+
+
+def run(x):
+    mesh = Mesh(np.array(jax.devices()), (AXIS,))
+    fn = shard_map(
+        _body, mesh=mesh, in_specs=(P(AXIS), P()), out_specs=P(AXIS)
+    )
+    return fn(x, 2)

@@ -1,0 +1,24 @@
+"""Donation used correctly (rebind idiom) — HG106 must stay silent."""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _update(state, x):
+    return state + x
+
+
+def rebind(state, xs):
+    for x in xs:
+        state = _update(state, x)   # rebound every iteration: safe
+    return state
+
+
+def branch_rebind(state, x, cold):
+    if cold:
+        state = _update(state, x)
+    else:
+        state = _update(state, x * 2)
+    return state

@@ -1,0 +1,45 @@
+"""Well-budgeted / hand-verified pallas_call shapes — HG5xx must stay
+silent."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+TILE_R = 8
+LANES = 128
+
+
+def _kernel(x_ref, o_ref, acc_ref):
+    acc_ref[:] = x_ref[:]
+    o_ref[:] = acc_ref[:]
+
+
+def within_budget(x):
+    # (8, 128) f32 blocks double-buffered + one scratch tile: ~20 KiB
+    return pl.pallas_call(
+        _kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((TILE_R, LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((TILE_R, LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((32, LANES), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((TILE_R, LANES), jnp.float32)],
+    )(x)
+
+
+def _copy(x_ref, o_ref):
+    o_ref[:] = x_ref[:]
+
+
+def verified_by_hand(x, rows):
+    # runtime-shaped block: unresolvable statically, but verified by hand
+    # and guarded at runtime by the caller — the pragma records that
+    return pl.pallas_call(  # hglint: disable=HG502
+        _copy,
+        grid=(2,),
+        in_specs=[pl.BlockSpec((rows, LANES), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, LANES), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((16, LANES), jnp.float32),
+    )(x)

@@ -1,33 +1,43 @@
 """hglint — AST-based JAX/TPU hazard analyzer for the hypergraphdb_tpu
 codebase.
 
-Four rule families (see ``tools.hglint.model.RULES``):
+Six rule families (see ``tools.hglint.model.RULES``):
 
-- HG1xx  host syncs reachable from traced (jit/pjit/shard_map/pallas) code
+- HG1xx  host syncs reachable from traced (jit/pjit/shard_map/pallas) code,
+         donation lifetimes (HG106), host-numpy uploads (HG107)
 - HG2xx  retrace/recompile hazards
 - HG3xx  Pallas kernel contracts ((8,128) tiling, index maps, dtypes)
 - HG4xx  lock-order cycles and unlocked shared-state mutation
+- HG5xx  static VMEM budgets per pallas_call (abstract interpretation)
+- HG6xx  shard_map collective consistency (mesh axes, divergence)
 
 Run ``python -m tools.hglint <paths>``; the repo gate is
-``tools/lint.sh`` (baseline-filtered, exits nonzero on new findings).
-Pure AST analysis: target code is never imported or executed.
+``tools/lint.sh`` (baseline-filtered, exits nonzero on new findings,
+distinct exit code on analyzer crashes). Pure AST analysis: target code
+is never imported or executed. ``# hglint: disable=HGnnn`` on a finding's
+line suppresses it (for hazards verified by hand / guarded at runtime).
 """
 
 from tools.hglint.engine import (
     apply_baseline,
     baseline_counts,
+    build_report,
+    finding_dict,
     load_baseline,
     run_lint,
     summarize,
     write_baseline,
 )
-from tools.hglint.model import RULES, Finding, sort_findings
+from tools.hglint.model import RULES, Finding, doc_anchor, sort_findings
 
 __all__ = [
     "Finding",
     "RULES",
     "apply_baseline",
     "baseline_counts",
+    "build_report",
+    "doc_anchor",
+    "finding_dict",
     "load_baseline",
     "run_lint",
     "sort_findings",

@@ -1,6 +1,8 @@
 """CLI: ``python -m tools.hglint [paths...] [--baseline FILE]``.
 
-Exit status: 0 when no (post-baseline) findings, 1 otherwise.
+Exit status: 0 no (post-baseline) findings · 1 findings · 2 usage error
+(argparse) · 3 analyzer crash. ``tools/lint.sh`` distinguishes crashes
+from findings by the >= 2 codes.
 """
 
 from __future__ import annotations
@@ -8,6 +10,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import traceback
 
 from tools.hglint import engine
 
@@ -16,7 +19,8 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="hglint",
         description="AST-based JAX/TPU hazard analyzer "
-                    "(host-sync, retrace, Pallas tiling, lock-order)",
+                    "(host-sync, retrace, Pallas tiling, lock-order, VMEM "
+                    "budgets, shard_map collectives, donation lifetimes)",
     )
     p.add_argument("paths", nargs="*", default=["hypergraphdb_tpu"],
                    help="package dirs / files to analyze "
@@ -26,45 +30,73 @@ def main(argv=None) -> int:
     p.add_argument("--write-baseline", metavar="FILE", default=None,
                    help="write current findings as the new baseline and "
                         "exit 0")
+    p.add_argument("--only", metavar="PREFIXES", default=None,
+                   help="comma-separated rule-id prefixes to run "
+                        "(e.g. 'HG5' or 'HG5,HG601') — skips other rule "
+                        "families entirely for fast local runs")
+    p.add_argument("--vmem-budget", metavar="BYTES", type=int, default=None,
+                   help="per-core VMEM budget for HG501 "
+                        "(default 16 MiB = 16777216)")
+    p.add_argument("--output", choices=("text", "json"), default="text",
+                   help="'json' emits the full machine-readable report "
+                        "(counts, findings, doc anchors) for CI")
     p.add_argument("--json", action="store_true", dest="as_json",
-                   help="emit findings as json")
+                   help="emit findings as a bare json list "
+                        "(legacy; prefer --output json)")
     p.add_argument("--severity", choices=("error", "warning", "info"),
                    default=None,
                    help="only report findings at this severity")
     args = p.parse_args(argv)
 
-    findings = engine.run_lint(args.paths)
+    try:
+        engine.parse_only(args.only)   # validate prefixes up front
+    except ValueError as e:
+        p.error(str(e))                # usage error: exit 2
 
-    if args.write_baseline:
-        engine.write_baseline(findings, args.write_baseline)
-        print(f"wrote {len(findings)} findings to {args.write_baseline}")
-        return 0
+    try:
+        findings = engine.run_lint(
+            args.paths, only=args.only, vmem_budget=args.vmem_budget
+        )
 
-    if args.baseline:
-        baseline = engine.load_baseline(args.baseline)
-        findings = engine.apply_baseline(findings, baseline)
-        label = "new finding(s) beyond baseline"
-    else:
-        label = "finding(s)"
+        if args.write_baseline:
+            engine.write_baseline(findings, args.write_baseline)
+            print(f"wrote {len(findings)} findings to "
+                  f"{args.write_baseline}")
+            return 0
 
-    if args.severity:
-        findings = [f for f in findings if f.severity == args.severity]
+        suppressed = 0
+        if args.baseline:
+            baseline = engine.load_baseline(args.baseline)
+            fresh = engine.apply_baseline(findings, baseline)
+            suppressed = len(findings) - len(fresh)
+            findings = fresh
+            label = "new finding(s) beyond baseline"
+        else:
+            label = "finding(s)"
 
-    if args.as_json:
+        if args.severity:
+            findings = [f for f in findings if f.severity == args.severity]
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        print("hglint: internal analyzer crash (exit 3) — this is a lint "
+              "bug, not a finding", file=sys.stderr)
+        return 3
+
+    if args.output == "json":
+        print(json.dumps(engine.build_report(
+            findings, args.paths, baseline_path=args.baseline,
+            suppressed=suppressed, only=args.only,
+            vmem_budget=args.vmem_budget,
+        ), indent=2))
+    elif args.as_json:
         print(json.dumps(
-            [
-                {
-                    "rule": f.rule, "severity": f.severity, "path": f.path,
-                    "line": f.line, "scope": f.scope, "message": f.message,
-                }
-                for f in findings
-            ],
-            indent=2,
+            [engine.finding_dict(f) for f in findings], indent=2,
         ))
     else:
         for f in findings:
             print(f.render())
-        print(f"hglint: {len(findings)} {label}; {engine.summarize(findings)}")
+        print(f"hglint: {len(findings)} {label}; "
+              f"{engine.summarize(findings)}")
     return 1 if findings else 0
 
 

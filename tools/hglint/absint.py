@@ -1,0 +1,568 @@
+"""Abstract interpreter for hglint's semantic rules (HG5xx/HG6xx/HG106).
+
+Propagates *compile-time-knowable* facts through the AST call graph:
+
+- integer/float/string/tuple constants, with shape arithmetic folding
+  (``n // 2``, ``t + (1,)``, ``t[0]``, ``len(t)``, ``-(-n // m) * m``);
+- array values as :class:`ShapeDtype` (shape tuple with per-dim holes,
+  dtype name) built from ``jnp.zeros/ones/full/arange/asarray``,
+  ``jax.ShapeDtypeStruct``, ``.reshape``/``.astype``/``.T``/``.shape``;
+- mesh-axis environments as :class:`MeshEnv` from ``Mesh(devs, axes)``
+  constructions (``jax.sharding.Mesh`` or any ``*.Mesh`` spelling);
+- **interprocedural constant propagation**: a parameter binds to a value
+  when every resolved call site (plus its default) agrees on it — the
+  join of disagreeing sites is :data:`UNKNOWN`, never a guess;
+- one level of return-value propagation for trivial bodies (a function
+  whose body is a single evaluable ``return`` folds at its call sites,
+  e.g. ``make_mesh()`` returning ``Mesh(devices, (axis,))``).
+
+Everything stays pure AST work: unresolvable means :data:`UNKNOWN`, and
+rules built on this module must stay silent (or emit an explicit
+"unresolvable" diagnostic) rather than guess.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Optional
+
+from tools.hglint.callgraph import CallGraph, CallSite
+from tools.hglint.loader import ModuleInfo, dtype_name, resolve_fqn
+
+
+class _Unknown:
+    """Singleton bottom value — ``None`` stays available as Python None."""
+
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return "<unknown>"
+
+
+UNKNOWN = _Unknown()
+
+#: a parameter no call site supplies (distinct from "supplied but unknown")
+_MISSING = object()
+
+
+@dataclass(frozen=True)
+class ShapeDtype:
+    """Abstract array: shape is a tuple whose entries are ints or UNKNOWN;
+    ``shape is None`` means even the rank is unknown."""
+
+    shape: Optional[tuple]
+    dtype: Optional[str]
+
+    def dim(self, i: int):
+        if self.shape is None or not (-len(self.shape) <= i < len(self.shape)):
+            return UNKNOWN
+        return self.shape[i]
+
+
+@dataclass(frozen=True)
+class MeshEnv:
+    """Known mesh-axis names of a ``Mesh`` construction."""
+
+    axes: tuple  # tuple[str, ...]
+
+
+#: dtype name -> element size in bytes (default for unknown dtypes is 4:
+#: every index/mask array in this codebase is 32-bit, and assuming wider
+#: would flag kernels we cannot prove over budget)
+DTYPE_BYTES = {
+    "float64": 8, "int64": 8, "uint64": 8, "complex64": 8,
+    "float32": 4, "int32": 4, "uint32": 4,
+    "bfloat16": 2, "float16": 2, "int16": 2, "uint16": 2,
+    "int8": 1, "uint8": 1, "float8_e4m3fn": 1, "float8_e5m2": 1,
+    "bool": 1, "bool_": 1,
+}
+DEFAULT_DTYPE_BYTES = 4
+
+_ZEROS_LIKE = ("zeros", "ones", "empty")
+_JNP_HEADS = ("jax.numpy.", "numpy.")
+
+
+def _is_jnp(fqn: str, name: str) -> bool:
+    return any(fqn == h + name for h in _JNP_HEADS)
+
+
+class Interp:
+    """Whole-program abstract interpreter over a built :class:`CallGraph`."""
+
+    MAX_PASSES = 3
+    _RET_DEPTH = 3
+
+    def __init__(self, cg: CallGraph, modules: list):
+        self.cg = cg
+        self.modules = {m.name: m for m in modules}
+        self.bindings: dict[str, dict] = {}   # fn key -> {param: value}
+        self._env_cache: dict[str, dict] = {}
+        self._ret_stack: list = []
+        self._infer_bindings()
+
+    # -- public API -----------------------------------------------------------
+
+    def env_for(self, fi) -> dict:
+        """Name -> abstract value environment for a function: parameter
+        bindings (joined over call sites) + straight-line local assigns.
+        A name assigned more than once keeps its LAST evaluable value,
+        matching :class:`loader.ConstEnv` — good enough for the literal
+        shape plumbing these rules read."""
+        cached = self._env_cache.get(fi.key)
+        if cached is not None:
+            return cached
+        env = dict(self.bindings.get(fi.key, {}))
+        self._fold_locals(fi.node, env, fi.mod)
+        self._env_cache[fi.key] = env
+        return env
+
+    def eval_in(self, node: ast.AST, fi) -> object:
+        """Evaluate an expression in a function's environment (module env
+        when ``fi`` is None)."""
+        if fi is None:
+            return self.eval(node, {}, None)
+        return self.eval(node, self.env_for(fi), fi.mod)
+
+    def dtype_of(self, node: ast.AST, env: dict, mod) -> Optional[str]:
+        """Dtype name for a dtype-position expression: literal spellings
+        via :func:`loader.dtype_name`, else abstract evaluation (a name
+        bound to a dtype string)."""
+        if node is None:
+            return None
+        if mod is not None:
+            dt = dtype_name(node, mod)
+            if dt is not None:
+                return dt
+        v = self.eval(node, env, mod)
+        return v if isinstance(v, str) else None
+
+    # -- interprocedural parameter bindings -----------------------------------
+
+    def _infer_bindings(self) -> None:
+        for _ in range(self.MAX_PASSES):
+            nxt = self._one_binding_pass()
+            if nxt == self.bindings:
+                break
+            self.bindings = nxt
+            self._env_cache.clear()
+
+    def _one_binding_pass(self) -> dict:
+        # seed with evaluable parameter defaults: a default participates in
+        # the join alongside every call-site value, so a parameter binds
+        # only when the default and all sites agree (or sites always
+        # override it with one common value and there is no default)
+        cand: dict[str, dict] = {}
+        for key, fi in self.cg.functions.items():
+            cand[key] = {}
+            args = fi.node.args
+            pos = args.posonlyargs + args.args
+            defaults = args.defaults
+            for p, d in zip(pos[len(pos) - len(defaults):], defaults):
+                cand[key][p.arg] = {self._freeze(
+                    self.eval(d, {}, fi.mod)
+                )}
+            for p, d in zip(args.kwonlyargs, args.kw_defaults):
+                if d is not None:
+                    cand[key][p.arg] = {self._freeze(
+                        self.eval(d, {}, fi.mod)
+                    )}
+        for site in self.cg.calls:
+            callee = self.cg.resolve_callable(site.node.func, site)
+            if callee is None:
+                continue
+            fi = self.cg.functions[callee]
+            caller = self.cg.functions.get(site.fn_key) \
+                if site.fn_key else None
+            env = self.env_for(caller) if caller is not None else {}
+            mod = caller.mod if caller is not None else site.mod
+            params = fi.params
+            # bound-method call sites skip the explicit self/cls argument
+            off = 0
+            if params and params[0] in ("self", "cls") and \
+                    isinstance(site.node.func, ast.Attribute):
+                off = 1
+            for i, a in enumerate(site.node.args):
+                if isinstance(a, ast.Starred):
+                    break
+                if i + off < len(params):
+                    cand[callee].setdefault(params[i + off], set()).add(
+                        self._freeze(self.eval(a, env, mod))
+                    )
+            for kw in site.node.keywords:
+                if kw.arg and kw.arg in params:
+                    cand[callee].setdefault(kw.arg, set()).add(
+                        self._freeze(self.eval(kw.value, env, mod))
+                    )
+        out: dict[str, dict] = {}
+        for key, pv in cand.items():
+            bound = {}
+            for name, vals in pv.items():
+                if len(vals) == 1:
+                    v = next(iter(vals))
+                    if v is not UNKNOWN:
+                        bound[name] = v
+            if bound:
+                out[key] = bound
+        return out
+
+    @staticmethod
+    def _freeze(v):
+        """Hashable form for join sets (ShapeDtype/MeshEnv are frozen
+        dataclasses already; tuples recurse naturally)."""
+        try:
+            hash(v)
+            return v
+        except TypeError:  # pragma: no cover - lists inside tuples etc.
+            return UNKNOWN
+
+    # -- local straight-line folding ------------------------------------------
+
+    def _fold_locals(self, fn_node, env: dict, mod) -> None:
+        def walk(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    continue
+                if isinstance(child, ast.Assign) and \
+                        len(child.targets) == 1 and \
+                        isinstance(child.targets[0], ast.Name):
+                    env[child.targets[0].id] = self.eval(
+                        child.value, env, mod
+                    )
+                elif isinstance(child, ast.AnnAssign) and \
+                        isinstance(child.target, ast.Name) and \
+                        child.value is not None:
+                    env[child.target.id] = self.eval(child.value, env, mod)
+                walk(child)
+
+        walk(fn_node)
+
+    # -- expression evaluation -------------------------------------------------
+
+    def eval(self, node: ast.AST, env: dict, mod) -> object:  # noqa: C901
+        if node is None:
+            return UNKNOWN
+        if isinstance(node, ast.Constant):
+            return node.value
+        if isinstance(node, ast.Name):
+            if node.id in env:
+                return env[node.id]
+            if mod is not None and node.id in mod.consts:
+                return mod.consts[node.id]
+            return UNKNOWN
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return tuple(self.eval(e, env, mod) for e in node.elts)
+        if isinstance(node, ast.UnaryOp):
+            v = self.eval(node.operand, env, mod)
+            if isinstance(node.op, ast.USub) and isinstance(v, (int, float)):
+                return -v
+            return UNKNOWN
+        if isinstance(node, ast.BinOp):
+            return self._binop(node, env, mod)
+        if isinstance(node, ast.Attribute):
+            return self._attribute(node, env, mod)
+        if isinstance(node, ast.Subscript):
+            return self._subscript(node, env, mod)
+        if isinstance(node, ast.Call):
+            return self._call(node, env, mod)
+        if isinstance(node, ast.IfExp):
+            a = self.eval(node.body, env, mod)
+            b = self.eval(node.orelse, env, mod)
+            return a if a == b else UNKNOWN
+        return UNKNOWN
+
+    def _binop(self, node: ast.BinOp, env, mod):
+        lhs = self.eval(node.left, env, mod)
+        rhs = self.eval(node.right, env, mod)
+        # tuple algebra for shape math
+        if isinstance(node.op, ast.Add) and isinstance(lhs, tuple) \
+                and isinstance(rhs, tuple):
+            return lhs + rhs
+        if isinstance(node.op, ast.Mult):
+            if isinstance(lhs, tuple) and isinstance(rhs, int):
+                return lhs * rhs
+            if isinstance(lhs, int) and isinstance(rhs, tuple):
+                return rhs * lhs
+        if not isinstance(lhs, (int, float)) or \
+                not isinstance(rhs, (int, float)):
+            return UNKNOWN
+        try:
+            if isinstance(node.op, ast.Add):
+                return lhs + rhs
+            if isinstance(node.op, ast.Sub):
+                return lhs - rhs
+            if isinstance(node.op, ast.Mult):
+                return lhs * rhs
+            if isinstance(node.op, ast.FloorDiv):
+                return lhs // rhs
+            if isinstance(node.op, ast.Div):
+                return lhs / rhs
+            if isinstance(node.op, ast.Mod):
+                return lhs % rhs
+            if isinstance(node.op, ast.Pow):
+                return lhs ** rhs
+            if isinstance(node.op, ast.LShift):
+                return lhs << rhs
+            if isinstance(node.op, ast.RShift):
+                return lhs >> rhs
+        except Exception:
+            return UNKNOWN
+        return UNKNOWN
+
+    def _attribute(self, node: ast.Attribute, env, mod):
+        base = self.eval(node.value, env, mod)
+        if isinstance(base, ShapeDtype):
+            if node.attr == "shape":
+                return base.shape if base.shape is not None else UNKNOWN
+            if node.attr == "dtype":
+                return base.dtype if base.dtype is not None else UNKNOWN
+            if node.attr == "ndim" and base.shape is not None:
+                return len(base.shape)
+            if node.attr == "size" and base.shape is not None and \
+                    all(isinstance(d, int) for d in base.shape):
+                n = 1
+                for d in base.shape:
+                    n *= d
+                return n
+            if node.attr == "T" and base.shape is not None:
+                return ShapeDtype(tuple(reversed(base.shape)), base.dtype)
+            return UNKNOWN
+        if isinstance(base, MeshEnv) and node.attr == "axis_names":
+            return base.axes
+        # cross-module constant: resolve `pkg.mod.CONST` through the import
+        # map, then look the name up in that module's literal consts
+        if mod is not None:
+            fqn = resolve_fqn(node, mod)
+            if fqn and "." in fqn:
+                mname, _, attr = fqn.rpartition(".")
+                other = self.modules.get(mname)
+                if other is not None and attr in other.consts:
+                    return other.consts[attr]
+        return UNKNOWN
+
+    def _subscript(self, node: ast.Subscript, env, mod):
+        base = self.eval(node.value, env, mod)
+        if not isinstance(base, tuple):
+            return UNKNOWN
+        if isinstance(node.slice, ast.Slice):
+            lo = self.eval(node.slice.lower, env, mod) \
+                if node.slice.lower else None
+            hi = self.eval(node.slice.upper, env, mod) \
+                if node.slice.upper else None
+            if (lo is None or isinstance(lo, int)) and \
+                    (hi is None or isinstance(hi, int)):
+                return base[lo:hi]
+            return UNKNOWN
+        idx = self.eval(node.slice, env, mod)
+        if isinstance(idx, int) and -len(base) <= idx < len(base):
+            return base[idx]
+        return UNKNOWN
+
+    # -- calls ----------------------------------------------------------------
+
+    def _call(self, node: ast.Call, env, mod):  # noqa: C901
+        # method-style calls on abstract arrays
+        if isinstance(node.func, ast.Attribute):
+            meth = node.func.attr
+            base = self.eval(node.func.value, env, mod)
+            if isinstance(base, ShapeDtype):
+                return self._array_method(base, meth, node, env, mod)
+        if mod is None:
+            return UNKNOWN
+        fqn = resolve_fqn(node.func, mod)
+        if fqn is None:
+            return UNKNOWN
+        if fqn == "len":
+            v = self.eval(node.args[0], env, mod) if node.args else UNKNOWN
+            if isinstance(v, tuple):
+                return len(v)
+            if isinstance(v, ShapeDtype) and v.shape is not None:
+                return v.dim(0)
+            return UNKNOWN
+        if fqn in ("int", "float") and len(node.args) == 1:
+            v = self.eval(node.args[0], env, mod)
+            if isinstance(v, (int, float)):
+                return int(v) if fqn == "int" else float(v)
+            return UNKNOWN
+        if fqn in ("min", "max") and node.args and not node.keywords:
+            vals = [self.eval(a, env, mod) for a in node.args]
+            if all(isinstance(v, (int, float)) for v in vals):
+                return min(vals) if fqn == "min" else max(vals)
+            return UNKNOWN
+        kw = {k.arg: k.value for k in node.keywords if k.arg}
+        if fqn.endswith(".ShapeDtypeStruct"):
+            shape_node = kw.get("shape", node.args[0] if node.args else None)
+            dt_node = kw.get(
+                "dtype", node.args[1] if len(node.args) > 1 else None
+            )
+            return ShapeDtype(
+                self._as_shape(self.eval(shape_node, env, mod)),
+                self.dtype_of(dt_node, env, mod),
+            )
+        if fqn.endswith(".Mesh"):
+            ax_node = kw.get(
+                "axis_names", node.args[1] if len(node.args) > 1 else None
+            )
+            axes = self.eval(ax_node, env, mod)
+            if isinstance(axes, str):
+                return MeshEnv((axes,))
+            if isinstance(axes, tuple) and axes and \
+                    all(isinstance(a, str) for a in axes):
+                return MeshEnv(axes)
+            return UNKNOWN
+        hit = self._jnp_ctor(fqn, node, kw, env, mod)
+        if hit is not UNKNOWN:
+            return hit
+        # single-return user functions fold at the call site
+        return self._fold_return(fqn, node, env, mod)
+
+    def _array_method(self, base: ShapeDtype, meth, node, env, mod):
+        if meth == "astype" and node.args:
+            return ShapeDtype(
+                base.shape, self.dtype_of(node.args[0], env, mod)
+            )
+        if meth == "reshape":
+            dims = [self.eval(a, env, mod) for a in node.args]
+            if len(dims) == 1 and isinstance(dims[0], tuple):
+                dims = list(dims[0])
+            shape = tuple(
+                d if isinstance(d, int) and d >= 0 else UNKNOWN for d in dims
+            )
+            return ShapeDtype(shape if dims else None, base.dtype)
+        if meth == "view" and node.args:
+            return ShapeDtype(base.shape, self.dtype_of(node.args[0], env, mod))
+        return UNKNOWN
+
+    def _jnp_ctor(self, fqn, node, kw, env, mod):
+        dt = self.dtype_of(kw.get("dtype"), env, mod)
+        for name in _ZEROS_LIKE:
+            if _is_jnp(fqn, name):
+                shape = self._as_shape(
+                    self.eval(node.args[0], env, mod) if node.args
+                    else UNKNOWN
+                )
+                return ShapeDtype(shape, dt or "float32")
+        if _is_jnp(fqn, "full"):
+            shape = self._as_shape(
+                self.eval(node.args[0], env, mod) if node.args else UNKNOWN
+            )
+            return ShapeDtype(shape, dt)
+        if _is_jnp(fqn, "arange"):
+            n = self.eval(node.args[0], env, mod) if node.args else UNKNOWN
+            shape = (n,) if isinstance(n, int) else (UNKNOWN,)
+            return ShapeDtype(shape, dt or (
+                "int32" if isinstance(n, int) else None
+            ))
+        if _is_jnp(fqn, "asarray") or _is_jnp(fqn, "array"):
+            v = self.eval(node.args[0], env, mod) if node.args else UNKNOWN
+            if isinstance(v, ShapeDtype):
+                return ShapeDtype(v.shape, dt or v.dtype)
+            if isinstance(v, tuple):
+                return ShapeDtype((len(v),), dt)
+            return ShapeDtype(None, dt)
+        return UNKNOWN
+
+    def _fold_return(self, fqn, node, env, mod):
+        fi = self.cg.functions.get(fqn)
+        if fi is None or len(self._ret_stack) >= self._RET_DEPTH or \
+                fqn in self._ret_stack:
+            return UNKNOWN
+        body = getattr(fi.node, "body", None)
+        ret = None
+        if body:
+            stmts = [s for s in body
+                     if not isinstance(s, (ast.Expr,))]  # skip docstrings
+            if len(stmts) == 1 and isinstance(stmts[0], ast.Return):
+                ret = stmts[0].value
+        if ret is None:
+            return UNKNOWN
+        # bind THIS call's arguments over the callee's defaults
+        callee_env = dict(self.bindings.get(fqn, {}))
+        params = fi.params
+        off = 1 if params and params[0] in ("self", "cls") and \
+            isinstance(node.func, ast.Attribute) else 0
+        for i, a in enumerate(node.args):
+            if isinstance(a, ast.Starred):
+                break
+            if i + off < len(params):
+                callee_env[params[i + off]] = self.eval(a, env, mod)
+        for k in node.keywords:
+            if k.arg and k.arg in params:
+                callee_env[k.arg] = self.eval(k.value, env, mod)
+        self._ret_stack.append(fqn)
+        try:
+            return self.eval(ret, callee_env, fi.mod)
+        finally:
+            self._ret_stack.pop()
+
+    @staticmethod
+    def _as_shape(v):
+        if isinstance(v, int):
+            return (v,)
+        if isinstance(v, tuple):
+            return tuple(d if isinstance(d, int) else UNKNOWN for d in v)
+        return None
+
+
+# ------------------------------------------------------------ shard_map envs
+
+
+_PSPEC_TAILS = (".PartitionSpec", ".P")
+
+
+def collect_axis_names(expr: ast.AST, interp: Interp, fi) -> set:
+    """Axis-name strings appearing in ``PartitionSpec(...)`` constructions
+    inside an ``in_specs``/``out_specs`` expression — the fallback mesh-axis
+    environment when the ``mesh=`` object itself doesn't fold."""
+    out: set = set()
+    if expr is None or fi is None:
+        return out
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.Call):
+            continue
+        fqn = resolve_fqn(node.func, fi.mod) or ""
+        if not (fqn.endswith(_PSPEC_TAILS) or fqn == "P"):
+            continue
+        for a in list(node.args) + [k.value for k in node.keywords]:
+            v = interp.eval_in(a, fi)
+            for s in _flat_strs(v):
+                out.add(s)
+    return out
+
+
+def _flat_strs(v):
+    if isinstance(v, str):
+        yield v
+    elif isinstance(v, tuple):
+        for e in v:
+            yield from _flat_strs(e)
+
+
+def mesh_axes_for_site(site: CallSite, interp: Interp, cg: CallGraph):
+    """Mesh-axis environment of a ``shard_map``/``pjit`` call site: the
+    folded ``mesh=`` object when resolvable, else the axis names named in
+    the site's partition specs. Returns a (possibly empty) frozenset, or
+    ``None`` when nothing at the site resolves — callers must then stay
+    silent rather than flag against a guessed environment."""
+    fi = cg.functions.get(site.fn_key) if site.fn_key else None
+    kw = {k.arg: k.value for k in site.node.keywords if k.arg}
+    mesh_node = kw.get("mesh")
+    if mesh_node is not None and fi is not None:
+        v = interp.eval_in(mesh_node, fi)
+        if isinstance(v, MeshEnv):
+            return frozenset(v.axes)
+    axes: set = set()
+    for name in ("in_specs", "out_specs"):
+        if name in kw:
+            axes |= collect_axis_names(kw[name], interp, fi)
+    return frozenset(axes) if axes else None
+
+
+def element_bytes(dtype: Optional[str]) -> int:
+    return DTYPE_BYTES.get(dtype or "", DEFAULT_DTYPE_BYTES)

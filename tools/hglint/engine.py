@@ -1,4 +1,5 @@
-"""Lint orchestration + baseline filtering."""
+"""Lint orchestration: rule-runner registry, pragma suppression, baseline
+filtering, and the machine-readable report."""
 
 from __future__ import annotations
 
@@ -7,31 +8,106 @@ import os
 from collections import Counter, defaultdict
 
 from tools.hglint import (
+    absint,
+    rules_collectives,
+    rules_donation,
     rules_hostsync,
     rules_locks,
     rules_pallas,
     rules_retrace,
+    rules_vmem,
 )
 from tools.hglint.callgraph import CallGraph
 from tools.hglint.loader import discover_modules
-from tools.hglint.model import Finding, sort_findings
+from tools.hglint.model import RULES, Finding, doc_anchor, sort_findings
 
 BASELINE_VERSION = 1
+REPORT_VERSION = 2
 
 
-def run_lint(paths: list) -> list:
+def _runners(cg, modules, interp, vmem_budget):
+    """(emittable rule ids, thunk) per rule module — the ``--only`` family
+    filter skips whole runners whose rules can't match."""
+    return [
+        (("HG101", "HG102", "HG103", "HG104", "HG105", "HG107"),
+         lambda: rules_hostsync.check(cg)),
+        (("HG106",),
+         lambda: rules_donation.check(cg, modules)),
+        (("HG201", "HG202", "HG203", "HG204"),
+         lambda: rules_retrace.check(cg, modules)),
+        (("HG301", "HG302", "HG303", "HG304"),
+         lambda: rules_pallas.check(cg, modules)),
+        (("HG401", "HG402"),
+         lambda: rules_locks.check(cg, modules)),
+        (("HG501", "HG502"),
+         lambda: rules_vmem.check(cg, modules, interp, vmem_budget)),
+        (("HG601", "HG602", "HG603"),
+         lambda: rules_collectives.check(cg, modules, interp)),
+    ]
+
+
+def parse_only(only) -> tuple:
+    """``--only`` value -> tuple of rule-id prefixes ("HG5" / "HG5,HG601"
+    / already-split sequences all accepted). A prefix matching NO known
+    rule raises: a typo'd ``--only`` must not turn the gate into a silent
+    green no-op."""
+    if not only:
+        return ()
+    if isinstance(only, str):
+        only = only.split(",")
+    prefixes = tuple(p.strip() for p in only if p and p.strip())
+    for p in prefixes:
+        if not any(r.startswith(p) for r in RULES):
+            raise ValueError(
+                f"--only prefix {p!r} matches no known rule; valid ids are "
+                f"{sorted(RULES)} (prefixes like 'HG5' select a family)"
+            )
+    return prefixes
+
+
+def run_lint(paths: list, only=None, vmem_budget: int = None) -> list:
     """Analyze every ``*.py`` under the given paths (analyzed together so
-    cross-module call edges resolve) and return sorted findings."""
+    cross-module call edges resolve) and return sorted findings.
+
+    ``only`` restricts to rule-id prefixes (e.g. ``"HG5"`` or
+    ``["HG5", "HG601"]``); ``vmem_budget`` overrides the default per-core
+    VMEM budget for HG501."""
     modules = []
     for p in paths:
         modules.extend(discover_modules(p))
     cg = CallGraph.build(modules)
+    interp = absint.Interp(cg, modules)
+    budget = vmem_budget or rules_vmem.DEFAULT_VMEM_BUDGET
+    prefixes = parse_only(only)
     findings = []
-    findings += rules_hostsync.check(cg)
-    findings += rules_retrace.check(cg, modules)
-    findings += rules_pallas.check(cg, modules)
-    findings += rules_locks.check(cg, modules)
+    for rules, thunk in _runners(cg, modules, interp, budget):
+        if prefixes and not any(
+            r.startswith(p) for p in prefixes for r in rules
+        ):
+            continue
+        findings += thunk()
+    if prefixes:
+        findings = [
+            f for f in findings
+            if any(f.rule.startswith(p) for p in prefixes)
+        ]
+    findings = _apply_pragmas(findings, modules)
     return sort_findings(findings)
+
+
+def _apply_pragmas(findings: list, modules: list) -> list:
+    """Drop findings whose line carries ``# hglint: disable=<rule>``
+    (or ``disable=all``) in the module source."""
+    by_path = {m.path: m.pragmas for m in modules if m.pragmas}
+    if not by_path:
+        return findings
+    out = []
+    for f in findings:
+        rules = by_path.get(f.path, {}).get(f.line, ())
+        if f.rule in rules or "all" in rules:
+            continue
+        out.append(f)
+    return out
 
 
 # ------------------------------------------------------------------ baseline
@@ -82,6 +158,44 @@ def apply_baseline(findings: list, baseline: dict) -> list:
             fs = sorted(fs, key=lambda f: f.line)
             out.extend(fs[allowed:])
     return sort_findings(out)
+
+
+# -------------------------------------------------------------------- report
+
+
+def finding_dict(f: Finding) -> dict:
+    return {
+        "rule": f.rule, "severity": f.severity, "path": f.path,
+        "line": f.line, "scope": f.scope, "message": f.message,
+        "doc": doc_anchor(f.rule),
+    }
+
+
+def build_report(findings: list, paths: list, *, baseline_path=None,
+                 suppressed: int = 0, only=None,
+                 vmem_budget: int = None) -> dict:
+    """Machine-readable run report for CI (``--output json``): stable
+    envelope, per-rule/severity counts, findings with doc anchors."""
+    by_rule = Counter(f.rule for f in findings)
+    by_sev = Counter(f.severity for f in findings)
+    return {
+        "tool": "hglint",
+        "report_version": REPORT_VERSION,
+        "paths": list(paths),
+        "only": list(parse_only(only)),
+        "vmem_budget_bytes": vmem_budget or rules_vmem.DEFAULT_VMEM_BUDGET,
+        "baseline": {
+            "path": baseline_path,
+            "applied": baseline_path is not None,
+            "suppressed": suppressed,
+        },
+        "counts": {
+            "total": len(findings),
+            "by_rule": dict(sorted(by_rule.items())),
+            "by_severity": dict(sorted(by_sev.items())),
+        },
+        "findings": [finding_dict(f) for f in findings],
+    }
 
 
 def summarize(findings: list) -> str:

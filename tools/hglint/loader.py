@@ -8,8 +8,13 @@ from __future__ import annotations
 
 import ast
 import os
+import re
 from dataclasses import dataclass, field
 from typing import Optional
+
+#: ``# hglint: disable=HG502`` / ``# hglint: disable=HG501,HG502`` — line
+#: pragma suppressing the named rules for findings reported on that line
+_PRAGMA_RE = re.compile(r"#\s*hglint:\s*disable=([A-Za-z0-9_,\s]+)")
 
 
 @dataclass
@@ -21,6 +26,9 @@ class ModuleInfo:
     toplevel: set = field(default_factory=set)    # names def'd at module level
     consts: dict = field(default_factory=dict)    # module-level literal consts
     mutable_globals: dict = field(default_factory=dict)  # name -> lineno
+    np_globals: dict = field(default_factory=dict)  # numpy-valued module
+    #                                                 globals: name -> lineno
+    pragmas: dict = field(default_factory=dict)   # lineno -> {rule ids}
 
 
 def discover_modules(root: str) -> list[ModuleInfo]:
@@ -55,6 +63,12 @@ def discover_modules(root: str) -> list[ModuleInfo]:
         rel = os.path.relpath(path)
         shown = rel if not rel.startswith("..") else path
         mod = ModuleInfo(name=name, path=shown, tree=tree)
+        for lineno, line in enumerate(src.splitlines(), start=1):
+            m = _PRAGMA_RE.search(line)
+            if m:
+                mod.pragmas[lineno] = {
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                }
         _index_module(mod)
         mods.append(mod)
     return mods
@@ -124,6 +138,10 @@ def _index_module(mod: ModuleInfo) -> None:
                     mod.consts[t.id] = cv
                 if _is_mutable_literal(value):
                     mod.mutable_globals[t.id] = t.lineno
+                if isinstance(value, ast.Call):
+                    fqn = resolve_fqn(value.func, mod)
+                    if fqn and fqn.startswith("numpy."):
+                        mod.np_globals[t.id] = t.lineno
 
 
 def _resolve_from(node: ast.ImportFrom, pkg_parts: list[str]) -> Optional[str]:

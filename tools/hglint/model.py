@@ -16,6 +16,9 @@ RULES = {
     "HG103": "numpy call inside traced code materializes a host value",
     "HG104": "jax.device_get inside traced code is a blocking transfer",
     "HG105": "block_until_ready inside traced code defeats async dispatch",
+    "HG106": "binding read after its buffer was donated (donate_argnums)",
+    "HG107": "jnp.asarray/jnp.array on a host numpy value inside traced "
+             "code (silent host->device transfer per trace)",
     # -- family 2: retrace hazards -------------------------------------------
     "HG201": "jax.jit(...) constructed inside a loop retraces every iteration",
     "HG202": "Python branch on a traced parameter (shape-independent control "
@@ -30,6 +33,14 @@ RULES = {
     # -- family 4: lock order -------------------------------------------------
     "HG401": "lock acquisition cycle (potential deadlock)",
     "HG402": "shared attribute mutated outside the instance lock",
+    # -- family 5: VMEM budgets ----------------------------------------------
+    "HG501": "pallas_call VMEM working set exceeds the per-core budget",
+    "HG502": "pallas_call VMEM working set is not statically resolvable",
+    # -- family 6: shard_map collective consistency ---------------------------
+    "HG601": "collective over an axis name absent from the shard_map mesh",
+    "HG602": "collective under a branch on a traced value "
+             "(divergent-program deadlock)",
+    "HG603": "collective axis mismatch between shard_map caller and callee",
 }
 
 #: rule id -> default severity
@@ -49,7 +60,32 @@ RULE_SEVERITY = {
     "HG304": "error",
     "HG401": "error",
     "HG402": "warning",
+    "HG106": "error",
+    "HG107": "warning",
+    "HG501": "error",
+    "HG502": "warning",
+    "HG601": "error",
+    "HG602": "error",
+    "HG603": "error",
 }
+
+#: family prefix -> README.md section anchor (rule docs live there); HG106
+#: and HG107 extend family 1, so the 3-char prefix mapping covers them
+DOC_ANCHORS = {
+    "HG1": "hg1xx-host-sync-in-traced-code",
+    "HG2": "hg2xx-retrace-hazards",
+    "HG3": "hg3xx-pallas-kernel-contracts",
+    "HG4": "hg4xx-lock-order",
+    "HG5": "hg5xx-vmem-budgets",
+    "HG6": "hg6xx-shard_map-collective-consistency",
+}
+
+
+def doc_anchor(rule: str) -> str:
+    """URL-style pointer to the rule family's README section, printed in
+    every rendered diagnostic (``HG5xx`` -> ``README.md#hg5xx-...``)."""
+    slug = DOC_ANCHORS.get(rule[:3], "static-analysis-hglint")
+    return f"README.md#{slug}"
 
 
 @dataclass(frozen=True)
@@ -75,7 +111,7 @@ class Finding:
     def render(self) -> str:
         return (
             f"{self.path}:{self.line} {self.rule} {self.severity}: "
-            f"{self.message}"
+            f"{self.message} [{doc_anchor(self.rule)}]"
         )
 
 

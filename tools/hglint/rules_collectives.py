@@ -1,0 +1,258 @@
+"""HG6xx — collective consistency inside ``shard_map``/``pjit`` regions.
+
+On a real TPU mesh every device must execute the SAME sequence of
+collectives over the SAME axis names; anything else hangs the mesh (no
+timeout, no traceback — the job just stops). Three statically checkable
+ways to get there:
+
+HG601 (error)  a collective names a mesh axis that does not exist in the
+               enclosing ``shard_map``'s mesh environment — XLA raises at
+               trace time at best, at worst (spelled via a variable that
+               aliases another region's axis) it deadlocks.
+HG602 (error)  a collective is issued under a Python branch whose
+               condition derives from a traced/device value (a parameter
+               of the shard-mapped body, or the result of
+               ``axis_index``/another collective): devices that take
+               different branches issue different collective sequences —
+               the classic divergent-program deadlock.
+HG603 (error)  caller/callee axis mismatch: a helper reached from a
+               shard_map region issues a collective whose axis name
+               (constant, or a parameter constant-propagated from its
+               call sites) is absent from every region environment that
+               reaches the helper.
+
+The mesh environment of a region is resolved by
+:func:`tools.hglint.absint.mesh_axes_for_site` — the folded ``mesh=``
+object, else the axis names in the site's partition specs. When NOTHING
+resolves the region is skipped entirely: silence over guessing.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import deque
+
+from tools.hglint.absint import Interp, mesh_axes_for_site
+from tools.hglint.callgraph import SHARD_FQNS, CallGraph
+from tools.hglint.loader import own_nodes, resolve_fqn
+from tools.hglint.model import Finding
+from tools.hglint.rules_retrace import _traced_name_in_test
+
+#: collective fqn -> positional index of its axis-name argument
+COLLECTIVES = {
+    "jax.lax.psum": 1,
+    "jax.lax.pmean": 1,
+    "jax.lax.pmax": 1,
+    "jax.lax.pmin": 1,
+    "jax.lax.all_gather": 1,
+    "jax.lax.psum_scatter": 1,
+    "jax.lax.all_to_all": 1,
+    "jax.lax.ppermute": 1,
+    "jax.lax.pshuffle": 1,
+    "jax.lax.pcast": 1,
+    "jax.lax.axis_index": 0,
+}
+
+_AXIS_KWARGS = ("axis_name", "axis_names")
+
+#: device-local queries of the mesh position: they take an axis name (so
+#: HG601/HG603 validate them) but perform NO cross-device communication —
+#: running one under a divergent branch cannot deadlock (no HG602)
+_NON_COMMUNICATING = {"jax.lax.axis_index"}
+
+
+def check(cg: CallGraph, modules: list, interp: Interp) -> list:
+    regions = _regions(cg, interp)
+    if not regions:
+        return []
+    # fn key -> list of (root key, env or None) for every region reaching it
+    reach: dict[str, list] = {}
+    for root, env in regions.items():
+        for key in _reachable(cg, root):
+            reach.setdefault(key, []).append((root, env))
+    findings = []
+    for key, hits in reach.items():
+        fi = cg.functions[key]
+        envs = [env for _, env in hits]
+        if any(env is None for env in envs):
+            env_union = None           # an unresolvable region reaches us
+        else:
+            env_union = frozenset().union(*envs)
+        findings += _check_fn(cg, interp, fi, key in regions, env_union)
+    return findings
+
+
+# ------------------------------------------------------------------ regions
+
+
+def _regions(cg: CallGraph, interp: Interp) -> dict:
+    """shard_map root key -> mesh-axis env (frozenset | None)."""
+    roots = {k for k, fi in cg.functions.items()
+             if fi.root_kind == "shard_map"}
+    if not roots:
+        return {}
+    envs: dict[str, list] = {k: [] for k in roots}
+    for site in cg.calls:
+        fqn = resolve_fqn(site.node.func, site.mod)
+        if fqn not in SHARD_FQNS or not site.node.args:
+            continue
+        key = cg.resolve_callable(site.node.args[0], site)
+        if key in envs:
+            envs[key].append(mesh_axes_for_site(site, interp, cg))
+    out = {}
+    for key, site_envs in envs.items():
+        if not site_envs or any(e is None for e in site_envs):
+            out[key] = None            # decorator-only or unresolvable site
+        else:
+            out[key] = frozenset().union(*site_envs)
+    return out
+
+
+def _reachable(cg: CallGraph, root: str) -> set:
+    seen = {root}
+    q = deque([root])
+    while q:
+        key = q.popleft()
+        fi = cg.functions[key]
+        nxt = set(cg.edges.get(key, ())) | set(fi.children.values())
+        for n in nxt:
+            if n not in seen:
+                seen.add(n)
+                q.append(n)
+    return seen
+
+
+# ------------------------------------------------------------ per function
+
+
+def _check_fn(cg: CallGraph, interp: Interp, fi, is_root: bool,
+              env) -> list:
+    """``env`` is the union of resolved region envs reaching ``fi``
+    (None when any reaching region is unresolvable — axis checks skip,
+    divergence checks still run)."""
+    findings = []
+    collectives = []   # (call node, fqn)
+    derived: set = set()   # names bound to collective results in this fn
+    for node in own_nodes(fi.node):
+        if isinstance(node, ast.Call):
+            fqn = resolve_fqn(node.func, fi.mod)
+            if fqn in COLLECTIVES:
+                collectives.append((node, fqn))
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                isinstance(node.value, ast.Call):
+            fqn = resolve_fqn(node.value.func, fi.mod)
+            if fqn in COLLECTIVES:
+                derived.add(node.targets[0].id)
+
+    # -- HG601/HG603: axis names vs the mesh environment ---------------------
+    if env is not None:
+        env_fn = interp.env_for(fi)
+        for node, fqn in collectives:
+            for axis in _axis_names(node, fqn, interp, env_fn, fi.mod):
+                if axis in env:
+                    continue
+                short = fqn.rsplit(".", 1)[-1]
+                if is_root:
+                    findings.append(Finding(
+                        rule="HG601", path=fi.mod.path, line=node.lineno,
+                        scope=fi.qualpath,
+                        message=(
+                            f"`{short}` over axis '{axis}' but the "
+                            f"shard_map mesh only has "
+                            f"{sorted(env) or '(no resolvable axes)'}"
+                        ),
+                    ))
+                else:
+                    findings.append(Finding(
+                        rule="HG603", path=fi.mod.path, line=node.lineno,
+                        scope=fi.qualpath,
+                        message=(
+                            f"`{short}` over axis '{axis}' in a helper "
+                            f"reached from shard_map, but every caller "
+                            f"region's mesh only has {sorted(env)} — "
+                            f"caller/callee axis mismatch"
+                        ),
+                    ))
+
+    # -- HG602: collectives under traced-value branches -----------------------
+    traced = set(derived)
+    if is_root:
+        traced |= {p for p in fi.params if p not in fi.static_params}
+    flagged: set = set()
+    for branch in own_nodes(fi.node):
+        if not isinstance(branch, (ast.If, ast.While)):
+            continue
+        hit = _device_test(branch.test, traced, fi.mod)
+        if not hit:
+            continue
+        # only the BODY diverges — a collective in the test itself still
+        # executes on every device
+        body = list(branch.body) + list(branch.orelse)
+        for node, fqn in collectives:
+            if fqn in _NON_COMMUNICATING:
+                continue
+            if id(node) in flagged or \
+                    not any(_within(s, node) for s in body):
+                continue
+            flagged.add(id(node))
+            short = fqn.rsplit(".", 1)[-1]
+            findings.append(Finding(
+                rule="HG602", path=fi.mod.path, line=node.lineno,
+                scope=fi.qualpath,
+                message=(
+                    f"`{short}` under a branch on device value "
+                    f"`{hit}` inside shard_map — devices taking "
+                    f"different branches issue different collective "
+                    f"sequences and the mesh deadlocks; use lax.cond "
+                    f"or hoist the collective out of the branch"
+                ),
+            ))
+    return findings
+
+
+def _axis_names(node: ast.Call, fqn: str, interp: Interp, env_fn: dict,
+                mod):
+    """Resolved axis-name strings of a collective call ([] when the axis
+    expression does not fold — silence over guessing)."""
+    pos = COLLECTIVES[fqn]
+    axis_node = node.args[pos] if len(node.args) > pos else None
+    if axis_node is None:
+        for k in node.keywords:
+            if k.arg in _AXIS_KWARGS:
+                axis_node = k.value
+                break
+    if axis_node is None:
+        return []
+    v = interp.eval(axis_node, env_fn, mod)
+    out = []
+    stack = [v]
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, str):
+            out.append(cur)
+        elif isinstance(cur, tuple):
+            stack.extend(cur)
+        else:
+            return []   # any unresolvable component voids the whole check
+    return out
+
+
+def _device_test(test: ast.AST, traced: set, mod) -> str:
+    """Name of the device value a branch condition concretizes, or '' —
+    a traced name (pruned through static accessors, shared with HG202) or
+    a direct collective call in the condition."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call):
+            fqn = resolve_fqn(node.func, mod)
+            if fqn in COLLECTIVES:
+                return fqn.rsplit(".", 1)[-1] + "(...)"
+    if traced:
+        hit = _traced_name_in_test(test, traced)
+        if hit:
+            return hit
+    return ""
+
+
+def _within(outer: ast.AST, target: ast.AST) -> bool:
+    return any(n is target for n in ast.walk(outer))

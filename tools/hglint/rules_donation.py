@@ -1,0 +1,280 @@
+"""HG106 — donated-buffer reuse after ``donate_argnums``/``donate_argnames``.
+
+``jax.jit(f, donate_argnums=(0,))`` lets XLA alias argument 0's buffer
+into the output: after the call the caller's array object still *exists*
+but its device buffer is deleted. Reading it raises
+``RuntimeError: Array has been deleted`` on hardware — and silently works
+on CPU test runs where donation is a no-op, which is why this needs a
+static rule.
+
+The check is a statement-ordered taint scan per function:
+
+- calls to donating callables (a ``@partial(jax.jit, donate_argnums=...)``
+  decorated function, or a name bound to ``jax.jit(f, donate_...)`` at
+  module or function scope) mark the plain-``Name`` arguments at donated
+  positions as dead;
+- any later ``Name`` load of a dead binding is HG106;
+- rebinding (``x = step(x)`` — the donation idiom) clears the taint, as
+  does any other store to the name;
+- ``if``/``else`` branches are scanned independently and their taints
+  union (a donation on either path poisons the join);
+- a donating call INSIDE a loop whose donated name is never rebound in
+  that loop body is flagged too: iteration 2 re-reads the buffer
+  iteration 1 donated.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tools.hglint.callgraph import JIT_FQNS, PARTIAL_FQNS, CallGraph
+from tools.hglint.loader import literal_value, resolve_fqn
+from tools.hglint.model import Finding
+
+
+def check(cg: CallGraph, modules: list) -> list:
+    donors = _collect_donors(cg, modules)
+    if not donors:
+        return []
+    findings = []
+    for fi in cg.functions.values():
+        vis = _visible_donors(donors, fi)
+        if vis:
+            _Scanner(cg, fi, vis, findings).run()
+    return findings
+
+
+# ----------------------------------------------------------------- donors
+
+
+def _donate_kw(call: ast.Call, params: list) -> Optional[set]:
+    """Donated *positional indices* from donate_argnums/donate_argnames
+    keywords (argnames resolved through the callee's parameter list)."""
+    out: set = set()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = literal_value(kw.value)
+            nums = [v] if isinstance(v, int) else list(v) \
+                if isinstance(v, tuple) else []
+            out |= {n for n in nums if isinstance(n, int)}
+        elif kw.arg == "donate_argnames":
+            v = literal_value(kw.value)
+            names = [v] if isinstance(v, str) else list(v) \
+                if isinstance(v, tuple) else []
+            out |= {params.index(n) for n in names
+                    if isinstance(n, str) and n in params}
+    return out or None
+
+
+def _collect_donors(cg: CallGraph, modules: list) -> dict:
+    """Maps both function keys and caller-visible alias names to donated
+    position sets:
+
+    - ``key:<fn key>`` for decorated functions (called by their own name);
+    - ``alias:<module>.<name>`` / ``alias:<fn key>.<name>`` for
+      ``name = jax.jit(f, donate_...)`` bindings.
+    """
+    donors: dict[str, set] = {}
+    # decorated: @partial(jax.jit, donate_argnums=...)
+    for key, fi in cg.functions.items():
+        for dec in getattr(fi.node, "decorator_list", ()):
+            if not isinstance(dec, ast.Call):
+                continue
+            base = resolve_fqn(dec.func, fi.mod)
+            inner = None
+            if base in PARTIAL_FQNS and dec.args:
+                inner = resolve_fqn(dec.args[0], fi.mod)
+            if base in JIT_FQNS or inner in JIT_FQNS:
+                pos = _donate_kw(dec, fi.params)
+                if pos:
+                    donors[f"key:{key}"] = pos
+    # aliased: name = jax.jit(f, donate_...) at module or function scope
+    for mod in modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Assign) or \
+                    not isinstance(node.value, ast.Call) or \
+                    len(node.targets) != 1 or \
+                    not isinstance(node.targets[0], ast.Name):
+                continue
+            call = node.value
+            if resolve_fqn(call.func, mod) not in JIT_FQNS or not call.args:
+                continue
+            target_fqn = resolve_fqn(call.args[0], mod)
+            params = []
+            if target_fqn in cg.functions:
+                params = cg.functions[target_fqn].params
+            pos = _donate_kw(call, params)
+            if pos:
+                donors[f"alias:{mod.name}.{node.targets[0].id}"] = pos
+    return donors
+
+
+def _visible_donors(donors: dict, fi) -> dict:
+    """Callable-name -> donated positions, as visible from ``fi``'s body."""
+    vis: dict[str, set] = {}
+    for tag, pos in donors.items():
+        kind, _, rest = tag.partition(":")
+        if kind == "key":
+            # called by bare name when defined in the same module, or by
+            # its imported alias elsewhere
+            name = rest.rsplit(".", 1)[-1]
+            if rest.startswith(fi.mod.name + "."):
+                vis[name] = pos
+            else:
+                for local, fqn in fi.mod.imports.items():
+                    if fqn == rest:
+                        vis[local] = pos
+        else:
+            mod_name, _, name = rest.rpartition(".")
+            if mod_name == fi.mod.name:
+                vis[name] = pos
+            else:
+                for local, fqn in fi.mod.imports.items():
+                    if fqn == rest:
+                        vis[local] = pos
+    return vis
+
+
+# ---------------------------------------------------------------- scanner
+
+
+class _Scanner:
+    def __init__(self, cg, fi, donors: dict, findings: list):
+        self.cg = cg
+        self.fi = fi
+        self.donors = donors
+        self.findings = findings
+
+    def run(self) -> None:
+        self._stmts(list(getattr(self.fi.node, "body", ())), {})
+
+    # active: name -> (donation line, callee display name)
+
+    def _stmts(self, stmts: list, active: dict) -> dict:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.If):
+                self._reads_expr(stmt.test, active)
+                a1 = self._stmts(list(stmt.body), dict(active))
+                a2 = self._stmts(list(stmt.orelse), dict(active))
+                active = {**a1, **a2}
+                continue
+            if isinstance(stmt, (ast.For, ast.While)):
+                # the iterator / condition is itself a read of any already-
+                # donated binding
+                self._reads_expr(
+                    stmt.iter if isinstance(stmt, ast.For) else stmt.test,
+                    active,
+                )
+                self._loop(stmt, active)
+                continue
+            if isinstance(stmt, ast.Try):
+                for blk in (stmt.body, stmt.orelse, stmt.finalbody,
+                            *[h.body for h in stmt.handlers]):
+                    active = self._stmts(list(blk), active)
+                continue
+            if isinstance(stmt, ast.With):
+                self._reads(stmt, active, items_only=True)
+                active = self._stmts(list(stmt.body), active)
+                continue
+            self._linear(stmt, active)
+        return active
+
+    def _loop(self, stmt, active: dict) -> None:
+        body = list(stmt.body) + list(stmt.orelse)
+        before = set(active)
+        inner = self._stmts(body, active)
+        # a donation born inside the loop whose name survives to the end of
+        # the body is re-read by iteration 2 — at minimum by the donating
+        # call itself, or by the loop condition/iterator
+        stored = _stored_names(body)
+        for name, (line, callee) in list(inner.items()):
+            if name in before or name in stored:
+                continue
+            read_line = line
+            it = stmt.iter if isinstance(stmt, ast.For) else stmt.test
+            for node in ast.walk(it):
+                if isinstance(node, ast.Name) and node.id == name:
+                    read_line = node.lineno
+            self.findings.append(self._f(
+                name, read_line, callee, line,
+                extra=" on the next loop iteration",
+            ))
+            del inner[name]
+        active.clear()
+        active.update(inner)
+
+    def _reads_expr(self, expr, active: dict) -> None:
+        """Report loads of donated bindings inside a bare expression (a
+        branch condition or loop iterator)."""
+        if expr is None or not active:
+            return
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Load) and node.id in active:
+                line, callee = active.pop(node.id)
+                self.findings.append(
+                    self._f(node.id, node.lineno, callee, line)
+                )
+
+    def _linear(self, stmt, active: dict) -> None:
+        self._reads(stmt, active)
+        donated = self._donations(stmt)
+        stored = _stored_names([stmt])
+        for name in stored:
+            active.pop(name, None)
+        for name, (line, callee) in donated.items():
+            if name not in stored:
+                active[name] = (line, callee)
+
+    def _reads(self, stmt, active: dict, items_only: bool = False) -> None:
+        if not active:
+            return
+        nodes = stmt.items if items_only else [stmt]
+        for root in nodes:
+            for node in ast.walk(root if not items_only else root.context_expr):
+                if isinstance(node, ast.Name) and \
+                        isinstance(node.ctx, ast.Load) and node.id in active:
+                    line, callee = active.pop(node.id)
+                    self.findings.append(
+                        self._f(node.id, node.lineno, callee, line)
+                    )
+
+    def _donations(self, stmt) -> dict:
+        out = {}
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call) or \
+                    not isinstance(node.func, ast.Name):
+                continue
+            pos = self.donors.get(node.func.id)
+            if not pos:
+                continue
+            for i, a in enumerate(node.args):
+                if i in pos and isinstance(a, ast.Name):
+                    out[a.id] = (node.lineno, node.func.id)
+        return out
+
+    def _f(self, name, read_line, callee, donate_line, extra="") -> Finding:
+        return Finding(
+            rule="HG106", path=self.fi.mod.path, line=read_line,
+            scope=self.fi.qualpath,
+            message=(
+                f"`{name}` read{extra} after being donated to "
+                f"`{callee}` at line {donate_line} — the device buffer is "
+                f"freed by donate_argnums; rebind the result "
+                f"(`{name} = {callee}(...)`) or drop the donation"
+            ),
+        )
+
+
+def _stored_names(stmts: list) -> set:
+    out: set = set()
+    for stmt in stmts:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, (ast.Store, ast.Del)):
+                out.add(node.id)
+    return out

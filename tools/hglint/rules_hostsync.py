@@ -23,12 +23,16 @@ _BLOCK_READY = {"jax.block_until_ready"}
 #: data (np.asarray, np.array, np.nonzero, ...)
 _NUMPY_HEADS = ("numpy.",)
 
+#: jnp constructors that silently upload a host value per trace (HG107)
+_JNP_UPLOADERS = ("jax.numpy.asarray", "jax.numpy.array")
+
 
 def check(cg: CallGraph) -> list:
     findings = []
     for fi in cg.traced_functions():
         root = cg.traced[fi.key]
         via = "" if root == fi.key else f" (traced via {_short(root)})"
+        np_locals = _numpy_locals(fi)
         for node in own_nodes(fi.node):
             if not isinstance(node, ast.Call):
                 continue
@@ -69,7 +73,50 @@ def check(cg: CallGraph) -> list:
                         f"`{fqn}()` on a possibly-traced value{via} — "
                         f"concretizes under trace",
                     ))
+            elif fqn in _JNP_UPLOADERS and node.args:
+                src = _host_numpy_source(node.args[0], fi, np_locals)
+                if src:
+                    findings.append(_f(
+                        "HG107", fi, node,
+                        f"`{_np_spelling(node.func)}` on host numpy value "
+                        f"`{src}` in traced code{via} — a silent "
+                        f"host->device transfer baked in per trace; build "
+                        f"it with jnp ops or pass it as an argument",
+                    ))
     return findings
+
+
+def _numpy_locals(fi) -> tuple:
+    """(names assigned from a ``numpy.*`` call inside this function,
+    every locally-bound name — params + any Store) so a parameter or
+    local that SHADOWS a numpy module global isn't misread as one."""
+    np_names: set = set()
+    bound: set = set(fi.params)
+    for node in own_nodes(fi.node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                isinstance(node.value, ast.Call):
+            fqn = resolve_fqn(node.value.func, fi.mod)
+            if fqn and fqn.startswith(_NUMPY_HEADS):
+                np_names.add(node.targets[0].id)
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            bound.add(node.id)
+    return np_names, bound
+
+
+def _host_numpy_source(expr: ast.AST, fi, np_locals: tuple):
+    """The name of the host numpy value being uploaded, or None: a local
+    assigned from ``np.*`` in this function, or a module-level global
+    bound to a ``np.*`` call result (unless a parameter/local shadows
+    it). Anything else (a traced array, a literal) is a legitimate
+    ``jnp.asarray`` and stays silent."""
+    np_names, bound = np_locals
+    if isinstance(expr, ast.Name):
+        if expr.id in np_names:
+            return expr.id
+        if expr.id in fi.mod.np_globals and expr.id not in bound:
+            return expr.id
+    return None
 
 
 def _np_spelling(func: ast.AST) -> str:

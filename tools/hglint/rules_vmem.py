@@ -1,0 +1,320 @@
+"""HG5xx — static VMEM budgeting per ``pl.pallas_call``.
+
+A TPU core has ~16 MiB of VMEM (see pallas guide: HBM → VMEM → compute).
+Mosaic allocates every blocked input/output a **double-buffered** VMEM
+window (compute on block k while block k+1 streams in) plus every
+``scratch_shapes`` VMEM buffer once; a call whose working set exceeds the
+budget fails at compile time on hardware — on CPU interpret-mode tests it
+silently passes, which is exactly the hazard this rule pins.
+
+The model, per ``pallas_call`` site (via :mod:`tools.hglint.absint`):
+
+- each in/out ``BlockSpec`` with a VMEM (or default) memory space
+  contributes ``tile_padded(block_shape) * dtype_bytes * (2 if gridded
+  else 1)`` — block dims are padded up to the dtype's (sublane, 128)
+  tile, matching Mosaic's physical allocation;
+- a BlockSpec dim of ``None`` (and a missing block_shape) means "the full
+  array dim", taken from the folded operand / ``out_shape``;
+- ``memory_space=ANY``/``SMEM``/semaphore specs contribute nothing
+  (they never live in VMEM);
+- ``scratch_shapes`` ``pltpu.VMEM((dims), dtype)`` entries contribute
+  once; DMA semaphores contribute nothing;
+- input dtypes come from abstract evaluation of the operands actually
+  passed to the returned callable; an unresolvable dtype falls back to 4
+  bytes (every index/mask array here is 32-bit — assuming wider would
+  manufacture overflows we cannot prove).
+
+HG501 (error)  the folded working set exceeds the budget (default 16 MiB,
+               ``--vmem-budget`` to override).
+HG502 (warn)   the working set is NOT statically resolvable — some block
+               dim, operand shape, or scratch shape doesn't fold. Fix by
+               making the shape static, or verify the bound by hand, guard
+               it at runtime, and add ``# hglint: disable=HG502`` on the
+               flagged line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tools.hglint.absint import (
+    UNKNOWN,
+    Interp,
+    ShapeDtype,
+    element_bytes,
+)
+from tools.hglint.callgraph import PALLAS_FQNS, CallGraph, CallSite
+from tools.hglint.loader import DTYPE_SUBLANE, resolve_fqn
+from tools.hglint.model import Finding
+
+#: default per-core VMEM budget in bytes (v4/v5 generations: ~16 MiB)
+DEFAULT_VMEM_BUDGET = 16 << 20
+
+LANE = 128
+
+_VMEM_TAILS = (".VMEM",)
+_OFF_VMEM_TAILS = (".ANY", ".SMEM", ".HBM", ".SEMAPHORE")
+
+
+def check(cg: CallGraph, modules: list, interp: Interp,
+          budget: int = DEFAULT_VMEM_BUDGET) -> list:
+    # map pallas_call(...) node -> the outer call that supplies operands:
+    # ``pl.pallas_call(kernel, ...)(x, y)`` parses as Call(Call(...), x, y)
+    outer_by_inner = {}
+    for site in cg.calls:
+        if isinstance(site.node.func, ast.Call):
+            outer_by_inner[id(site.node.func)] = site.node
+    findings = []
+    for site in cg.calls:
+        fqn = resolve_fqn(site.node.func, site.mod)
+        if fqn not in PALLAS_FQNS:
+            continue
+        findings += _check_call(
+            cg, site, interp, budget, outer_by_inner.get(id(site.node))
+        )
+    return findings
+
+
+# ---------------------------------------------------------------- per call
+
+
+def _check_call(cg: CallGraph, site: CallSite, interp: Interp, budget: int,
+                outer: Optional[ast.Call]) -> list:
+    call, mod = site.node, site.mod
+    fi = cg.functions.get(site.fn_key) if site.fn_key else None
+    env = interp.env_for(fi) if fi is not None else {}
+    scope = fi.qualpath if fi else "<module>"
+
+    kw = {k.arg: k.value for k in call.keywords if k.arg}
+    grid_node = kw.get("grid")
+    in_specs = kw.get("in_specs")
+    out_specs = kw.get("out_specs")
+    scratch = kw.get("scratch_shapes")
+    n_scalar = 0
+    gs = kw.get("grid_spec")
+    if isinstance(gs, ast.Call):
+        gkw = {k.arg: k.value for k in gs.keywords if k.arg}
+        grid_node = gkw.get("grid", grid_node)
+        in_specs = gkw.get("in_specs", in_specs)
+        out_specs = gkw.get("out_specs", out_specs)
+        scratch = gkw.get("scratch_shapes", scratch)
+        v = interp.eval(gkw.get("num_scalar_prefetch"), env, mod)
+        if isinstance(v, int):
+            n_scalar = v
+
+    gridded = grid_node is not None
+    buf_factor = 2 if gridded else 1
+
+    # abstract operand values (for dtypes and full-dim substitution)
+    operands: list = []
+    if outer is not None:
+        operands = [interp.eval(a, env, mod) for a in outer.args]
+    operands = operands[n_scalar:]  # scalar-prefetch args live in SMEM
+
+    out_vals = _out_shape_vals(kw.get("out_shape"), interp, env, mod)
+
+    total = 0
+    unresolved: list[str] = []
+
+    in_elts = _spec_nodes(in_specs)
+    if in_elts is None and in_specs is not None:
+        unresolved.append("in_specs is not a literal list/tuple/BlockSpec")
+        in_elts = []
+    for i, spec in enumerate(in_elts or []):
+        op = operands[i] if i < len(operands) else UNKNOWN
+        total += _spec_bytes(
+            spec, op, interp, env, mod, buf_factor, unresolved,
+            f"in_specs[{i}]",
+        )
+    if in_specs is None and operands:
+        # no blocking: each operand lands in VMEM whole
+        for i, op in enumerate(operands):
+            total += _whole_array_bytes(op, buf_factor, unresolved,
+                                        f"operand {i}")
+
+    out_elts = _spec_nodes(out_specs)
+    if out_elts is None and out_specs is not None:
+        unresolved.append("out_specs is not a literal list/tuple/BlockSpec")
+        out_elts = []
+    for i, spec in enumerate(out_elts or []):
+        ov = out_vals[i] if i < len(out_vals) else UNKNOWN
+        total += _spec_bytes(
+            spec, ov, interp, env, mod, buf_factor, unresolved,
+            f"out_specs[{i}]",
+        )
+    if out_specs is None:
+        if out_vals:
+            for i, ov in enumerate(out_vals):
+                total += _whole_array_bytes(ov, buf_factor, unresolved,
+                                            f"out_shape[{i}]")
+        else:
+            unresolved.append("out_shape does not fold")
+
+    for j, sc in enumerate(_scratch_nodes(scratch)):
+        total += _scratch_bytes(sc, interp, env, mod, unresolved, j)
+
+    if unresolved:
+        return [Finding(
+            rule="HG502", path=mod.path, line=call.lineno, scope=scope,
+            message=(
+                "VMEM working set of pallas_call is not statically "
+                "resolvable (" + "; ".join(unresolved[:3])
+                + ("; ..." if len(unresolved) > 3 else "")
+                + f"); resolved portion is {_fmt(total)} — make the "
+                "shapes static or verify the budget by hand and add "
+                "`# hglint: disable=HG502` with a runtime guard"
+            ),
+        )]
+    if total > budget:
+        return [Finding(
+            rule="HG501", path=mod.path, line=call.lineno, scope=scope,
+            message=(
+                f"pallas_call VMEM working set {_fmt(total)} exceeds the "
+                f"{_fmt(budget)} per-core budget (double-buffered blocks + "
+                f"scratch); shrink block shapes or re-tile the grid"
+            ),
+        )]
+    return []
+
+
+# ---------------------------------------------------------------- pieces
+
+
+def _spec_nodes(specs) -> Optional[list]:
+    if specs is None:
+        return []
+    if isinstance(specs, (ast.List, ast.Tuple)):
+        return list(specs.elts)
+    if isinstance(specs, ast.Call):
+        return [specs]
+    return None
+
+
+def _scratch_nodes(scratch) -> list:
+    if isinstance(scratch, (ast.List, ast.Tuple)):
+        return list(scratch.elts)
+    if isinstance(scratch, ast.Call):
+        return [scratch]
+    return []
+
+
+def _out_shape_vals(node, interp: Interp, env, mod) -> list:
+    if node is None:
+        return []
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return [interp.eval(e, env, mod) for e in node.elts]
+    return [interp.eval(node, env, mod)]
+
+
+def _memory_space(spec: ast.Call, mod) -> str:
+    for k in spec.keywords:
+        if k.arg == "memory_space":
+            fqn = resolve_fqn(k.value, mod) or ""
+            if fqn.endswith(_OFF_VMEM_TAILS):
+                return "off"
+            return "vmem"
+    return "vmem"
+
+
+def _spec_bytes(spec, op, interp: Interp, env, mod, buf_factor: int,
+                unresolved: list, label: str) -> int:
+    """VMEM bytes of one BlockSpec window (0 for non-VMEM spaces).
+    Appends to ``unresolved`` when the window doesn't fold."""
+    if not isinstance(spec, ast.Call):
+        unresolved.append(f"{label} is not a BlockSpec call")
+        return 0
+    fqn = resolve_fqn(spec.func, mod) or ""
+    if not fqn.endswith("BlockSpec"):
+        unresolved.append(f"{label} is not a BlockSpec")
+        return 0
+    if _memory_space(spec, mod) == "off":
+        return 0
+    block_node = None
+    if spec.args:
+        block_node = spec.args[0]
+    for k in spec.keywords:
+        if k.arg == "block_shape":
+            block_node = k.value
+    op_shape = op.shape if isinstance(op, ShapeDtype) else None
+    dtype = op.dtype if isinstance(op, ShapeDtype) else None
+    if block_node is None:
+        # whole-array window
+        if op_shape is None:
+            unresolved.append(f"{label} has no block_shape and the operand "
+                              f"shape does not fold")
+            return 0
+        dims = op_shape
+    else:
+        block = interp.eval(block_node, env, mod)
+        if not isinstance(block, tuple):
+            unresolved.append(f"{label} block_shape does not fold")
+            return 0
+        dims = []
+        for d, b in enumerate(block):
+            if b is None:  # None dim = full array dim
+                b = op_shape[d] if op_shape is not None and \
+                    d < len(op_shape) else UNKNOWN
+            dims.append(b)
+        dims = tuple(dims)
+    if not all(isinstance(d, int) for d in dims):
+        unresolved.append(f"{label} block dim does not fold to an int")
+        return 0
+    return _tile_padded_bytes(dims, dtype) * buf_factor
+
+
+def _whole_array_bytes(op, buf_factor: int, unresolved: list,
+                       label: str) -> int:
+    if not isinstance(op, ShapeDtype) or op.shape is None or \
+            not all(isinstance(d, int) for d in op.shape):
+        unresolved.append(f"{label} shape does not fold (unblocked arrays "
+                          f"land in VMEM whole)")
+        return 0
+    return _tile_padded_bytes(op.shape, op.dtype) * buf_factor
+
+
+def _scratch_bytes(sc, interp: Interp, env, mod, unresolved: list,
+                   j: int) -> int:
+    if not isinstance(sc, ast.Call):
+        unresolved.append(f"scratch_shapes[{j}] is not a call")
+        return 0
+    fqn = resolve_fqn(sc.func, mod) or ""
+    if "SemaphoreType" in fqn or fqn.endswith(".SMEM"):
+        return 0
+    if not fqn.endswith(_VMEM_TAILS):
+        unresolved.append(f"scratch_shapes[{j}] `{fqn}` is not recognized")
+        return 0
+    dims = interp.eval(sc.args[0], env, mod) if sc.args else UNKNOWN
+    dtype = interp.dtype_of(
+        sc.args[1] if len(sc.args) > 1 else None, env, mod
+    )
+    if not isinstance(dims, tuple) or \
+            not all(isinstance(d, int) for d in dims):
+        unresolved.append(f"scratch_shapes[{j}] shape does not fold")
+        return 0
+    return _tile_padded_bytes(dims, dtype)
+
+
+def _tile_padded_bytes(dims: tuple, dtype: Optional[str]) -> int:
+    """Physical VMEM footprint: the last dim pads to the 128-lane tile and
+    the second-to-last to the dtype's sublane multiple, matching Mosaic's
+    tiled layout (a (1, 1, 128) int32 block really occupies (1, 8, 128))."""
+    eb = element_bytes(dtype)
+    sublane = DTYPE_SUBLANE.get(dtype or "", 8)
+    dims = list(dims)
+    if len(dims) >= 1:
+        dims[-1] = -(-dims[-1] // LANE) * LANE
+    if len(dims) >= 2:
+        dims[-2] = -(-dims[-2] // sublane) * sublane
+    n = 1
+    for d in dims:
+        n *= max(d, 1)
+    return n * eb
+
+
+def _fmt(n: int) -> str:
+    if n >= 1 << 20:
+        return f"{n / (1 << 20):.1f} MiB"
+    if n >= 1 << 10:
+        return f"{n / (1 << 10):.1f} KiB"
+    return f"{n} B"

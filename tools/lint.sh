@@ -3,10 +3,24 @@
 # baseline (tools/hglint/baseline.json). Tier-1 enforces the same check via
 # tests/test_hglint.py::test_repo_gate_passes_with_baseline.
 #
+# Exit codes: 0 clean · 1 new findings · >= 2 analyzer crash / usage error
+# (a crash is an infrastructure failure, NOT a finding — CI must fail it
+# loudly instead of reporting "1 finding").
+#
+# Every diagnostic carries its rule-family docs anchor
+# (e.g. "[README.md#hg5xx-vmem-budgets]") — see the README rule table.
+#
 # Usage: tools/lint.sh [extra hglint args]
 #   tools/lint.sh --severity error     # only hard errors
-#   tools/lint.sh --json               # machine-readable output
-set -euo pipefail
+#   tools/lint.sh --only HG5           # one rule family, fast local run
+#   tools/lint.sh --output json        # machine-readable CI report
+set -uo pipefail
 cd "$(dirname "$0")/.."
-exec python -m tools.hglint hypergraphdb_tpu \
+python -m tools.hglint hypergraphdb_tpu \
     --baseline tools/hglint/baseline.json "$@"
+rc=$?
+if [ "$rc" -ge 2 ]; then
+    echo "tools/lint.sh: hglint analyzer crashed (exit $rc);" \
+         "fix the analyzer before trusting this gate" >&2
+fi
+exit "$rc"
