@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from hypergraphdb_tpu import verify as hgverify
 from hypergraphdb_tpu.ops.snapshot import CSRSnapshot, DeviceSnapshot
 
 WORD = 32
@@ -138,6 +139,11 @@ class PackedBFSResult(NamedTuple):
     levels: Optional[jax.Array]  # (K, M) int8 or None — hop distance, -1 unreached
 
 
+@hgverify.entry(
+    shapes=lambda: (hgverify.dev_snapshot_exemplar(),
+                    hgverify.sds((8,), "int32")),
+    statics={"max_hops": 2, "edge_chunk": 64, "with_levels": False},
+)
 @partial(
     jax.jit,
     static_argnames=("max_hops", "edge_chunk", "with_levels"),

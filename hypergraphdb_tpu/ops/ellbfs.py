@@ -50,6 +50,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from hypergraphdb_tpu import verify as hgverify
 from hypergraphdb_tpu.ops import pallas_gather as _pg
 from hypergraphdb_tpu.ops.snapshot import CSRSnapshot
 
@@ -603,6 +604,11 @@ def _bitdot(packed_t: jax.Array, vec: jax.Array, block_rows: int) -> jax.Array:
 # partition visited, so Σdeg(frontier_h) = S_h − S_{h-1}.
 
 
+@hgverify.entry(
+    shapes=lambda: (hgverify.sds((32,), "int32"),
+                    hgverify.sds((), "int32")),
+    statics={"n_pad": 64},
+)
 @partial(jax.jit, static_argnames=("n_pad",))
 def _seed_bitmap(seeds: jax.Array, n_atoms: jax.Array, n_pad: int):
     K = seeds.shape[0]
@@ -623,6 +629,10 @@ def _bitdot_rows(K: int, n_pad: int) -> int:
                          _ceil_to(n_pad, 8) // 8))
 
 
+@hgverify.entry(
+    shapes=lambda: (hgverify.sds((64, 1), "uint32"),
+                    hgverify.sds((64,), "float32")),
+)
 @jax.jit
 def _deg_sum(visited: jax.Array, deg_f: jax.Array) -> jax.Array:
     """S = Σ_v visited[v]·deg(v) per seed. Bounded by E_inc < 2^31 so
@@ -632,6 +642,11 @@ def _deg_sum(visited: jax.Array, deg_f: jax.Array) -> jax.Array:
                    _bitdot_rows(visited.shape[1] * WORD, visited.shape[0]))
 
 
+@hgverify.entry(
+    shapes=lambda: (hgverify.sds((64, 1), "uint32"),
+                    (hgverify.sds((64,), "int32"),)),
+    statics={"widths": (8,), "chunk": 1 << 19, "use_pallas": False},
+)
 @partial(jax.jit, static_argnames=("widths", "chunk", "use_pallas"))
 def _stage(values, levels, widths, chunk, use_pallas):
     return _apply_plan(values, levels, widths, chunk, use_pallas)
@@ -665,6 +680,13 @@ def _stage_upper(lvl0, levels, widths, chunk):
     return _upper_levels(buf, levels, widths[1:], sizes, n0, chunk)
 
 
+@hgverify.entry(
+    shapes=lambda: (hgverify.sds((64, 1), "uint32"),
+                    hgverify.sds((9, 1), "uint32"),
+                    hgverify.sds((64,), "int32"),
+                    hgverify.sds((), "int32")),
+    donate=True,
+)
 @partial(jax.jit, donate_argnums=(0,))  # visited aliases the output
 def _visited_update(visited, reach_chunks, out_map, n_atoms):
     """visited | reach_chunks[out_map], folded in row blocks so no second
@@ -693,6 +715,7 @@ def _visited_update(visited, reach_chunks, out_map, n_atoms):
     return nxt.at[n_atoms].set(jnp.uint32(0))
 
 
+@hgverify.entry(shapes=lambda: (hgverify.sds((64, 1), "uint32"),))
 @jax.jit
 def _reach_counts(visited: jax.Array) -> jax.Array:
     n_pad = visited.shape[0]

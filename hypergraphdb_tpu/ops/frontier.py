@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from hypergraphdb_tpu import verify as hgverify
 from hypergraphdb_tpu.ops.snapshot import CSRSnapshot, DeviceSnapshot
 
 def expand_frontier(dev: DeviceSnapshot, frontier: jax.Array) -> jax.Array:
@@ -47,6 +48,11 @@ def expand_frontier(dev: DeviceSnapshot, frontier: jax.Array) -> jax.Array:
     return jax.vmap(one)(frontier)
 
 
+@hgverify.entry(
+    shapes=lambda: (hgverify.dev_snapshot_exemplar(),
+                    hgverify.sds((8,), "int32")),
+    statics={"max_hops": 2},
+)
 @partial(jax.jit, static_argnames=("max_hops",))
 def bfs_levels(
     dev: DeviceSnapshot, seeds: jax.Array, max_hops: int
@@ -77,6 +83,11 @@ def bfs_levels(
     return levels, visited
 
 
+@hgverify.entry(
+    shapes=lambda: (hgverify.dev_snapshot_exemplar(),
+                    hgverify.sds((), "int32")),
+    statics={"max_hops": 2},
+)
 @partial(jax.jit, static_argnames=("max_hops",))
 def reachable(dev: DeviceSnapshot, seed: jax.Array, max_hops: int) -> jax.Array:
     """Single-seed reachability bitmap (N+1,)."""
@@ -103,6 +114,11 @@ def bfs_reachable_host(
     return out
 
 
+@hgverify.entry(
+    shapes=lambda: (hgverify.dev_snapshot_exemplar(),
+                    hgverify.sds((8,), "int32")),
+    statics={"max_hops": 2},
+)
 @partial(jax.jit, static_argnames=("max_hops",))
 def frontier_edge_counts(
     dev: DeviceSnapshot, seeds: jax.Array, max_hops: int

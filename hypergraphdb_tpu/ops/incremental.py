@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from hypergraphdb_tpu import verify as hgverify
 from hypergraphdb_tpu.core import events as ev
 from hypergraphdb_tpu.ops.frontier import expand_frontier
 from hypergraphdb_tpu.ops.setops import _bucket
@@ -80,6 +81,12 @@ def expand_frontier_delta(
     return jax.vmap(one)(frontier)
 
 
+@hgverify.entry(
+    shapes=lambda: (hgverify.dev_snapshot_exemplar(),
+                    hgverify.device_delta_exemplar(),
+                    hgverify.sds((8,), "int32")),
+    statics={"max_hops": 2, "with_levels": True},
+)
 @partial(jax.jit, static_argnames=("max_hops", "with_levels"))
 def bfs_levels_delta(
     dev: DeviceSnapshot, delta: DeviceDelta, seeds: jax.Array, max_hops: int,

@@ -46,6 +46,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from hypergraphdb_tpu import verify as hgverify
+
 try:  # DMA priorities landed after 0.4.x; harmless to drop when absent
     import inspect
 
@@ -55,8 +57,20 @@ try:  # DMA priorities landed after 0.4.x; harmless to drop when absent
 except Exception:  # pragma: no cover - defensive: API moved
     _COPY_PRIORITY = False
 
+#: per-core SMEM budget the scalar-prefetched index segment must fit
+#: (matches hglint HG503's model of PrefetchScalarGridSpec operands)
+SMEM_BUDGET = 1 << 20
 #: indices per pallas_call: 512 KB of the 1 MB SMEM budget
 SEG = 1 << 17
+# import-time twin of the hglint HG503 contract: one int32 index segment
+# must leave SMEM headroom for Mosaic's own scalar state — a SEG bump that
+# blows the budget should fail here, not in opaque Mosaic allocation
+# (a real raise, not an assert: the guard must survive `python -O`)
+if SEG * 4 > SMEM_BUDGET // 2:
+    raise ValueError(
+        "pallas_gather.SEG: scalar-prefetch segment exceeds half the "
+        "SMEM budget"
+    )
 #: output chunks per grid step
 G = 256
 #: in-flight DMA slots (D*w outstanding row copies)
@@ -141,6 +155,11 @@ def _call(seg_idx: jax.Array, values: jax.Array, w: int,
     )(seg_idx, values)
 
 
+@hgverify.entry(
+    shapes=lambda: (hgverify.sds((8, 128), "uint32"),
+                    hgverify.sds((2048,), "int32")),
+    statics={"w": 8, "interpret": True},
+)
 def gather_or(values: jax.Array, idx: jax.Array, w: int,
               interpret: bool = False) -> jax.Array:
     """``OR over groups of w``: returns ``(len(idx)//w, Kw)`` uint32 where

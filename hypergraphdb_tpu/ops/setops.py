@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from hypergraphdb_tpu import verify as hgverify
 from hypergraphdb_tpu.ops.snapshot import CSRSnapshot, DeviceSnapshot
 
 SENTINEL = np.int32(np.iinfo(np.int32).max)
@@ -44,6 +45,10 @@ def _bucket(n: int, minimum: int = 128) -> int:
 # ------------------------------------------------------------------ 1-D ops
 
 
+@hgverify.entry(
+    shapes=lambda: (hgverify.sds((16,), "int32"),
+                    hgverify.sds((16,), "int32")),
+)
 @jax.jit
 def member_mask(sorted_ref: jax.Array, queries: jax.Array) -> jax.Array:
     """queries ∈ sorted_ref, elementwise. Both may be SENTINEL-padded."""
@@ -68,6 +73,12 @@ def intersect_mask_many(base: jax.Array, others: jax.Array) -> jax.Array:
 # ------------------------------------------------------------------ segment search
 
 
+@hgverify.entry(
+    shapes=lambda: (hgverify.sds((64,), "int32"),
+                    hgverify.sds((4,), "int32"),
+                    hgverify.sds((4,), "int32"),
+                    hgverify.sds((4, 8), "int32")),
+)
 @jax.jit
 def segment_member_mask(
     flat: jax.Array,     # (E,) — concatenated sorted segments (CSR payload)
@@ -197,6 +208,12 @@ def ell_targets(snap: CSRSnapshot):
     return dev
 
 
+@hgverify.entry(
+    shapes=lambda: (hgverify.dev_snapshot_exemplar(),
+                    hgverify.sds((32, 4), "int32"),
+                    hgverify.sds((4, 2), "int32")),
+    statics={"pad_len": 8},
+)
 @partial(jax.jit, static_argnames=("pad_len",))
 def incident_intersection_ell(
     dev: DeviceSnapshot,
@@ -240,6 +257,11 @@ def gather_rows(
     return rows, valid
 
 
+@hgverify.entry(
+    shapes=lambda: (hgverify.dev_snapshot_exemplar(),
+                    hgverify.sds((4, 2), "int32")),
+    statics={"pad_len": 8},
+)
 @partial(jax.jit, static_argnames=("pad_len",))
 def incident_intersection(
     dev: DeviceSnapshot,
@@ -323,6 +345,19 @@ def incident_value_pattern(
     return rows0, mask & strict, mask & eq
 
 
+@hgverify.entry(
+    shapes=lambda: (
+        (hgverify.dev_snapshot_exemplar(),
+         hgverify.sds((32, 4), "int32"),
+         hgverify.sds((4, 2), "int32")),
+        {"kind": hgverify.sds((), "uint8"),
+         "lo_hi": hgverify.sds((), "uint32"),
+         "lo_lo": hgverify.sds((), "uint32"),
+         "hi_hi": hgverify.sds((), "uint32"),
+         "hi_lo": hgverify.sds((), "uint32")},
+    ),
+    statics={"pad_len": 8, "lo_op": "gte", "hi_op": "lt", "exact": True},
+)
 @partial(jax.jit, static_argnames=("pad_len", "lo_op", "hi_op", "exact"))
 def incident_value_range(
     dev: DeviceSnapshot,

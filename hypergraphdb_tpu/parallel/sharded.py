@@ -54,6 +54,7 @@ _SHARD_MAP_KW = (
     else MappingProxyType({"check_rep": False})
 )
 
+from hypergraphdb_tpu import verify as hgverify
 from hypergraphdb_tpu.ops.bitfrontier import (
     WORD,
     _scatter_relation,
@@ -208,6 +209,12 @@ def _scatter_local(src, dst, f_full_packed, n_loc, edge_chunk, count):
     )
 
 
+@hgverify.entry(
+    shapes=lambda: (hgverify.sharded_snapshot_exemplar(),
+                    hgverify.sds((32,), "int32")),
+    statics={"max_hops": 2},
+    mesh=(AXIS,),
+)
 @partial(jax.jit, static_argnames=("max_hops", "with_levels"))
 def bfs_packed_sharded(
     sdev: ShardedSnapshot,
@@ -626,6 +633,12 @@ def bfs_levels_sharded(
 # sharded conjunctive pattern match: candidate-parallel membership filter
 # --------------------------------------------------------------------------
 
+@hgverify.entry(
+    shapes=lambda: (hgverify.sharded_snapshot_exemplar(),
+                    hgverify.sds((64,), "int32"),
+                    hgverify.sds((2, 16), "int32")),
+    mesh=(AXIS,),
+)
 @jax.jit
 def match_candidates_sharded(
     sdev: ShardedSnapshot,

@@ -1,0 +1,214 @@
+"""Entry-point registry for jaxpr-level verification (``tools/hgverify``).
+
+The kernels in ``ops/`` and ``parallel/`` publish their public jitted
+entry points here with *shape exemplars* — small ``ShapeDtypeStruct``
+pytrees a verifier can trace under ``JAX_PLATFORMS=cpu`` to obtain the
+ground-truth jaxpr/HLO of what actually runs on the TPU. The decorator is
+non-invasive: it records the function in a registry and returns it
+UNCHANGED (no wrapper, no import-time tracing — exemplar builders are
+zero-arg callables evaluated only when a verifier harvests them).
+
+Usage, at a kernel definition site::
+
+    from hypergraphdb_tpu import verify as hgverify
+
+    @hgverify.entry(shapes=lambda: (hgverify.sds((8, 128), "uint32"),))
+    @jax.jit
+    def my_kernel(x): ...
+
+Registered metadata feeds four verification families (see
+``tools/hgverify``): HV1xx traced-graph purity (no host callbacks), HV2xx
+collective/mesh consistency (``mesh=`` declares the deployment mesh axis
+names the entry's collectives must match), HV3xx donation contracts
+(``donate=True`` declares that the entry donates buffers), HV4xx static
+cost budgets (FLOPs / bytes accessed / peak temp vs
+``tools/hgverify/costs.json``).
+
+This module deliberately imports nothing heavy at module scope so the
+registry is importable from both the product package and the tools tree.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
+
+
+@dataclass(frozen=True)
+class Entry:
+    """One registered verification entry point."""
+
+    name: str                      # registry key, e.g. "ops.frontier.bfs_levels"
+    fn: Callable                   # the (possibly jitted) callable, unchanged
+    shapes: Callable               # () -> tuple of exemplar args (SDS pytrees)
+    statics: dict                  # static kwargs bound before tracing
+    mesh: Optional[tuple]          # declared deployment mesh axis names
+    donate: bool                   # entry declares buffer donation
+    path: str                      # source file of the underlying function
+    line: int                      # first line of the underlying function
+
+
+class Registry:
+    """Ordered, name-keyed entry collection. The module-level
+    :data:`REGISTRY` holds the production entries; tests build private
+    registries so fixture entries never pollute the cost-budget gate."""
+
+    def __init__(self):
+        self._entries: dict[str, Entry] = {}
+
+    def entry(self, name: Optional[str] = None, *,
+              shapes: Callable,
+              statics: Optional[dict] = None,
+              mesh: Optional[Sequence[str]] = None,
+              donate: bool = False):
+        """Decorator registering ``fn`` under ``name`` (default: the
+        function's ``<module-tail>.<qualname>``). Returns ``fn`` as-is."""
+
+        def deco(fn):
+            path, line = _source_of(fn)
+            key = name or _default_name(fn)
+            if key in self._entries:
+                raise ValueError(f"hgverify entry {key!r} registered twice")
+            self._entries[key] = Entry(
+                name=key, fn=fn, shapes=shapes,
+                statics=dict(statics or {}),
+                mesh=tuple(mesh) if mesh is not None else None,
+                donate=bool(donate), path=path, line=line,
+            )
+            return fn
+
+        return deco
+
+    def names(self) -> list:
+        return list(self._entries)
+
+    def get(self, name: str) -> Entry:
+        return self._entries[name]
+
+    def __iter__(self):
+        return iter(self._entries.values())
+
+    def __len__(self):
+        return len(self._entries)
+
+
+#: the production registry ``tools/hgverify`` harvests
+REGISTRY = Registry()
+
+#: module-level decorator bound to the production registry, so kernel
+#: modules spell ``@hgverify.entry(shapes=...)``
+entry = REGISTRY.entry
+
+
+def _unwrap(fn):
+    """Innermost wrapped function — jit/partial wrappers carry
+    ``__wrapped__``/``func`` chains back to real code."""
+    seen = 0
+    while seen < 8:
+        nxt = getattr(fn, "__wrapped__", None) or getattr(fn, "func", None)
+        if nxt is None or nxt is fn:
+            break
+        fn = nxt
+        seen += 1
+    return fn
+
+
+def _source_of(fn) -> tuple:
+    code = getattr(_unwrap(fn), "__code__", None)
+    if code is None:
+        return "<unknown>", 0
+    return code.co_filename, code.co_firstlineno
+
+
+def _default_name(fn) -> str:
+    inner = _unwrap(fn)
+    mod = getattr(inner, "__module__", "") or ""
+    tail = mod.split("hypergraphdb_tpu.")[-1] if mod else "<mod>"
+    return f"{tail}.{getattr(inner, '__qualname__', repr(inner))}"
+
+
+# ---------------------------------------------------------------- exemplars
+#
+# Shared builders for the shape exemplars kernel modules register. All jax
+# imports are deferred: nothing here touches a backend until a verifier
+# actually evaluates a ``shapes=`` callable.
+
+
+def sds(shape, dtype):
+    """``jax.ShapeDtypeStruct`` shorthand for exemplar tuples."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.ShapeDtypeStruct(tuple(shape), jnp.dtype(dtype))
+
+
+def dev_snapshot_exemplar(n_atoms: int = 31, e_inc: int = 64,
+                          e_tgt: int = 64):
+    """A :class:`ops.snapshot.DeviceSnapshot` pytree of abstract leaves —
+    31 atoms + the dummy row, 64-entry edge relations. Small enough that
+    every traced program compiles in milliseconds on CPU."""
+    from hypergraphdb_tpu.ops.snapshot import DeviceSnapshot
+
+    n1 = n_atoms + 1
+    return DeviceSnapshot(
+        num_atoms=n_atoms,
+        inc_offsets=sds((n1 + 1,), "int32"),
+        inc_links=sds((e_inc,), "int32"),
+        inc_src=sds((e_inc,), "int32"),
+        tgt_offsets=sds((n1 + 1,), "int32"),
+        tgt_flat=sds((e_tgt,), "int32"),
+        tgt_src=sds((e_tgt,), "int32"),
+        type_of=sds((n1,), "int32"),
+        is_link=sds((n1,), "bool"),
+        arity=sds((n1,), "int32"),
+        value_rank_hi=sds((n1,), "uint32"),
+        value_rank_lo=sds((n1,), "uint32"),
+        value_kind=sds((n1,), "uint8"),
+    )
+
+
+def device_delta_exemplar(n_atoms: int = 31, d: int = 16):
+    """A :class:`ops.incremental.DeviceDelta` overlay exemplar matching
+    :func:`dev_snapshot_exemplar`'s id space."""
+    from hypergraphdb_tpu.ops.incremental import DeviceDelta
+
+    return DeviceDelta(
+        inc_links=sds((d,), "int32"),
+        inc_src=sds((d,), "int32"),
+        tgt_flat=sds((d,), "int32"),
+        tgt_src=sds((d,), "int32"),
+        dead=sds((n_atoms + 1,), "bool"),
+    )
+
+
+def sharded_snapshot_exemplar(n_loc: int = 128, e_loc: int = 64):
+    """A :class:`parallel.sharded.ShardedSnapshot` over the available CPU
+    devices (capped at 8 — the count ``tools/verify.sh`` and the test
+    harness force via ``xla_force_host_platform_device_count``). Edge/row
+    arrays are abstract; only the mesh itself is concrete (shard_map needs
+    a real Mesh object to trace, not real data)."""
+    import jax
+    import numpy as np
+
+    from hypergraphdb_tpu.parallel.sharded import ShardedSnapshot
+    from jax.sharding import Mesh
+
+    devices = jax.devices()[:8]
+    mesh = Mesh(np.asarray(devices), ("shard",))
+    n_dev = len(devices)
+    n_pad = n_dev * n_loc
+    return ShardedSnapshot(
+        mesh=mesh,
+        num_atoms=n_pad - 28,     # a ragged tail exercises the valid mask
+        n_loc=n_loc,
+        edge_chunk=e_loc,
+        inc_src=sds((n_dev * e_loc,), "int32"),
+        inc_dst=sds((n_dev * e_loc,), "int32"),
+        tgt_src=sds((n_dev * e_loc,), "int32"),
+        tgt_dst=sds((n_dev * e_loc,), "int32"),
+        type_of=sds((n_pad,), "int32"),
+        is_link=sds((n_pad,), "bool"),
+        arity=sds((n_pad,), "int32"),
+        value_rank_hi=sds((n_pad,), "uint32"),
+        value_rank_lo=sds((n_pad,), "uint32"),
+    )

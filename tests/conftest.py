@@ -27,6 +27,14 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 gate (`-m 'not slow'`); "
+        "full-fidelity end-to-end runs",
+    )
+
+
 @pytest.fixture
 def graph():
     from hypergraphdb_tpu import HyperGraph

@@ -1,0 +1,65 @@
+"""Seeded HG604 hazard — lax.cond branches with mismatched collectives."""
+
+import jax
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+AXIS = "data"
+
+
+def _with_psum(x):
+    return jax.lax.psum(x, AXIS)
+
+
+def _without_psum(x):
+    return x * 2
+
+
+def _cond_body(x, flag):
+    # HG604: the true branch issues a psum, the false branch none — the
+    # cond traces fine, but devices whose flags disagree deadlock
+    return jax.lax.cond(flag, _with_psum, _without_psum, x)
+
+
+def run_cond_mismatch(x, flag):
+    mesh = Mesh(np.array(jax.devices()), (AXIS,))
+    return shard_map(
+        _cond_body, mesh=mesh, in_specs=(P(AXIS), P()), out_specs=P(AXIS)
+    )(x, flag)
+
+
+def _hidden_psum(x):
+    return _with_psum(x)   # the collective hides one call deep
+
+
+def _helper_body(x, flag):
+    # HG604 through a helper: the true branch's psum is routed through
+    # `_hidden_psum`; the false branch issues none — the one-level-deep
+    # scan must still see the mismatch
+    return jax.lax.cond(flag, _hidden_psum, _without_psum, x)
+
+
+def run_helper_mismatch(x, flag):
+    mesh = Mesh(np.array(jax.devices()), (AXIS,))
+    return shard_map(
+        _helper_body, mesh=mesh, in_specs=(P(AXIS), P()),
+        out_specs=P(AXIS),
+    )(x, flag)
+
+
+def _switch_body(x, which):
+    # HG604 via switch: branch collectives disagree on axis spelling
+    return jax.lax.switch(
+        which,
+        [lambda v: jax.lax.psum(v, AXIS), lambda v: jax.lax.pmax(v, AXIS)],
+        x,
+    )
+
+
+def run_switch_mismatch(x, which):
+    mesh = Mesh(np.array(jax.devices()), (AXIS,))
+    return shard_map(
+        _switch_body, mesh=mesh, in_specs=(P(AXIS), P()),
+        out_specs=P(AXIS),
+    )(x, which)

@@ -1,0 +1,46 @@
+"""Seeded HG501 hazards only foldable THROUGH scan/vmap wrappers.
+
+Both pallas_call sites use ``None`` block dims, so the budget needs the
+operand's shape — which only exists if the interpreter propagates
+``ShapeDtype`` through the ``lax.scan`` carry / ``jax.vmap`` result.
+Before that propagation these sites degraded to HG502 (unresolvable);
+now they fold and the overflow is caught as the error it is.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _copy(x_ref, o_ref):
+    o_ref[:] = x_ref[:]
+
+
+def scan_carried_overflow(xs):
+    # carry keeps the init's (4096, 2048) f32 shape through the scan; the
+    # None block dims then fold to 32 MiB double-buffered in-window alone
+    big = jnp.zeros((4096, 2048), jnp.float32)
+    big, _ = jax.lax.scan(lambda c, x: (c, x), big, xs)
+    return pl.pallas_call(
+        _copy,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((None, None), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),
+    )(big)
+
+
+def _tile(row):
+    return jnp.zeros((4096, 2048), jnp.float32)
+
+
+def vmap_result_overflow():
+    rows = jnp.zeros((4, 16), jnp.float32)
+    tiles = jax.vmap(_tile)(rows)   # (4, 4096, 2048) via the fold
+    return pl.pallas_call(
+        _copy,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((1, None, None), lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),
+    )(tiles)

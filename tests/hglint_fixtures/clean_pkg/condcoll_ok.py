@@ -1,0 +1,89 @@
+"""Clean twin of condcoll_bad — branches issue IDENTICAL collectives."""
+
+import jax
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+AXIS = "data"
+
+
+def _scaled_psum(x):
+    return jax.lax.psum(x * 2, AXIS)
+
+
+def _plain_psum(x):
+    return jax.lax.psum(x, AXIS)
+
+
+def _cond_body(x, flag):
+    # both branches run one psum over the same axis: every device issues
+    # the same collective sequence regardless of its flag — no finding
+    return jax.lax.cond(flag, _scaled_psum, _plain_psum, x)
+
+
+def run_cond_matched(x, flag):
+    mesh = Mesh(np.array(jax.devices()), (AXIS,))
+    return shard_map(
+        _cond_body, mesh=mesh, in_specs=(P(AXIS), P()), out_specs=P(AXIS)
+    )(x, flag)
+
+
+def _via_helper(x):
+    return _plain_psum(x)   # identical collective, one call deep
+
+
+def _helper_body(x, flag):
+    # one branch psums directly, the other routes the SAME psum through a
+    # helper — the branch comparison must follow the call and stay silent
+    return jax.lax.cond(flag, _plain_psum, _via_helper, x)
+
+
+def run_helper_matched(x, flag):
+    mesh = Mesh(np.array(jax.devices()), (AXIS,))
+    return shard_map(
+        _helper_body, mesh=mesh, in_specs=(P(AXIS), P()),
+        out_specs=P(AXIS),
+    )(x, flag)
+
+
+_REDUCERS = (_plain_psum,)
+
+
+def _opaque_body(x, flag):
+    # one branch psums directly, the other dispatches the SAME psum
+    # through a tuple subscript — an opaque callable the scan cannot
+    # resolve, so the comparison must be VOIDED (silence over guessing),
+    # not reported as a mismatch against an empty branch
+    return jax.lax.cond(
+        flag, _plain_psum, lambda v: _REDUCERS[0](v), x
+    )
+
+
+def run_opaque_matched(x, flag):
+    mesh = Mesh(np.array(jax.devices()), (AXIS,))
+    return shard_map(
+        _opaque_body, mesh=mesh, in_specs=(P(AXIS), P()),
+        out_specs=P(AXIS),
+    )(x, flag)
+
+
+def _helper_with_opaque(x):
+    # the helper ALSO psums, but routes part of its work through an
+    # opaque subscript call — the scan cannot prove this helper's
+    # collective multiset, so the whole comparison must void, not read
+    # the helper as an empty arm against the direct psum
+    y = _REDUCERS[0](x)
+    return jax.lax.psum(y, AXIS)
+
+
+def _opaque_in_helper_body(x, flag):
+    return jax.lax.cond(flag, _plain_psum, _helper_with_opaque, x)
+
+
+def run_opaque_in_helper(x, flag):
+    mesh = Mesh(np.array(jax.devices()), (AXIS,))
+    return shard_map(
+        _opaque_in_helper_body, mesh=mesh, in_specs=(P(AXIS), P()),
+        out_specs=P(AXIS),
+    )(x, flag)

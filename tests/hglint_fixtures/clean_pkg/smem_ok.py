@@ -1,0 +1,26 @@
+"""Clean twin of smem_bad — scalar-prefetch segment fits SMEM."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _gather_kernel(idx_ref, x_ref, o_ref):
+    o_ref[:] = x_ref[:]
+
+
+def smem_fits(x):
+    # (1 << 17,) int32 = 512 KB of the 1 MB SMEM — the pallas_gather SEG
+    # contract; no finding
+    idx = jnp.zeros((1 << 17,), jnp.int32)
+    return pl.pallas_call(
+        _gather_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(4,),
+            in_specs=[pl.BlockSpec((8, 128), lambda i, s: (i, 0))],
+            out_specs=pl.BlockSpec((8, 128), lambda i, s: (i, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),
+    )(idx, x)

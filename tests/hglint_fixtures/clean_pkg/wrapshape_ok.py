@@ -1,0 +1,43 @@
+"""Clean twin of wrapshape_bad — scan/vmap-folded shapes fit the budget.
+
+The point: these operands fold ONLY through the scan-carry / vmap-result
+propagation. If that propagation regressed, these sites would degrade to
+HG502 (unresolvable) and fail the clean sweep — the fixture pins the
+fold, not just the absence of an overflow.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _copy(x_ref, o_ref):
+    o_ref[:] = x_ref[:]
+
+
+def scan_carried_fits(xs):
+    small = jnp.zeros((64, 256), jnp.float32)
+    small, _ = jax.lax.scan(lambda c, x: (c, x), small, xs)
+    return pl.pallas_call(
+        _copy,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((None, None), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),
+    )(small)
+
+
+def _tile(row):
+    return jnp.zeros((64, 256), jnp.float32)
+
+
+def vmap_result_fits():
+    rows = jnp.zeros((4, 16), jnp.float32)
+    tiles = jax.vmap(_tile)(rows)   # (4, 64, 256) via the fold
+    return pl.pallas_call(
+        _copy,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((1, None, None), lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),
+    )(tiles)

@@ -1,0 +1,184 @@
+"""Fixture entry points for hgverify precision tests.
+
+``build_bad_registry()`` seeds at least one finding in every HV rule
+family on private :class:`hypergraphdb_tpu.verify.Registry` objects;
+``build_clean_registry()`` holds the clean twins, which must verify
+silent (HV4xx coverage is exercised separately through a temp costs
+file). Private registries keep fixture entries out of the production
+cost-budget gate.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from hypergraphdb_tpu.verify import Registry, sds
+
+AX = "shard"
+
+
+def _mesh(axis=AX):
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()[:8]), (axis,))
+
+
+def build_bad_registry() -> Registry:
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import io_callback
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    R = Registry()
+
+    # -- HV100: exemplars that cannot trace -----------------------------------
+    def _boom():
+        raise ValueError("fixture exemplar explosion")
+
+    @R.entry(name="fix.trace_fail", shapes=_boom)
+    def trace_fail(x):
+        return x
+
+    # -- HV101/102/103: host callbacks inside the traced graph ----------------
+    @R.entry(name="fix.pure_cb", shapes=lambda: (sds((8,), "float32"),))
+    @jax.jit
+    def pure_cb(x):
+        y = jax.pure_callback(
+            lambda a: np.asarray(a), jax.ShapeDtypeStruct((8,), np.float32),
+            x,
+        )
+        return y * 2
+
+    @R.entry(name="fix.io_cb", shapes=lambda: (sds((8,), "float32"),))
+    @jax.jit
+    def io_cb(x):
+        io_callback(lambda a: None, None, x)
+        return x + 1
+
+    @R.entry(name="fix.debug_cb", shapes=lambda: (sds((8,), "float32"),))
+    @jax.jit
+    def debug_cb(x):
+        jax.debug.print("x sum {}", x.sum())
+        return x + 1
+
+    # -- HV201: collective axis vs the DECLARED deployment mesh ---------------
+    @R.entry(name="fix.ghost_axis", shapes=lambda: (sds((8,), "float32"),),
+             mesh=("rows",))
+    def ghost_axis(x):
+        return shard_map(
+            lambda v: jax.lax.psum(v, AX),
+            mesh=_mesh(AX), in_specs=(P(AX),), out_specs=P(),
+            check_rep=False,
+        )(x)
+
+    # -- HV202: cond branches with mismatched collectives ---------------------
+    @R.entry(name="fix.cond_mismatch",
+             shapes=lambda: (sds((8,), "float32"),), mesh=(AX,))
+    def cond_mismatch(x):
+        def body(v):
+            return jax.lax.cond(
+                v[0] > 0,
+                lambda u: jax.lax.psum(u, AX),
+                lambda u: u * 2,
+                v,
+            )
+
+        return shard_map(
+            body, mesh=_mesh(AX), in_specs=(P(AX),), out_specs=P(AX),
+            check_rep=False,
+        )(x)
+
+    # -- HV203: collectives with no declared mesh -----------------------------
+    @R.entry(name="fix.undeclared_mesh",
+             shapes=lambda: (sds((8,), "float32"),))
+    def undeclared_mesh(x):
+        return shard_map(
+            lambda v: jax.lax.psum(v, AX),
+            mesh=_mesh(AX), in_specs=(P(AX),), out_specs=P(),
+            check_rep=False,
+        )(x)
+
+    # -- HV301: donation with no matching output ------------------------------
+    _shrink = jax.jit(lambda x: x[:4] * 2, donate_argnums=(0,))
+
+    @R.entry(name="fix.donate_unusable",
+             shapes=lambda: (sds((8,), "float32"),), donate=True)
+    def donate_unusable(x):
+        return _shrink(x)   # (4,) output cannot reuse the (8,) buffer
+
+    # -- HV302: donated buffer aliased into two outputs -----------------------
+    _twice = jax.jit(lambda x: (x, x), donate_argnums=(0,))
+
+    @R.entry(name="fix.donate_twice",
+             shapes=lambda: (sds((8,), "float32"),), donate=True)
+    def donate_twice(x):
+        return _twice(x)
+
+    # -- HV303: declared donation the traced jit does not perform -------------
+    @R.entry(name="fix.donate_lost",
+             shapes=lambda: (sds((8,), "float32"),), donate=True)
+    @jax.jit
+    def donate_lost(x):
+        return x + 1
+
+    # -- HV4xx probe: budget drift/coverage is driven by the test's costs file
+    @R.entry(name="fix.cost_probe", shapes=lambda: (sds((64,), "float32"),))
+    @jax.jit
+    def cost_probe(x):
+        return (x * 2 + 1).sum()
+
+    return R
+
+
+def build_clean_registry() -> Registry:
+    import jax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    R = Registry()
+
+    @R.entry(name="fix.pure_math", shapes=lambda: (sds((8,), "float32"),))
+    @jax.jit
+    def pure_math(x):
+        return x * 2 + 1
+
+    @R.entry(name="fix.matched_axis",
+             shapes=lambda: (sds((8,), "float32"),), mesh=(AX,))
+    def matched_axis(x):
+        return shard_map(
+            lambda v: jax.lax.psum(v, AX),
+            mesh=_mesh(AX), in_specs=(P(AX),), out_specs=P(),
+            check_rep=False,
+        )(x)
+
+    @R.entry(name="fix.cond_matched",
+             shapes=lambda: (sds((8,), "float32"),), mesh=(AX,))
+    def cond_matched(x):
+        def body(v):
+            return jax.lax.cond(
+                v[0] > 0,
+                lambda u: jax.lax.psum(u * 2, AX),
+                lambda u: jax.lax.psum(u, AX),
+                v,
+            )
+
+        return shard_map(
+            body, mesh=_mesh(AX), in_specs=(P(AX),), out_specs=P(AX),
+            check_rep=False,
+        )(x)
+
+    _honored = jax.jit(lambda x: x + 1, donate_argnums=(0,))
+
+    @R.entry(name="fix.donate_honored",
+             shapes=lambda: (sds((8,), "float32"),), donate=True)
+    def donate_honored(x):
+        return _honored(x)
+
+    @R.entry(name="fix.cost_probe", shapes=lambda: (sds((64,), "float32"),))
+    @jax.jit
+    def cost_probe(x):
+        return (x * 2 + 1).sum()
+
+    return R

@@ -56,10 +56,10 @@ def test_bad_fixture_flags_every_family():
     assert {"HG301", "HG302", "HG303", "HG304"} <= rules
     # family 4: lock order
     assert {"HG401", "HG402"} <= rules
-    # family 5: VMEM budgets
-    assert {"HG501", "HG502"} <= rules
-    # family 6: shard_map collective consistency
-    assert {"HG601", "HG602", "HG603"} <= rules
+    # family 5: VMEM budgets (incl. scalar-prefetch SMEM)
+    assert {"HG501", "HG502", "HG503"} <= rules
+    # family 6: shard_map collective consistency (incl. cond branches)
+    assert {"HG601", "HG602", "HG603", "HG604"} <= rules
     assert len(findings) >= 8  # acceptance floor; actual seed is larger
 
 
@@ -110,6 +110,30 @@ def test_vmem_pragma_suppresses_hg502():
     assert findings == [], "\n".join(f.render() for f in findings)
 
 
+def test_smem_scalar_prefetch_budget():
+    findings = run_lint([str(FIXTURES / "bad_pkg" / "smem_bad.py")])
+    hits = [f for f in findings if f.rule == "HG503"]
+    assert len(hits) == 1
+    assert "SMEM" in hits[0].message and hits[0].scope == "smem_overflow"
+    # the fitting twin (the pallas_gather SEG contract) stays silent
+    ok = run_lint([str(FIXTURES / "clean_pkg" / "smem_ok.py")])
+    assert [f for f in ok if f.rule == "HG503"] == []
+
+
+def test_shapes_fold_through_scan_and_vmap():
+    """ShapeDtype propagates through lax.scan carries and jax.vmap
+    results: the wrapshape fixtures' None block dims fold, so overflows
+    surface as HG501 (not the weaker HG502), and the fitting twins fold
+    clean (no HG502 either)."""
+    findings = run_lint([str(FIXTURES / "bad_pkg" / "wrapshape_bad.py")])
+    by_scope = {f.scope: f.rule for f in findings
+                if f.rule.startswith("HG5")}
+    assert by_scope == {"scan_carried_overflow": "HG501",
+                        "vmap_result_overflow": "HG501"}
+    ok = run_lint([str(FIXTURES / "clean_pkg" / "wrapshape_ok.py")])
+    assert [f for f in ok if f.rule.startswith("HG5")] == []
+
+
 # ------------------------------------------------------ collective fixtures
 
 
@@ -127,6 +151,59 @@ def test_collective_axis_and_divergence_flagged():
 def test_collectives_clean_region_is_silent():
     findings = run_lint([str(FIXTURES / "clean_pkg" / "collectives_ok.py")])
     assert [f for f in findings if f.rule.startswith("HG6")] == []
+
+
+def test_cond_branch_collective_mismatch_flagged():
+    findings = run_lint([str(FIXTURES / "bad_pkg" / "condcoll_bad.py")])
+    hits = [f for f in findings if f.rule == "HG604"]
+    by_scope = {f.scope: f for f in hits}
+    # _helper_body: the mismatched psum hides one call deep — the branch
+    # scan must follow resolvable helpers in both directions
+    assert set(by_scope) == {"_cond_body", "_switch_body", "_helper_body"}
+    assert "mismatched collectives" in by_scope["_cond_body"].message
+    # identical-psum branches must stay silent — including a branch that
+    # routes the SAME psum through a helper
+    ok = run_lint([str(FIXTURES / "clean_pkg" / "condcoll_ok.py")])
+    assert [f for f in ok if f.rule == "HG604"] == []
+
+
+def test_decorator_args_are_host_scope(tmp_path):
+    """Decorator expressions of a module-level jitted function execute at
+    import (host) — numpy work there must NOT be flagged as traced; the
+    same hazard on a def NESTED inside a jit root executes under tracing
+    and must be flagged."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "m.py").write_text(
+        "import jax\n"
+        "import numpy as np\n\n\n"
+        "def _register(table):\n"
+        "    def deco(fn):\n"
+        "        return fn\n"
+        "    return deco\n\n\n"
+        "@_register(table=np.arange(8))\n"
+        "@jax.jit\n"
+        "def host_decorated(x):\n"
+        "    return x * 2\n"
+    )
+    assert run_lint([str(pkg)]) == [], "host-side decorator arg flagged"
+    (pkg / "m.py").write_text(
+        "import jax\n"
+        "import numpy as np\n\n\n"
+        "def _register(table):\n"
+        "    def deco(fn):\n"
+        "        return fn\n"
+        "    return deco\n\n\n"
+        "@jax.jit\n"
+        "def traced(x):\n"
+        "    @_register(table=np.arange(8))\n"
+        "    def inner(y):\n"
+        "        return y\n"
+        "    return inner(x)\n"
+    )
+    rules = {f.rule for f in run_lint([str(pkg)])}
+    assert "HG103" in rules, "traced nested-def decorator arg missed"
 
 
 # -------------------------------------------------------- donation fixtures
@@ -302,7 +379,7 @@ def test_only_family_filter():
     assert vmem_only and all(f.rule.startswith("HG5") for f in vmem_only)
     assert len(vmem_only) < len(all_f)
     multi = run_lint([str(FIXTURES / "bad_pkg")], only="HG5,HG601")
-    assert {f.rule for f in multi} <= {"HG501", "HG502", "HG601"}
+    assert {f.rule for f in multi} <= {"HG501", "HG502", "HG503", "HG601"}
     assert any(f.rule == "HG601" for f in multi)
 
 

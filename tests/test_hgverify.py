@@ -1,0 +1,308 @@
+"""Tier-1 gate + precision pins for the hgverify jaxpr-level verifier.
+
+Three jobs:
+
+1. precision against the fixture registries — every seedable HV rule
+   fires on ``hgverify_fixtures.entries.build_bad_registry()`` and the
+   clean twins stay silent (HV104 needs the removed legacy host_callback
+   staging and is pinned by rule-table presence only);
+2. the ``costs.json`` lifecycle: uncovered -> HV402, ``--update-costs``
+   covers, drift -> HV401, stale -> HV403;
+3. the repo gate: every registered production entry traces, the
+   committed budgets cover all of them, and the full verify + concordance
+   run clean — a PR that sneaks a callback into a jitted hot path or
+   doubles an op's footprint fails tier-1.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from hgverify_fixtures.entries import (  # noqa: E402
+    build_bad_registry,
+    build_clean_registry,
+)
+from tools.hgverify import (  # noqa: E402
+    RULES,
+    load_costs,
+    parse_only,
+    run_verify,
+)
+from tools.hgverify import concord as concord_mod  # noqa: E402
+from tools.hgverify.engine import build_report  # noqa: E402
+from tools.hgverify.harvest import COST_METRICS  # noqa: E402
+
+COSTS = REPO / "tools" / "hgverify" / "costs.json"
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+# ------------------------------------------------------------ fixture gate
+
+
+@pytest.fixture(scope="module")
+def bad_run(tmp_path_factory):
+    costs = tmp_path_factory.mktemp("hv") / "costs.json"
+    return run_verify(registry=build_bad_registry(), costs_path=str(costs))
+
+
+def test_bad_registry_fires_every_family(bad_run):
+    findings, meta = bad_run
+    rules = _rules(findings)
+    # family 1: trace failure + every constructible callback flavor
+    # (HV104's legacy host_callback staging cannot be built on this jax)
+    assert {"HV100", "HV101", "HV102", "HV103"} <= rules
+    # family 2: declared-mesh ghost axis, cond divergence, missing mesh
+    assert {"HV201", "HV202", "HV203"} <= rules
+    # family 3: unusable donation, double-aliased donation, lost donation
+    assert {"HV301", "HV302", "HV303"} <= rules
+    # family 4: a fresh costs file leaves every fixture entry uncovered
+    assert "HV402" in rules
+
+
+def test_bad_findings_anchor_to_entries(bad_run):
+    findings, _ = bad_run
+    by_scope = {f.scope for f in findings}
+    assert "fix.pure_cb" in by_scope and "fix.donate_twice" in by_scope
+    for f in findings:
+        if f.rule != "HV403":
+            assert f.path.endswith("entries.py")
+            assert f.line > 0
+
+
+def test_clean_registry_is_silent_once_covered(tmp_path):
+    costs = tmp_path / "costs.json"
+    _, _ = run_verify(registry=build_clean_registry(),
+                      costs_path=str(costs), update_costs=True)
+    findings, meta = run_verify(registry=build_clean_registry(),
+                                costs_path=str(costs))
+    assert findings == [], "\n".join(f.render() for f in findings)
+    assert meta["traced"] == meta["registered"]
+
+
+# ------------------------------------------------------- costs lifecycle
+
+
+def test_costs_lifecycle(tmp_path):
+    costs = tmp_path / "costs.json"
+    reg = build_clean_registry
+
+    # 1. fresh entries have no budget -> HV402 uncovered warnings
+    findings, _ = run_verify(registry=reg(), costs_path=str(costs))
+    assert _rules(findings) == {"HV402"}
+
+    # 2. --update-costs writes budgets; the gate goes quiet
+    run_verify(registry=reg(), costs_path=str(costs), update_costs=True)
+    budgets = load_costs(str(costs))
+    assert set(budgets) == {e.name for e in reg()}
+    assert all(set(b) == set(COST_METRICS) for b in budgets.values())
+    findings, _ = run_verify(registry=reg(), costs_path=str(costs))
+    assert findings == []
+
+    # 3. drift beyond tolerance -> HV401 names the metric and direction
+    data = json.loads(costs.read_text())
+    data["entries"]["fix.cost_probe"]["flops"] *= 3
+    costs.write_text(json.dumps(data))
+    findings, _ = run_verify(registry=reg(), costs_path=str(costs))
+    hits = [f for f in findings if f.rule == "HV401"]
+    assert len(hits) == 1 and hits[0].scope == "fix.cost_probe"
+    assert "flops" in hits[0].message and "shrank" in hits[0].message
+
+    # 4. a generous tolerance accepts the same drift
+    findings, _ = run_verify(registry=reg(), costs_path=str(costs),
+                             tolerance=5.0)
+    assert [f for f in findings if f.rule == "HV401"] == []
+
+    # 5. stale budget (no live entry) fails the gate like hglint
+    #    baseline staleness
+    data["entries"]["fix.cost_probe"]["flops"] //= 3
+    data["entries"]["fix.removed_entry"] = {
+        "flops": 1, "bytes_accessed": 1, "temp_bytes": 0
+    }
+    costs.write_text(json.dumps(data))
+    findings, _ = run_verify(registry=reg(), costs_path=str(costs))
+    stale = [f for f in findings if f.rule == "HV403"]
+    assert len(stale) == 1 and stale[0].scope == "fix.removed_entry"
+    assert stale[0].severity == "error"
+
+    # 6. --update-costs prunes the stale entry: the loop closes
+    run_verify(registry=reg(), costs_path=str(costs), update_costs=True)
+    assert "fix.removed_entry" not in load_costs(str(costs))
+
+
+def test_costs_file_tolerance_is_honored(tmp_path):
+    """The tolerance committed IN costs.json is the default gate width;
+    an explicit --tolerance still wins."""
+    costs = tmp_path / "costs.json"
+    reg = build_clean_registry
+    run_verify(registry=reg(), costs_path=str(costs), update_costs=True)
+    data = json.loads(costs.read_text())
+    data["entries"]["fix.cost_probe"]["flops"] *= 2
+    data["tolerance"] = 5.0
+    costs.write_text(json.dumps(data))
+    findings, meta = run_verify(registry=reg(), costs_path=str(costs))
+    assert [f for f in findings if f.rule == "HV401"] == []
+    assert meta["tolerance"] == 5.0
+    findings, _ = run_verify(registry=reg(), costs_path=str(costs),
+                             tolerance=0.15)
+    assert [f for f in findings if f.rule == "HV401"]
+
+
+def test_family_filter_never_corrupts_concordance(tmp_path):
+    """--only narrows the REPORT; meta['all_findings'] (what --concord
+    cross-tabulates) keeps the full ground truth."""
+    costs = tmp_path / "costs.json"
+    findings, meta = run_verify(registry=build_bad_registry(),
+                                costs_path=str(costs), only="HV4")
+    visible = {f.rule for f in findings}
+    full = {f.rule for f in meta["all_findings"]}
+    assert "HV101" not in visible
+    assert {"HV101", "HV201", "HV302"} <= full
+
+
+# ------------------------------------------------------------- repo gate
+
+
+@pytest.fixture(scope="module")
+def production_run(tmp_path_factory):
+    os.chdir(REPO)   # finding paths and costs default are repo-relative
+    return run_verify()
+
+
+def test_production_entries_all_trace(production_run):
+    findings, meta = production_run
+    assert meta["registered"] >= 10, "entry registry shrank below floor"
+    assert meta["traced"] == meta["registered"], (
+        "entries failed to trace:\n"
+        + "\n".join(f.render() for f in findings if f.rule == "HV100")
+    )
+
+
+def test_production_gate_is_clean(production_run):
+    findings, _ = production_run
+    assert findings == [], (
+        "hgverify findings on the production entries (fix them or, for "
+        "accepted cost changes, regenerate budgets via `python -m "
+        "tools.hgverify --update-costs`):\n"
+        + "\n".join(f.render() for f in findings)
+    )
+
+
+def test_costs_json_covers_every_entry(production_run):
+    _, meta = production_run
+    budgets = load_costs(str(COSTS))
+    live = {t.entry.name for t in meta["traces"]}
+    assert budgets, "committed costs.json is missing or empty"
+    assert live - set(budgets) == set(), "uncovered entries"
+    assert set(budgets) - live == set(), "stale budget entries"
+    donated = [t.entry.name for t in meta["traces"] if t.entry.donate]
+    assert "ops.ellbfs._visited_update" in donated
+
+
+def test_concordance_runs_cleanly(production_run):
+    findings, meta = production_run
+    table = concord_mod.concord(meta["traces"], findings,
+                                ["hypergraphdb_tpu"])
+    assert table["rows"], "concordance produced no (entry, family) rows"
+    verdicts = {r["verdict"] for r in table["rows"]}
+    assert verdicts <= {"agree_clean", "agree_flagged",
+                        "hglint_false_negative", "hglint_only"}
+    assert concord_mod.render(table).startswith("hgverify concordance")
+
+
+def test_report_shape_matches_hglint_envelope(production_run):
+    findings, meta = production_run
+    report = build_report(findings, meta)
+    assert report["tool"] == "hgverify"
+    assert report["report_version"] == 2
+    # the keys CI consumers share with hglint's report
+    assert {"counts", "findings", "only"} <= set(report)
+    assert set(report["counts"]) == {"total", "by_rule", "by_severity"}
+    bad, _ = run_verify(registry=build_bad_registry(),
+                        costs_path=str(COSTS))
+    rep2 = build_report(bad, meta)
+    assert all({"rule", "severity", "path", "line", "scope", "message",
+                "doc"} <= set(f) for f in rep2["findings"])
+    assert any(f["doc"].startswith("README.md#hv") for f in rep2["findings"])
+
+
+# ---------------------------------------------------------------- filters
+
+
+def test_only_family_filter(tmp_path):
+    costs = tmp_path / "costs.json"
+    findings, _ = run_verify(registry=build_bad_registry(),
+                             costs_path=str(costs), only="HV3")
+    rules = _rules(findings)
+    assert {"HV301", "HV302", "HV303"} <= rules
+    # HV100 always surfaces (broken ground truth must never hide) but the
+    # other families are filtered out
+    assert rules - {"HV301", "HV302", "HV303", "HV100"} == set()
+
+
+def test_only_typo_refuses_silent_green():
+    with pytest.raises(ValueError, match="matches no known rule"):
+        parse_only("HV9")
+    with pytest.raises(ValueError, match="matches no known rule"):
+        parse_only("hv4")   # case-sensitive
+
+
+def test_rule_registry_consistency(bad_run):
+    findings, _ = bad_run
+    assert _rules(findings) <= set(RULES)
+
+
+# ------------------------------------------------------------------- CLI
+
+
+def test_cli_crash_is_exit_3_not_a_finding(monkeypatch, capsys):
+    """The lint.sh/verify.sh contract: an analyzer bug exits 3 with a
+    traceback, never masquerading as '1 finding'."""
+    from tools.hgverify import __main__ as cli
+    from tools.hgverify import engine
+
+    def boom(**kw):
+        raise RuntimeError("injected analyzer bug")
+
+    monkeypatch.setattr(engine, "run_verify", boom)
+    rc = cli.main([])
+    assert rc == 3
+    err = capsys.readouterr().err
+    assert "injected analyzer bug" in err
+    assert "internal analyzer crash" in err
+
+
+def test_cli_usage_error_exit_2():
+    env = dict(os.environ)
+    env.setdefault("PYTHONPATH", str(REPO))
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.hgverify", "--only", "HV9"],
+        cwd=REPO, capture_output=True, text=True, env=env,
+    )
+    assert out.returncode == 2
+    assert "matches no known rule" in out.stderr
+
+
+@pytest.mark.slow
+def test_cli_end_to_end_json():
+    env = dict(os.environ)
+    env.setdefault("PYTHONPATH", str(REPO))
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.hgverify", "--output", "json"],
+        cwd=REPO, capture_output=True, text=True, env=env,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    report = json.loads(out.stdout)
+    assert report["tool"] == "hgverify"
+    assert report["entries"]["traced"] >= 10
+    assert report["counts"]["total"] == 0

@@ -224,17 +224,27 @@ class Interp:
     # -- local straight-line folding ------------------------------------------
 
     def _fold_locals(self, fn_node, env: dict, mod) -> None:
+        def bind(target, value):
+            if isinstance(target, ast.Name):
+                env[target.id] = value
+            elif isinstance(target, (ast.Tuple, ast.List)):
+                # tuple unpacking — `carry, ys = lax.scan(...)` — binds
+                # element-wise when the value folds to a matching tuple
+                vals = value if isinstance(value, tuple) and \
+                    len(value) == len(target.elts) else \
+                    (UNKNOWN,) * len(target.elts)
+                for t, v in zip(target.elts, vals):
+                    bind(t, v)
+
         def walk(node):
             for child in ast.iter_child_nodes(node):
                 if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
                                       ast.ClassDef)):
                     continue
                 if isinstance(child, ast.Assign) and \
-                        len(child.targets) == 1 and \
-                        isinstance(child.targets[0], ast.Name):
-                    env[child.targets[0].id] = self.eval(
-                        child.value, env, mod
-                    )
+                        len(child.targets) == 1:
+                    bind(child.targets[0],
+                         self.eval(child.value, env, mod))
                 elif isinstance(child, ast.AnnAssign) and \
                         isinstance(child.target, ast.Name) and \
                         child.value is not None:
@@ -373,11 +383,28 @@ class Interp:
             base = self.eval(node.func.value, env, mod)
             if isinstance(base, ShapeDtype):
                 return self._array_method(base, meth, node, env, mod)
+        # wrapper-applied calls: ``jax.vmap(f)(xs)`` parses as
+        # Call(Call(vmap, f), xs) — fold through the mapped function
+        # (jnp.vectorize is NOT folded: its scalar-core-dims semantics map
+        # over every dimension, not just axis 0)
+        if isinstance(node.func, ast.Call) and mod is not None:
+            if resolve_fqn(node.func.func, mod) == "jax.vmap":
+                return self._vmap_result(node, env, mod)
         if mod is None:
             return UNKNOWN
         fqn = resolve_fqn(node.func, mod)
         if fqn is None:
             return UNKNOWN
+        if fqn == "jax.lax.scan":
+            # scan returns (final_carry, stacked_ys): the carry keeps the
+            # init's abstract value; the stacked outputs stay unknown
+            kw = {k.arg: k.value for k in node.keywords if k.arg}
+            init_node = kw.get(
+                "init", node.args[1] if len(node.args) > 1 else None
+            )
+            if init_node is None:
+                return UNKNOWN
+            return (self.eval(init_node, env, mod), UNKNOWN)
         if fqn == "len":
             v = self.eval(node.args[0], env, mod) if node.args else UNKNOWN
             if isinstance(v, tuple):
@@ -468,7 +495,39 @@ class Interp:
             return ShapeDtype(None, dt)
         return UNKNOWN
 
-    def _fold_return(self, fqn, node, env, mod):
+    def _vmap_result(self, node: ast.Call, env, mod):
+        """``jax.vmap(f)(xs, ...)`` with default axes: fold ``f``'s
+        single-return body over the element shapes (leading dim stripped)
+        and prepend the common batch dim to the result. Any explicit
+        ``in_axes``/``out_axes`` (or unfoldable pieces) bail to UNKNOWN —
+        silence over guessing non-zero axis arithmetic."""
+        wrap = node.func
+        if wrap.keywords or len(wrap.args) != 1 or not node.args or \
+                node.keywords:
+            return UNKNOWN
+        fn_fqn = resolve_fqn(wrap.args[0], mod)
+        if fn_fqn is None:
+            return UNKNOWN
+        batch = None
+        elems = []
+        for a in node.args:
+            if isinstance(a, ast.Starred):
+                return UNKNOWN
+            v = self.eval(a, env, mod)
+            if not isinstance(v, ShapeDtype) or v.shape is None or \
+                    len(v.shape) < 1 or not isinstance(v.shape[0], int):
+                return UNKNOWN
+            if batch is None:
+                batch = v.shape[0]
+            elif v.shape[0] != batch:
+                return UNKNOWN
+            elems.append(ShapeDtype(v.shape[1:], v.dtype))
+        out = self._fold_return(fn_fqn, node, env, mod, arg_vals=elems)
+        if isinstance(out, ShapeDtype) and out.shape is not None:
+            return ShapeDtype((batch,) + out.shape, out.dtype)
+        return UNKNOWN
+
+    def _fold_return(self, fqn, node, env, mod, arg_vals=None):
         fi = self.cg.functions.get(fqn)
         if fi is None or len(self._ret_stack) >= self._RET_DEPTH or \
                 fqn in self._ret_stack:
@@ -482,6 +541,16 @@ class Interp:
                 ret = stmts[0].value
         if ret is None:
             return UNKNOWN
+        if arg_vals is not None:
+            # explicit abstract arguments (the vmap element shapes)
+            callee_env = dict(self.bindings.get(fqn, {}))
+            for p, v in zip(fi.params, arg_vals):
+                callee_env[p] = v
+            self._ret_stack.append(fqn)
+            try:
+                return self.eval(ret, callee_env, fi.mod)
+            finally:
+                self._ret_stack.pop()
         # bind THIS call's arguments over the callee's defaults
         callee_env = dict(self.bindings.get(fqn, {}))
         params = fi.params

@@ -182,9 +182,21 @@ class CallGraph:
 
 
 def _index_functions(cg: CallGraph, mod: ModuleInfo) -> None:
-    def walk(node, qual: list, cls_name: Optional[str],
-             fn_stack: list):
+    from tools.hglint.loader import def_time_exprs
+
+    def expr_calls(node, fn_stack: list):
+        """Record call sites in a def-time expression (decorator, param
+        default) — these execute in the ENCLOSING scope when the ``def``
+        statement runs, not inside the defined function."""
+        if isinstance(node, ast.Call):
+            fn_key = fn_stack[-1].key if fn_stack else None
+            cg.calls.append(CallSite(node=node, fn_key=fn_key, mod=mod))
         for child in ast.iter_child_nodes(node):
+            expr_calls(child, fn_stack)
+
+    def walk(children, qual: list, cls_name: Optional[str],
+             fn_stack: list):
+        for child in children:
             if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 qp = ".".join(qual + [child.name])
                 key = f"{mod.name}.{qp}"
@@ -208,18 +220,27 @@ def _index_functions(cg: CallGraph, mod: ModuleInfo) -> None:
                 cg.functions[key] = fi
                 if fn_stack:
                     fn_stack[-1].children[child.name] = key
-                walk(child, qual + [child.name], None, fn_stack + [fi])
+                for host in def_time_exprs(child):
+                    expr_calls(host, fn_stack)
+                walk(child.body, qual + [child.name], None,
+                     fn_stack + [fi])
             elif isinstance(child, ast.ClassDef):
-                walk(child, qual + [child.name], child.name, fn_stack)
+                hosts = (def_time_exprs(child) + list(child.bases)
+                         + [k.value for k in child.keywords])
+                for host in hosts:
+                    expr_calls(host, fn_stack)
+                walk(child.body, qual + [child.name], child.name,
+                     fn_stack)
             else:
                 if isinstance(child, ast.Call):
                     fn_key = fn_stack[-1].key if fn_stack else None
                     cg.calls.append(
                         CallSite(node=child, fn_key=fn_key, mod=mod)
                     )
-                walk(child, qual, cls_name, fn_stack)
+                walk(ast.iter_child_nodes(child), qual, cls_name,
+                     fn_stack)
 
-    walk(mod.tree, [], None, [])
+    walk(mod.tree.body, [], None, [])
 
 
 def _decorator_roots(fi: FunctionInfo, mod: ModuleInfo) -> None:

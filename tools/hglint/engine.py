@@ -39,9 +39,9 @@ def _runners(cg, modules, interp, vmem_budget):
          lambda: rules_pallas.check(cg, modules)),
         (("HG401", "HG402"),
          lambda: rules_locks.check(cg, modules)),
-        (("HG501", "HG502"),
+        (("HG501", "HG502", "HG503"),
          lambda: rules_vmem.check(cg, modules, interp, vmem_budget)),
-        (("HG601", "HG602", "HG603"),
+        (("HG601", "HG602", "HG603", "HG604"),
          lambda: rules_collectives.check(cg, modules, interp)),
     ]
 

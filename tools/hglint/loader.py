@@ -303,16 +303,46 @@ def dtype_name(node: ast.AST, mod: ModuleInfo) -> Optional[str]:
     return None
 
 
+def def_time_exprs(fn_node: ast.AST) -> list:
+    """Expressions a ``def``/``class`` statement evaluates in its
+    ENCLOSING scope when it executes: decorators, parameter defaults,
+    and annotations (evaluated eagerly absent ``from __future__ import
+    annotations`` — including them is the conservative attribution
+    either way). Decorators of a module-level function run at import on
+    host; the same decorators on a def nested inside a jitted function
+    run under tracing — scope attribution matters."""
+    out = list(getattr(fn_node, "decorator_list", ()))
+    args = getattr(fn_node, "args", None)
+    if args is not None:
+        out.extend(args.defaults)
+        out.extend(d for d in args.kw_defaults if d is not None)
+        params = (args.posonlyargs + args.args + args.kwonlyargs
+                  + [a for a in (args.vararg, args.kwarg) if a])
+        out.extend(a.annotation for a in params if a.annotation)
+    ret = getattr(fn_node, "returns", None)
+    if ret is not None:
+        out.append(ret)
+    return out
+
+
 def own_nodes(fn_node: ast.AST):
     """Yield every descendant of a function node that belongs to the
     function's own scope — nested function/class definitions are not
-    entered (they are analyzed as their own scopes). Lambdas ARE entered:
-    they trace with their parent."""
-    stack = list(ast.iter_child_nodes(fn_node))
+    entered (they are analyzed as their own scopes), but their decorators
+    and parameter defaults ARE yielded (they execute when the nested
+    ``def`` runs, i.e. in this scope). The function's OWN decorators and
+    defaults are excluded: they run in the enclosing (usually module =
+    host) scope, not under trace. Lambdas ARE entered: they trace with
+    their parent."""
+    if isinstance(fn_node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        stack = list(fn_node.body)
+    else:
+        stack = list(ast.iter_child_nodes(fn_node))
     while stack:
         node = stack.pop()
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                              ast.ClassDef)):
+            stack.extend(def_time_exprs(node))
             continue
         yield node
         stack.extend(ast.iter_child_nodes(node))

@@ -36,11 +36,14 @@ RULES = {
     # -- family 5: VMEM budgets ----------------------------------------------
     "HG501": "pallas_call VMEM working set exceeds the per-core budget",
     "HG502": "pallas_call VMEM working set is not statically resolvable",
+    "HG503": "pallas_call scalar-prefetch operands exceed the 1 MB SMEM "
+             "budget",
     # -- family 6: shard_map collective consistency ---------------------------
     "HG601": "collective over an axis name absent from the shard_map mesh",
     "HG602": "collective under a branch on a traced value "
              "(divergent-program deadlock)",
     "HG603": "collective axis mismatch between shard_map caller and callee",
+    "HG604": "lax.cond/switch branches carry mismatched collectives",
 }
 
 #: rule id -> default severity
@@ -64,9 +67,11 @@ RULE_SEVERITY = {
     "HG107": "warning",
     "HG501": "error",
     "HG502": "warning",
+    "HG503": "error",
     "HG601": "error",
     "HG602": "error",
     "HG603": "error",
+    "HG604": "error",
 }
 
 #: family prefix -> README.md section anchor (rule docs live there); HG106

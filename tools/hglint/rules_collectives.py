@@ -20,6 +20,15 @@ HG603 (error)  caller/callee axis mismatch: a helper reached from a
                (constant, or a parameter constant-propagated from its
                call sites) is absent from every region environment that
                reaches the helper.
+HG604 (error)  ``jax.lax.cond``/``switch`` inside a shard_map region whose
+               branch callables carry MISMATCHED collectives: unlike a
+               Python branch (HG602) the cond itself traces fine — both
+               branches are staged — but at runtime devices whose
+               predicates disagree execute different collective
+               sequences and the mesh hangs. Branches are compared as
+               multisets of (collective, folded axis names); a branch
+               that does not resolve to a known function/lambda voids the
+               comparison (silence over guessing).
 
 The mesh environment of a region is resolved by
 :func:`tools.hglint.absint.mesh_axes_for_site` — the folded ``mesh=``
@@ -31,9 +40,10 @@ from __future__ import annotations
 
 import ast
 from collections import deque
+from typing import Optional
 
 from tools.hglint.absint import Interp, mesh_axes_for_site
-from tools.hglint.callgraph import SHARD_FQNS, CallGraph
+from tools.hglint.callgraph import SHARD_FQNS, CallGraph, CallSite
 from tools.hglint.loader import own_nodes, resolve_fqn
 from tools.hglint.model import Finding
 from tools.hglint.rules_retrace import _traced_name_in_test
@@ -79,6 +89,7 @@ def check(cg: CallGraph, modules: list, interp: Interp) -> list:
         else:
             env_union = frozenset().union(*envs)
         findings += _check_fn(cg, interp, fi, key in regions, env_union)
+        findings += _check_cond_branches(cg, interp, fi)
     return findings
 
 
@@ -209,6 +220,113 @@ def _check_fn(cg: CallGraph, interp: Interp, fi, is_root: bool,
                 ),
             ))
     return findings
+
+
+_COND_FQNS = ("jax.lax.cond", "jax.lax.switch")
+
+
+def _check_cond_branches(cg: CallGraph, interp: Interp, fi) -> list:
+    """HG604: compare the collective multisets of every ``lax.cond`` /
+    ``lax.switch`` branch inside a shard_map-reachable function."""
+    findings = []
+    env_fn = interp.env_for(fi)
+    for node in own_nodes(fi.node):
+        if not isinstance(node, ast.Call):
+            continue
+        fqn = resolve_fqn(node.func, fi.mod)
+        if fqn not in _COND_FQNS or len(node.args) < 2:
+            continue
+        if fqn.endswith(".cond"):
+            branch_nodes = list(node.args[1:3])
+        else:  # switch(index, branches, *operands)
+            seq = node.args[1]
+            if isinstance(seq, (ast.List, ast.Tuple)):
+                branch_nodes = list(seq.elts)
+            else:
+                continue   # branches behind a name: unresolvable, skip
+        sets = []
+        for bn in branch_nodes:
+            s = _callable_collectives(cg, interp, fi, bn, env_fn)
+            if s is None:
+                sets = None   # one unresolvable branch voids the compare
+                break
+            sets.append(s)
+        if not sets or len(set(sets)) <= 1:
+            continue
+        short = fqn.rsplit(".", 1)[-1]
+        desc = " vs ".join(
+            "[" + (", ".join(f"{n}({a})" for n, a in s) or "-") + "]"
+            for s in sets
+        )
+        findings.append(Finding(
+            rule="HG604", path=fi.mod.path, line=node.lineno,
+            scope=fi.qualpath,
+            message=(
+                f"`lax.{short}` branches carry mismatched collectives "
+                f"({desc}) — devices whose predicates disagree issue "
+                f"different collective sequences and the mesh hangs; "
+                f"issue the same collectives on every branch (reduce a "
+                f"zero contribution instead of skipping the op)"
+            ),
+        ))
+    return findings
+
+
+def _callable_collectives(cg: CallGraph, interp: Interp, fi, branch,
+                          env_fn: dict, _depth: int = 0,
+                          _seen: Optional[frozenset] = None):
+    """Sorted multiset of (collective short name, axis names) a branch
+    callable issues — following calls into RESOLVABLE user functions (so
+    a psum routed through a helper still counts on both arms), bounded
+    depth, cycle-safe. None when the branch doesn't resolve."""
+    seen = _seen or frozenset()
+    if isinstance(branch, ast.Lambda):
+        body_nodes = ast.walk(branch.body)
+        mod = fi.mod
+        env = env_fn
+        site_fi = fi
+    else:
+        site = CallSite(node=ast.Call(func=branch, args=[], keywords=[]),
+                        fn_key=fi.key, mod=fi.mod)
+        key = cg.resolve_callable(branch, site)
+        if key is None:
+            # at the branch position an unresolvable callable voids the
+            # comparison; below it, a dotted name that is not user code
+            # is a library call and contributes nothing
+            return None if _depth == 0 else ()
+        if key in seen:
+            return ()
+        seen = seen | {key}
+        site_fi = cg.functions[key]
+        body_nodes = own_nodes(site_fi.node)
+        mod = site_fi.mod
+        env = interp.env_for(site_fi)
+    out = []
+    for node in body_nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        fqn = resolve_fqn(node.func, mod)
+        if fqn in COLLECTIVES:
+            if fqn in _NON_COMMUNICATING:
+                continue
+            axes = _axis_names(node, fqn, interp, env, mod)
+            out.append((fqn.rsplit(".", 1)[-1],
+                        ",".join(sorted(axes)) if axes else "?"))
+        elif _depth < 3:
+            if fqn is None and not isinstance(node.func, ast.Lambda):
+                # an OPAQUE callable (dict dispatch, getattr, higher-order
+                # result) could hide a collective either way — void the
+                # whole comparison: silence over guessing
+                return None
+            # a dotted name: either known user code (follow it) or a
+            # library call (cannot carry a user collective — skip)
+            sub = _callable_collectives(
+                cg, interp, site_fi, node.func, env, _depth + 1, seen
+            )
+            if sub is None:
+                return None   # opacity anywhere below voids the compare
+            out.extend(sub)
+    return tuple(sorted(out))
 
 
 def _axis_names(node: ast.Call, fqn: str, interp: Interp, env_fn: dict,

@@ -31,6 +31,17 @@ HG502 (warn)   the working set is NOT statically resolvable — some block
                making the shape static, or verify the bound by hand, guard
                it at runtime, and add ``# hglint: disable=HG502`` on the
                flagged line.
+HG503 (error)  the SCALAR-PREFETCH operands (the first
+               ``num_scalar_prefetch`` arguments of a
+               ``PrefetchScalarGridSpec`` call) exceed the 1 MB SMEM
+               budget. Scalar prefetch lands whole in SMEM before the
+               grid runs — an oversized index segment fails Mosaic
+               allocation on hardware while CPU interpret tests pass
+               (the hazard ``ops/pallas_gather.py`` bounds with its
+               ``SEG`` segmentation; its import-time guard asserts the
+               same contract this rule checks statically). Operands that
+               do not fold stay silent here — silence over guessing; the
+               VMEM model reports its own unresolvables via HG502.
 """
 
 from __future__ import annotations
@@ -50,6 +61,9 @@ from tools.hglint.model import Finding
 
 #: default per-core VMEM budget in bytes (v4/v5 generations: ~16 MiB)
 DEFAULT_VMEM_BUDGET = 16 << 20
+
+#: per-core SMEM budget for scalar-prefetch operands (1 MB on v4/v5)
+SMEM_BUDGET = 1 << 20
 
 LANE = 128
 
@@ -110,7 +124,10 @@ def _check_call(cg: CallGraph, site: CallSite, interp: Interp, budget: int,
     operands: list = []
     if outer is not None:
         operands = [interp.eval(a, env, mod) for a in outer.args]
-    operands = operands[n_scalar:]  # scalar-prefetch args live in SMEM
+    scalar_ops = operands[:n_scalar]  # scalar-prefetch args live in SMEM
+    operands = operands[n_scalar:]
+
+    smem_findings = _check_smem(scalar_ops, call, mod, scope)
 
     out_vals = _out_shape_vals(kw.get("out_shape"), interp, env, mod)
 
@@ -155,7 +172,7 @@ def _check_call(cg: CallGraph, site: CallSite, interp: Interp, budget: int,
         total += _scratch_bytes(sc, interp, env, mod, unresolved, j)
 
     if unresolved:
-        return [Finding(
+        return smem_findings + [Finding(
             rule="HG502", path=mod.path, line=call.lineno, scope=scope,
             message=(
                 "VMEM working set of pallas_call is not statically "
@@ -167,7 +184,7 @@ def _check_call(cg: CallGraph, site: CallSite, interp: Interp, budget: int,
             ),
         )]
     if total > budget:
-        return [Finding(
+        return smem_findings + [Finding(
             rule="HG501", path=mod.path, line=call.lineno, scope=scope,
             message=(
                 f"pallas_call VMEM working set {_fmt(total)} exceeds the "
@@ -175,7 +192,34 @@ def _check_call(cg: CallGraph, site: CallSite, interp: Interp, budget: int,
                 f"scratch); shrink block shapes or re-tile the grid"
             ),
         )]
-    return []
+    return smem_findings
+
+
+def _check_smem(scalar_ops: list, call: ast.Call, mod, scope: str) -> list:
+    """HG503: folded scalar-prefetch operand bytes vs the SMEM budget.
+    SMEM is scalar memory — raw element bytes, no (sublane, lane) tile
+    padding. Unfoldable operands contribute nothing (silence over
+    guessing)."""
+    total = 0
+    for op in scalar_ops:
+        if isinstance(op, ShapeDtype) and op.shape is not None and \
+                all(isinstance(d, int) for d in op.shape):
+            n = 1
+            for d in op.shape:
+                n *= max(d, 1)
+            total += n * element_bytes(op.dtype)
+    if total <= SMEM_BUDGET:
+        return []
+    return [Finding(
+        rule="HG503", path=mod.path, line=call.lineno, scope=scope,
+        message=(
+            f"scalar-prefetch operands total {_fmt(total)} but SMEM is "
+            f"{_fmt(SMEM_BUDGET)} per core — prefetch lands whole before "
+            f"the grid runs; segment the index array (see "
+            f"ops/pallas_gather.py SEG) or move it to a blocked VMEM "
+            f"input"
+        ),
+    )]
 
 
 # ---------------------------------------------------------------- pieces

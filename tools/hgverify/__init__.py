@@ -1,0 +1,39 @@
+"""hgverify — jaxpr-level contract verification + static cost gate.
+
+Where ``tools.hglint`` predicts TPU hazards from the AST, hgverify
+*traces* the registered kernel entry points (``hypergraphdb_tpu.verify``
+registry, populated by ``@hgverify.entry`` decorators at the kernel
+definitions) and verifies the closed jaxpr / compiled HLO itself:
+
+- **HV1xx** traced-graph purity: no ``pure_callback`` / ``io_callback``
+  / ``debug_callback`` / legacy host_callback primitives in the graph;
+- **HV2xx** collective consistency: collective axis names match the
+  entry's declared deployment mesh; ``cond`` branches carry identical
+  collective sequences;
+- **HV3xx** donation contracts: declared donations exist in the traced
+  jit, match an output buffer, and never alias two outputs;
+- **HV4xx** static cost budgets: FLOPs / bytes accessed / peak temp size
+  vs ``tools/hgverify/costs.json`` within ±15% (``--update-costs`` to
+  accept changes).
+
+CLI: ``python -m tools.hgverify`` · gate: ``tools/verify.sh`` ·
+concordance vs hglint: ``--concord``.
+"""
+
+from hypergraphdb_tpu.verify import REGISTRY, Registry, entry  # noqa: F401
+
+from tools.hgverify.costs import (  # noqa: F401
+    DEFAULT_COSTS_PATH,
+    DEFAULT_TOLERANCE,
+    load_costs,
+    write_costs,
+)
+from tools.hgverify.engine import build_report, run_verify  # noqa: F401
+from tools.hgverify.harvest import harvest, trace_entry  # noqa: F401
+from tools.hgverify.model import (  # noqa: F401
+    RULES,
+    Finding,
+    doc_anchor,
+    parse_only,
+    sort_findings,
+)

@@ -1,0 +1,108 @@
+"""``--concord``: diff jaxpr ground truth against hglint's AST layer.
+
+hglint predicts hazards from syntax; hgverify observes them in the traced
+IR. Running both over the same entry points measures each layer's blind
+spots (the EmptyHeaded move: validate plans at the IR level, then use the
+disagreement to sharpen the cheap layer):
+
+- ``hglint_false_negative`` — the jaxpr shows a hazard the AST layer
+  missed on that entry's module (a callback laundered through a helper,
+  a computed axis name, donation dropped by a wrapper);
+- ``hglint_only`` — the AST layer flags the module but the traced entry
+  is clean: either an hglint false positive, or a hazard on a code path
+  the exemplar does not exercise (both worth knowing);
+- ``agree_flagged`` / ``agree_clean`` — the layers corroborate.
+
+Comparison is at module granularity (hglint findings in the entry's
+source file vs hgverify findings on the entry), per comparable family:
+HV1xx ↔ HG1xx host sync, HV2xx ↔ HG6xx collectives, HV3xx ↔ HG106
+donation. HV4xx has no AST counterpart — cost is only visible in the IR.
+"""
+
+from __future__ import annotations
+
+from tools.hgverify.harvest import rel_path
+
+#: hgverify family prefix -> predicate over hglint rule ids
+FAMILY_MAP = {
+    "HV1": lambda r: r.startswith("HG1") and r != "HG106",
+    "HV2": lambda r: r.startswith("HG6"),
+    "HV3": lambda r: r == "HG106",
+}
+
+
+def concord(traces: list, verify_findings: list, paths: list) -> dict:
+    """Run hglint over ``paths`` and cross-tabulate with hgverify
+    findings per (entry, family). Returns the machine-readable table
+    embedded in the ``--output json`` report."""
+    from tools.hglint import engine as hglint_engine
+
+    lint = hglint_engine.run_lint(list(paths))
+    lint_by_path: dict = {}
+    for f in lint:
+        lint_by_path.setdefault(f.path.replace("\\", "/"), []).append(f)
+
+    vf_by_entry: dict = {}
+    for f in verify_findings:
+        vf_by_entry.setdefault(f.scope, []).append(f)
+
+    rows = []
+    for tr in traces:
+        entry = tr.entry
+        epath = rel_path(entry.path).replace("\\", "/")
+        module_lint = lint_by_path.get(epath, [])
+        entry_verify = vf_by_entry.get(entry.name, [])
+        for hv_prefix, hg_pred in sorted(FAMILY_MAP.items()):
+            v_rules = sorted({
+                f.rule for f in entry_verify
+                if f.rule.startswith(hv_prefix) and f.rule != "HV100"
+            })
+            l_rules = sorted({
+                f.rule for f in module_lint if hg_pred(f.rule)
+            })
+            if v_rules and l_rules:
+                verdict = "agree_flagged"
+            elif v_rules:
+                verdict = "hglint_false_negative"
+            elif l_rules:
+                verdict = "hglint_only"
+            else:
+                verdict = "agree_clean"
+            rows.append({
+                "entry": entry.name,
+                "family": hv_prefix + "xx",
+                "hgverify": v_rules,
+                "hglint": l_rules,
+                "verdict": verdict,
+            })
+    summary = {}
+    for row in rows:
+        summary[row["verdict"]] = summary.get(row["verdict"], 0) + 1
+    return {
+        "paths": list(paths),
+        "hglint_findings": len(lint),
+        "rows": rows,
+        "summary": summary,
+    }
+
+
+def render(table: dict) -> str:
+    lines = [
+        "hgverify concordance (jaxpr ground truth vs hglint AST "
+        f"predictions over {', '.join(table['paths'])}):"
+    ]
+    interesting = [r for r in table["rows"]
+                   if r["verdict"] != "agree_clean"]
+    for row in interesting:
+        lines.append(
+            f"  {row['entry']:<44} {row['family']}: "
+            f"hgverify={','.join(row['hgverify']) or '-'} "
+            f"hglint={','.join(row['hglint']) or '-'} -> {row['verdict']}"
+        )
+    if not interesting:
+        lines.append("  all (entry, family) pairs agree clean")
+    s = table["summary"]
+    lines.append(
+        "  summary: " + ", ".join(f"{k}={v}" for k, v in sorted(s.items()))
+    )
+    return "\n".join(lines)

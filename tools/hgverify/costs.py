@@ -1,0 +1,143 @@
+"""HV4xx — the static cost-model regression gate.
+
+``tools/hgverify/costs.json`` commits, per registered entry, the XLA
+static-cost fingerprint of its exemplar trace: FLOPs, bytes accessed, and
+the peak temp-buffer footprint (``memory_analysis``). Any drift beyond
+the tolerance (default ±15%) fails the gate — an op whose footprint
+silently doubles becomes a lint failure *before* any benchmark runs, and
+a legitimate optimization is accepted explicitly via ``--update-costs``
+(the same accept-or-fix loop as hglint's baseline).
+
+The numbers are CPU-backend costs under the pinned trace environment
+(``JAX_PLATFORMS=cpu``, 8 forced host devices — see ``tools/verify.sh``).
+They are not TPU-accurate in absolute terms; they are *deterministic*,
+which is the property a regression gate needs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from tools.hgverify.harvest import COST_METRICS, rel_path
+from tools.hgverify.model import Finding
+
+COSTS_VERSION = 1
+DEFAULT_TOLERANCE = 0.15
+
+DEFAULT_COSTS_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "costs.json"
+)
+
+
+def _load(path: str) -> dict:
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    if data.get("version") != COSTS_VERSION:
+        raise ValueError(
+            f"costs file {path}: version {data.get('version')} != "
+            f"{COSTS_VERSION}"
+        )
+    return data
+
+
+def load_costs(path: str) -> dict:
+    """name -> {metric: number}; {} when the file does not exist yet."""
+    return dict(_load(path).get("entries", {}))
+
+
+def load_tolerance(path: str):
+    """The costs file's committed tolerance (editable alongside the
+    budgets; ``--tolerance`` overrides), or None when absent."""
+    tol = _load(path).get("tolerance")
+    return float(tol) if isinstance(tol, (int, float)) else None
+
+
+def write_costs(traces: list, path: str) -> dict:
+    """Write current measurements for every successfully-traced entry
+    (stale names drop out by construction). Returns the entries dict."""
+    entries = {
+        tr.entry.name: dict(tr.costs)
+        for tr in sorted(traces, key=lambda t: t.entry.name)
+        if tr.ok and tr.costs is not None
+    }
+    data = {
+        "version": COSTS_VERSION,
+        "comment": "hgverify static cost budgets — XLA cost-analysis "
+                   "fingerprints of every registered entry's exemplar "
+                   "trace (CPU backend, 8 forced host devices). The gate "
+                   "fails when a live measurement drifts beyond the "
+                   "tolerance. Regenerate with: "
+                   "python -m tools.hgverify --update-costs",
+        "tolerance": DEFAULT_TOLERANCE,
+        "entries": entries,
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return entries
+
+
+def check(traces: list, budgets: dict,
+          tolerance: float = DEFAULT_TOLERANCE) -> list:
+    """HV401 drift / HV402 uncovered / HV403 stale findings."""
+    findings = []
+    live = set()
+    for tr in traces:
+        entry = tr.entry
+        live.add(entry.name)
+        if not tr.ok or tr.costs is None:
+            continue  # HV100 already covers broken entries
+        path, line, scope = rel_path(entry.path), entry.line, entry.name
+        budget = budgets.get(entry.name)
+        if budget is None:
+            findings.append(Finding(
+                rule="HV402", path=path, line=line, scope=scope,
+                message=(
+                    "entry has no budget in costs.json — cost "
+                    "regressions on it are invisible; run "
+                    "`python -m tools.hgverify --update-costs` to cover "
+                    "it"
+                ),
+            ))
+            continue
+        for metric in COST_METRICS:
+            cur = tr.costs.get(metric, 0)
+            ref = budget.get(metric, 0)
+            if not _within(cur, ref, tolerance):
+                direction = "grew" if cur > ref else "shrank"
+                findings.append(Finding(
+                    rule="HV401", path=path, line=line, scope=scope,
+                    message=(
+                        f"{metric} {direction} {ref} -> {cur} "
+                        f"({_pct(cur, ref)} beyond the "
+                        f"±{tolerance:.0%} tolerance) — fix the "
+                        f"regression, or accept the new cost with "
+                        f"--update-costs"
+                    ),
+                ))
+    for name in sorted(set(budgets) - live):
+        findings.append(Finding(
+            rule="HV403", path="tools/hgverify/costs.json", line=1,
+            scope=name,
+            message=(
+                f"costs.json budgets entry {name!r} but no such entry "
+                f"point is registered — stale budgets hide coverage "
+                f"loss; regenerate with --update-costs"
+            ),
+        ))
+    return findings
+
+
+def _within(cur, ref, tol: float) -> bool:
+    if ref == 0:
+        return cur == 0
+    return abs(cur - ref) <= tol * abs(ref)
+
+
+def _pct(cur, ref) -> str:
+    if ref == 0:
+        return "∞"
+    return f"{abs(cur - ref) / abs(ref):+.0%}".lstrip("+")

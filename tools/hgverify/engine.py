@@ -1,0 +1,126 @@
+"""hgverify orchestration: harvest -> rules -> cost gate -> report.
+
+Same CI surface as hglint's engine: sorted findings, an ``--only`` family
+filter that rejects typo'd prefixes, and a ``report_version`` 2 JSON
+report with per-rule/severity counts and doc anchors.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+from tools.hgverify import costs as costs_mod
+from tools.hgverify import rules_jaxpr
+from tools.hgverify.harvest import harvest, production_registry
+from tools.hgverify.model import (
+    Finding,
+    doc_anchor,
+    parse_only,
+    sort_findings,
+)
+
+REPORT_VERSION = 2
+
+
+def run_verify(registry=None, *, costs_path=None, only=None,
+               tolerance=None, update_costs=False) -> tuple:
+    """Verify every registered entry. Returns ``(findings, meta)`` where
+    ``meta`` carries the traces and counts the report/CLI need.
+
+    ``registry=None`` harvests the production registry (importing the
+    kernel modules); tests pass a private registry. ``update_costs=True``
+    rewrites the budget file from the current measurements instead of
+    gating against it."""
+    prefixes = parse_only(only)
+    if registry is None:
+        registry = production_registry()
+    traces = harvest(registry)
+
+    cpath = costs_path or costs_mod.DEFAULT_COSTS_PATH
+    if tolerance is None:
+        # --tolerance beats the costs file's committed tolerance beats
+        # the built-in default
+        tolerance = costs_mod.load_tolerance(cpath)
+    tol = costs_mod.DEFAULT_TOLERANCE if tolerance is None else tolerance
+
+    findings: list = []
+    findings += rules_jaxpr.check(traces)
+    if update_costs:
+        costs_mod.write_costs(traces, cpath)
+    else:
+        findings += costs_mod.check(traces, costs_mod.load_costs(cpath),
+                                    tolerance=tol)
+    all_findings = sort_findings(findings)
+    if prefixes:
+        # HV100 (broken entry) always surfaces: a family filter must not
+        # hide that the ground truth itself could not be produced
+        findings = [
+            f for f in findings
+            if f.rule == "HV100"
+            or any(f.rule.startswith(p) for p in prefixes)
+        ]
+    meta = {
+        "registered": len(registry),
+        "traced": sum(1 for t in traces if t.ok),
+        "traces": traces,
+        "costs_path": cpath,
+        "tolerance": tol,
+        "updated_costs": bool(update_costs),
+        # pre-filter findings: concordance must cross-tabulate the full
+        # ground truth, not whatever --only/--severity left visible
+        "all_findings": all_findings,
+    }
+    return sort_findings(findings), meta
+
+
+def finding_dict(f: Finding) -> dict:
+    return {
+        "rule": f.rule, "severity": f.severity, "path": f.path,
+        "line": f.line, "scope": f.scope, "message": f.message,
+        "doc": doc_anchor(f.rule),
+    }
+
+
+def build_report(findings: list, meta: dict, *, only=None,
+                 concordance=None) -> dict:
+    """``report_version`` 2 envelope, shape-compatible with hglint's
+    (tool/counts/findings keys identical) so CI consumers parse both."""
+    by_rule = Counter(f.rule for f in findings)
+    by_sev = Counter(f.severity for f in findings)
+    report = {
+        "tool": "hgverify",
+        "report_version": REPORT_VERSION,
+        "entries": {
+            "registered": meta["registered"],
+            "traced": meta["traced"],
+        },
+        "only": list(parse_only(only)),
+        "costs": {
+            "path": meta["costs_path"],
+            "tolerance": meta["tolerance"],
+            "updated": meta["updated_costs"],
+        },
+        "counts": {
+            "total": len(findings),
+            "by_rule": dict(sorted(by_rule.items())),
+            "by_severity": dict(sorted(by_sev.items())),
+        },
+        "findings": [finding_dict(f) for f in findings],
+    }
+    if concordance is not None:
+        report["concordance"] = concordance
+    return report
+
+
+def summarize(findings: list, meta: dict) -> str:
+    fam = Counter(f.rule[:3] + "xx" for f in findings)
+    parts = [
+        f"{meta['traced']}/{meta['registered']} entries traced",
+        f"{len(findings)} finding(s)" if len(findings) != 1
+        else "1 finding",
+    ]
+    if findings:
+        parts.append("by family: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(fam.items())
+        ))
+    return "; ".join(parts)

@@ -1,0 +1,124 @@
+"""Rule registry and finding model for hgverify.
+
+Mirrors ``tools/hglint/model.py`` — same finding fields, same
+``report_version`` 2 report shape — but the rules verify the **traced
+jaxpr/HLO**, not the AST: hgverify findings are ground truth for what XLA
+will actually execute, where hglint findings are predictions.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+SEVERITIES = ("error", "warning", "info")
+
+#: one-line summaries, keyed by rule id (also the rule registry)
+RULES = {
+    # -- family 1: traced-graph purity (the jaxpr itself) ---------------------
+    "HV100": "registered entry point failed to trace/lower",
+    "HV101": "pure_callback inside the traced graph (host round-trip per "
+             "dispatch)",
+    "HV102": "io_callback inside the traced graph (ordered host side "
+             "effect per dispatch)",
+    "HV103": "debug_callback/debug.print inside the traced graph",
+    "HV104": "legacy host_callback primitive inside the traced graph",
+    # -- family 2: collective/mesh consistency --------------------------------
+    "HV201": "collective axis name absent from the entry's declared mesh",
+    "HV202": "cond/switch branches carry mismatched collectives",
+    "HV203": "traced graph issues collectives but the entry declares no "
+             "mesh",
+    # -- family 3: donation contracts -----------------------------------------
+    "HV301": "donated buffer matches no output (donation silently dropped)",
+    "HV302": "donated input aliased into more than one output",
+    "HV303": "entry declares donation but the traced jit donates nothing",
+    # -- family 4: static cost budgets ----------------------------------------
+    "HV401": "entry cost metric drifted beyond tolerance vs costs.json",
+    "HV402": "entry has no budget in costs.json (uncovered)",
+    "HV403": "stale costs.json entry with no live entry point",
+}
+
+RULE_SEVERITY = {
+    "HV100": "error",
+    "HV101": "error",
+    "HV102": "error",
+    "HV103": "warning",
+    "HV104": "error",
+    "HV201": "error",
+    "HV202": "error",
+    "HV203": "error",
+    "HV301": "warning",
+    "HV302": "error",
+    "HV303": "error",
+    "HV401": "error",
+    "HV402": "warning",
+    "HV403": "error",
+}
+
+#: family prefix -> README.md section anchor
+DOC_ANCHORS = {
+    "HV1": "hv1xx-traced-graph-purity",
+    "HV2": "hv2xx-collective-mesh-consistency",
+    "HV3": "hv3xx-donation-contracts",
+    "HV4": "hv4xx-static-cost-budgets",
+}
+
+
+def doc_anchor(rule: str) -> str:
+    slug = DOC_ANCHORS.get(rule[:3], "jaxpr-verification-hgverify")
+    return f"README.md#{slug}"
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str           # source file of the entry point
+    line: int           # entry definition line
+    message: str
+    scope: str = "<entry>"    # registered entry name
+    severity: str = field(default="")
+
+    def __post_init__(self):
+        if not self.severity:
+            object.__setattr__(
+                self, "severity", RULE_SEVERITY.get(self.rule, "warning")
+            )
+
+    @property
+    def baseline_key(self) -> str:
+        return f"{self.rule}:{_norm(self.path)}:{self.scope}"
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line} {self.rule} {self.severity} "
+            f"[{self.scope}]: {self.message} [{doc_anchor(self.rule)}]"
+        )
+
+
+def _norm(path: str) -> str:
+    return path.replace(os.sep, "/")
+
+
+def sort_findings(findings):
+    sev_rank = {s: i for i, s in enumerate(SEVERITIES)}
+    return sorted(
+        findings,
+        key=lambda f: (f.scope, sev_rank.get(f.severity, 9), f.rule, f.line),
+    )
+
+
+def parse_only(only) -> tuple:
+    """``--only`` prefixes with typo rejection (same contract as hglint:
+    a prefix matching no rule raises instead of going silently green)."""
+    if not only:
+        return ()
+    if isinstance(only, str):
+        only = only.split(",")
+    prefixes = tuple(p.strip() for p in only if p and p.strip())
+    for p in prefixes:
+        if not any(r.startswith(p) for r in RULES):
+            raise ValueError(
+                f"--only prefix {p!r} matches no known rule; valid ids are "
+                f"{sorted(RULES)} (prefixes like 'HV4' select a family)"
+            )
+    return prefixes

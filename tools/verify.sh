@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# hgverify repo gate: traces every registered kernel entry point and fails
+# on any jaxpr-contract violation (HV1xx-HV3xx) or static cost drift
+# beyond tolerance (HV4xx vs tools/hgverify/costs.json). Tier-1 enforces
+# the same checks via tests/test_hgverify.py.
+#
+# Exit codes: 0 clean · 1 findings · >= 2 analyzer crash / usage error
+# (a crash is an infrastructure failure, NOT a finding — CI must fail it
+# loudly instead of reporting "1 finding"). Same contract as tools/lint.sh.
+#
+# The CLI pins the trace environment itself (JAX_PLATFORMS=cpu, 8 forced
+# host devices) so the committed costs.json numbers reproduce everywhere.
+#
+# Usage: tools/verify.sh [extra hgverify args]
+#   tools/verify.sh --only HV4          # cost gate only, fast local run
+#   tools/verify.sh --update-costs      # accept current costs as budgets
+#   tools/verify.sh --concord           # diff ground truth vs hglint
+#   tools/verify.sh --output json       # machine-readable CI report
+set -uo pipefail
+cd "$(dirname "$0")/.."
+python -m tools.hgverify "$@"
+rc=$?
+if [ "$rc" -ge 2 ]; then
+    echo "tools/verify.sh: hgverify analyzer crashed (exit $rc);" \
+         "fix the analyzer before trusting this gate" >&2
+fi
+exit "$rc"
