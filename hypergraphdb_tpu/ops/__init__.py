@@ -9,7 +9,12 @@ from hypergraphdb_tpu.ops.bitfrontier import (
     unpack_visited,
 )
 from hypergraphdb_tpu.ops.ellbfs import PullBFSResult, bfs_pull, visited_rows
-from hypergraphdb_tpu.ops.incremental import SnapshotManager, bfs_levels_delta
+from hypergraphdb_tpu.ops.incremental import (
+    PinnedView,
+    SnapshotManager,
+    bfs_levels_delta,
+)
+from hypergraphdb_tpu.ops.serving import bfs_serve_batch, pattern_serve_batch
 from hypergraphdb_tpu.ops.setops import (
     and_incident_pattern,
     collect_pattern,
@@ -27,8 +32,11 @@ from hypergraphdb_tpu.ops.checkpoint import (
 __all__ = [
     "CSRSnapshot",
     "DeviceSnapshot",
+    "PinnedView",
     "PullBFSResult",
     "SnapshotManager",
+    "bfs_serve_batch",
+    "pattern_serve_batch",
     "and_incident_pattern",
     "bfs_levels",
     "bfs_pull",

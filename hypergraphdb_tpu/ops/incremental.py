@@ -23,7 +23,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -138,6 +138,22 @@ def _splice(buf: jax.Array, tail: jax.Array, offset: jax.Array) -> jax.Array:
     return jax.lax.dynamic_update_slice(buf, tail, (offset,))
 
 
+class PinnedView(NamedTuple):
+    """One consistent read unit for a serving batch: the base snapshot, its
+    device pair, AND the host memtable correction sets, all captured under a
+    single manager lock. A batch built from one PinnedView can never
+    straddle a compaction swap — the serve-layer twin of
+    :meth:`SnapshotManager.read_view` (which returns host-only views)."""
+
+    base: CSRSnapshot
+    device: DeviceSnapshot
+    delta: Optional[DeviceDelta]  # None when pinned with sync_delta=False
+    epoch: int          # compaction counter the pair belongs to
+    dead: set           # tombstoned handles not yet baked into the base
+    new_atoms: list     # handles added since the base pack (commit order)
+    revalued: set       # values replaced since the base pack
+
+
 class SnapshotManager:
     """Owns the (base, delta) pair for one graph: listens to mutation
     events, accumulates host-side delta buffers, re-uploads the (bucketed)
@@ -184,6 +200,9 @@ class SnapshotManager:
         self.base: Optional[CSRSnapshot] = None
         self._capacity = 0
         self._lock = threading.RLock()
+        # signalled whenever a background compaction pass finishes —
+        # wait_compacted() blocks on it instead of polling delta_edges
+        self._compact_cv = threading.Condition(self._lock)
         self._compacting = False
         self._compact_thread = None
         # host delta buffers (the memtable)
@@ -368,8 +387,9 @@ class SnapshotManager:
                         if not self._needs_recompact:
                             break
             finally:
-                with self._lock:
+                with self._compact_cv:
                     self._compacting = False
+                    self._compact_cv.notify_all()
 
         t = threading.Thread(target=work, name="hgdb-compact", daemon=True)
         with self._lock:
@@ -414,20 +434,77 @@ class SnapshotManager:
         self._maybe_compact()
         with self._lock:
             base = self.base
-            # epoch keyed on the monotonic compaction counter — id(base)
-            # could be REUSED by CPython after the old base is collected,
-            # silently pairing an old device delta with a new base
-            marker = (self.compactions, len(self._inc_links), len(self._dead))
-            stale = self._device_delta is None or marker[0] != self._uploaded_marker[0]
-            if not stale and self._delta_dirty:
-                drift = (
-                    marker[1] - self._uploaded_marker[1]
-                    + marker[2] - self._uploaded_marker[2]
-                )
-                stale = drift > max_lag_edges
-            if stale:
-                self._refresh_device_delta_locked(marker)
+            self._sync_device_delta_locked(max_lag_edges)
             return base.device, self._device_delta
+
+    def _sync_device_delta_locked(self, max_lag_edges: int) -> None:
+        """Refresh the device delta if it drifted beyond ``max_lag_edges``
+        (caller holds the mgr lock — the shared core of :meth:`device` and
+        :meth:`pinned_view`)."""
+        # epoch keyed on the monotonic compaction counter — id(base)
+        # could be REUSED by CPython after the old base is collected,
+        # silently pairing an old device delta with a new base
+        marker = (self.compactions, len(self._inc_links), len(self._dead))
+        stale = self._device_delta is None or marker[0] != self._uploaded_marker[0]
+        if not stale and self._delta_dirty:
+            drift = (
+                marker[1] - self._uploaded_marker[1]
+                + marker[2] - self._uploaded_marker[2]
+            )
+            stale = drift > max_lag_edges
+        if stale:
+            self._refresh_device_delta_locked(marker)
+
+    def pinned_view(self, max_lag_edges: int = 0,
+                    sync_delta: bool = True) -> PinnedView:
+        """The serving read unit: (base, device pair, memtable correction)
+        captured under ONE lock. ``device()`` + a separate ``correction()``
+        can straddle a background swap — a batch assembled from this view
+        cannot: every request in it reads the same epoch, and the host
+        correction sets compensate for exactly the delta this view's device
+        overlay has (or has not, under ``max_lag_edges`` drift) seen.
+
+        ``sync_delta=False`` skips the device-delta refresh entirely and
+        returns ``delta=None`` — for readers (the pattern serving path)
+        that consume only the base plus the HOST correction sets, paying a
+        host→HBM delta upload per memtable change on their hot path would
+        buy nothing."""
+        self._maybe_compact()
+        with self._lock:
+            base = self.base
+            if sync_delta:
+                self._sync_device_delta_locked(max_lag_edges)
+            return PinnedView(
+                base=base,
+                device=base.device,
+                delta=self._device_delta if sync_delta else None,
+                epoch=self.compactions,
+                dead=set(self._dead),
+                new_atoms=list(self._new_atoms),
+                revalued=set(self._revalued),
+            )
+
+    def wait_compacted(self, timeout: Optional[float] = None) -> bool:
+        """Block until no compaction pass is in flight (bounded by
+        ``timeout`` seconds; ``None`` waits forever). Returns True when
+        quiesced, False on timeout — so serve-layer drains and tests await
+        the swap directly instead of polling ``delta_edges``. A pass that
+        re-queues itself (``_needs_recompact`` coalescing) is covered: the
+        worker clears ``_compacting`` only after its bounded catch-up loop
+        settles."""
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        with self._compact_cv:
+            while self._compacting:
+                remaining = (
+                    None if deadline is None
+                    else deadline - _time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._compact_cv.wait(remaining)
+            return True
 
     def _refresh_device_delta_locked(self, marker) -> None:
         """Re-materialize the device delta (the ``_locked`` suffix

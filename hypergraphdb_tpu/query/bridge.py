@@ -1,0 +1,98 @@
+"""Condition → batched serving request: the supported subset.
+
+The serving runtime batches two device shapes — K-seed BFS and K
+conjunctive incident patterns. This module maps the query-condition
+vocabulary onto them:
+
+==========================================  ================================
+condition                                   request
+==========================================  ================================
+``BFS(start, max_distance=d)``              ``BFSRequest(start, d)``
+``Incident(t)``                             ``PatternRequest((t,))``
+``TypedIncident(t, T)``                     ``PatternRequest((t,), T)``
+``Link(t1, .., tn)``                        ``PatternRequest((t1, .., tn))``
+``And(Incident.., [AtomType])``             ``PatternRequest(anchors, T)``
+==========================================  ================================
+
+Anything else — value predicates, Or/Not, regex, unbounded BFS — raises a
+typed :class:`~hypergraphdb_tpu.serve.types.Unservable`: the caller runs
+those through ``graph.find_all`` (the planner's host/one-shot device
+paths stay exact and general; the serving subset is deliberately the two
+batch-native shapes). This is honest scoping, not a fallback-in-disguise:
+a serving tier that silently degraded to one-shot execution would destroy
+the latency contract it exists to provide.
+"""
+
+from __future__ import annotations
+
+from hypergraphdb_tpu.query import conditions as c
+from hypergraphdb_tpu.serve.types import (
+    BFSRequest,
+    PatternRequest,
+    Unservable,
+)
+
+
+def _type_handle(graph, type_cond: c.AtomType) -> int:
+    if graph is None and isinstance(type_cond.type, str):
+        raise Unservable(
+            "type names need a graph to resolve; pass a type handle"
+        )
+    return int(type_cond.type_handle(graph)) if isinstance(
+        type_cond.type, str
+    ) else int(type_cond.type)
+
+
+def to_request(graph, condition, *, default_max_hops: int = 2):
+    """Translate ``condition`` into a batchable request, or raise
+    :class:`Unservable` naming the unsupported shape."""
+    if isinstance(condition, c.BFS):
+        hops = condition.max_distance
+        if hops is None:
+            # fixed-shape kernels need a static hop count; an unbounded
+            # traversal has no batchable device form
+            raise Unservable(
+                "unbounded BFS is not batchable; set max_distance (the "
+                f"runtime default is {default_max_hops})"
+            )
+        return BFSRequest(int(condition.start), int(hops),
+                          include_seed=bool(condition.include_start))
+    if isinstance(condition, c.Incident):
+        return PatternRequest((int(condition.target),))
+    if isinstance(condition, c.TypedIncident):
+        return PatternRequest(
+            (int(condition.target),),
+            _type_handle(graph, c.AtomType(condition.type)),
+        )
+    if isinstance(condition, c.Link):
+        return PatternRequest(tuple(int(t) for t in condition.targets))
+    if isinstance(condition, c.And):
+        anchors: list[int] = []
+        type_h = None
+        for cl in condition.clauses:
+            if isinstance(cl, c.Incident):
+                anchors.append(int(cl.target))
+            elif isinstance(cl, c.TypedIncident):
+                anchors.append(int(cl.target))
+                th = _type_handle(graph, c.AtomType(cl.type))
+                if type_h is not None and type_h != th:
+                    raise Unservable("conflicting type constraints")
+                type_h = th
+            elif isinstance(cl, c.AtomType):
+                th = _type_handle(graph, cl)
+                if type_h is not None and type_h != th:
+                    raise Unservable("conflicting type constraints")
+                type_h = th
+            else:
+                raise Unservable(
+                    f"{type(cl).__name__} inside And is outside the "
+                    "batchable subset (Incident/TypedIncident/AtomType)"
+                )
+        if not anchors:
+            raise Unservable("And without an Incident anchor has no "
+                             "batchable device form")
+        return PatternRequest(tuple(anchors), type_h)
+    raise Unservable(
+        f"{type(condition).__name__} is outside the batchable subset; "
+        "use graph.find_all"
+    )

@@ -1,0 +1,71 @@
+"""Query-serving runtime: async micro-batching with admission control.
+
+The kernels under ``ops/`` are batch-native (K seeds / K queries per
+dispatch) but every caller-facing entry point so far was one-shot — each
+caller paid a full device dispatch alone (BENCH_r05 ``c5_streaming``:
+p99 = 4.4 s under concurrent ingest). This package turns the kernel
+library into a service using the continuous-batching shape of inference
+stacks:
+
+- requests enter a **bounded admission queue** (``admission.py``) with
+  per-request deadlines; expired requests are shed IN the queue with a
+  typed :class:`DeadlineExceeded` — never a wasted device dispatch;
+- a batcher (``batcher.py``) coalesces compatible requests and flushes
+  **shape-bucketed micro-batches** (pad-to-bucket K ∈ {64, 256, 1024}) on
+  batch-full or max-linger timeout;
+- a dedicated dispatch thread (``runtime.py``) double-buffers: host-side
+  assembly of batch N+1 overlaps device execution of batch N;
+- every batch pins a consistent read view via
+  ``SnapshotManager.pinned_view(max_lag_edges=...)`` so no request ever
+  straddles a compaction swap;
+- ``stats.py`` records queue depth, batch occupancy, shed counts, and
+  latency percentiles.
+
+Entry point::
+
+    from hypergraphdb_tpu.serve import ServeRuntime, ServeConfig
+
+    with ServeRuntime(graph, ServeConfig(max_lag_edges=0)) as rt:
+        fut = rt.submit_bfs(seed, max_hops=2, deadline_s=0.1)
+        res = fut.result()          # ServeResult | raises DeadlineExceeded
+"""
+
+from hypergraphdb_tpu.serve.types import (
+    BFSRequest,
+    Clock,
+    DeadlineExceeded,
+    PatternRequest,
+    QueueFull,
+    RuntimeClosed,
+    ServeError,
+    ServeResult,
+    Unservable,
+)
+from hypergraphdb_tpu.serve.stats import ServeStats
+from hypergraphdb_tpu.serve.admission import AdmissionQueue
+from hypergraphdb_tpu.serve.batcher import Batcher, MicroBatch, bucket_for
+from hypergraphdb_tpu.serve.runtime import (
+    DeviceExecutor,
+    ServeConfig,
+    ServeRuntime,
+)
+
+__all__ = [
+    "AdmissionQueue",
+    "Batcher",
+    "BFSRequest",
+    "Clock",
+    "DeadlineExceeded",
+    "DeviceExecutor",
+    "MicroBatch",
+    "PatternRequest",
+    "QueueFull",
+    "RuntimeClosed",
+    "ServeConfig",
+    "ServeError",
+    "ServeResult",
+    "ServeRuntime",
+    "ServeStats",
+    "Unservable",
+    "bucket_for",
+]

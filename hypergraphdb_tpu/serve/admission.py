@@ -1,0 +1,182 @@
+"""Bounded admission queue: backpressure + in-queue deadline shedding.
+
+The queue is the runtime's ONLY synchronization point between submitters
+and the dispatch thread: one condition variable guards a deque of
+:class:`~.types.Ticket`. Backpressure policy is per-queue:
+
+- ``"block"`` — ``submit`` waits for space (bounded by the request's own
+  deadline when it has one: a request that would expire while waiting is
+  shed immediately, with the queue untouched);
+- ``"fail"``  — ``submit`` raises :class:`~.types.QueueFull` at once.
+
+Deadline shedding happens at pop time (``shed_expired``): an expired
+ticket's future completes with a typed :class:`~.types.DeadlineExceeded`
+and the ticket never reaches a batch — a dead request costs zero device
+work.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Optional
+
+from hypergraphdb_tpu.serve.stats import ServeStats
+from hypergraphdb_tpu.serve.types import (
+    Clock,
+    QueueFull,
+    RuntimeClosed,
+    Ticket,
+)
+
+
+class AdmissionQueue:
+    """Bounded FIFO of tickets with deadline shedding.
+
+    All mutation happens under one condition variable; the dispatch thread
+    waits on the same cv (``wait_for_work``) so a submit wakes it without
+    polling."""
+
+    def __init__(self, capacity: int, policy: str = "block",
+                 clock: Clock = None, stats: Optional[ServeStats] = None):
+        if policy not in ("block", "fail"):
+            raise ValueError(f"unknown admission policy {policy!r}")
+        if capacity < 1:
+            raise ValueError("queue capacity must be >= 1")
+        import time
+
+        self.capacity = capacity
+        self.policy = policy
+        self.clock = clock or time.monotonic
+        self.stats = stats or ServeStats()
+        self._cv = threading.Condition()
+        self._dq: deque[Ticket] = deque()
+        self._closed = False
+
+    # -- submit side ---------------------------------------------------------
+    def submit(self, ticket: Ticket) -> Ticket:
+        """Enqueue (or shed / reject) one ticket; returns it either way —
+        a shed ticket's future already carries ``DeadlineExceeded``."""
+        with self._cv:
+            while True:
+                if self._closed:
+                    raise RuntimeClosed("runtime is closed")
+                if len(self._dq) < self.capacity:
+                    self._dq.append(ticket)
+                    self.stats.record_submit()
+                    self._cv.notify_all()
+                    return ticket
+                if self.policy == "fail":
+                    self.stats.record_reject()
+                    raise QueueFull(
+                        f"admission queue full ({self.capacity})"
+                    )
+                # block policy: wait for space, bounded by the request's
+                # own deadline — expiring in THIS wait is still "expired
+                # in the queue", shed the same way
+                now = self.clock()
+                if ticket.expired(now):
+                    # counts as submitted-then-shed so the accounting
+                    # identity holds: submitted == completed + shed +
+                    # cancelled + in-flight
+                    self.stats.record_submit()
+                    ticket.shed(now)
+                    self.stats.record_shed()
+                    return ticket
+                timeout = (
+                    None if ticket.deadline_t is None
+                    else max(ticket.deadline_t - now, 0.0)
+                )
+                self._cv.wait(timeout)
+
+    # -- dispatch side -------------------------------------------------------
+    def shed_expired(self, now: float) -> int:
+        """Complete every expired ticket with DeadlineExceeded and drop it
+        from the queue. Returns the shed count."""
+        shed = 0
+        with self._cv:
+            live = deque()
+            for t in self._dq:
+                if t.expired(now):
+                    t.shed(now)
+                    self.stats.record_shed()
+                    shed += 1
+                else:
+                    live.append(t)
+            if shed:
+                self._dq = live
+                self._cv.notify_all()  # space freed: wake blocked submits
+        return shed
+
+    def take(self, batch_key: tuple, max_n: int) -> list:
+        """Remove and return up to ``max_n`` tickets with ``batch_key``,
+        preserving FIFO order; other keys stay queued in order."""
+        with self._cv:
+            out, rest = [], deque()
+            for t in self._dq:
+                if len(out) < max_n and t.batch_key == batch_key:
+                    out.append(t)
+                else:
+                    rest.append(t)
+            self._dq = rest
+            if out:
+                self._cv.notify_all()
+            return out
+
+    def front(self) -> Optional[Ticket]:
+        with self._cv:
+            return self._dq[0] if self._dq else None
+
+    def count_key(self, batch_key: tuple) -> int:
+        with self._cv:
+            return sum(1 for t in self._dq if t.batch_key == batch_key)
+
+    def depth(self) -> int:
+        with self._cv:
+            return len(self._dq)
+
+    def wait_for_work(self, timeout: Optional[float] = None) -> bool:
+        """Dispatch-thread parking: returns True when the queue is
+        non-empty or closed (else after ``timeout``)."""
+        with self._cv:
+            if self._dq or self._closed:
+                return True
+            self._cv.wait(timeout)
+            return bool(self._dq) or self._closed
+
+    def park(self, timeout: float) -> None:
+        """Sleep up to ``timeout`` seconds, waking early on any queue
+        event (submit/close) — the dispatch thread's linger wait when
+        requests are already queued but the flush policy says not yet."""
+        with self._cv:
+            if self._closed:
+                return
+            self._cv.wait(timeout)
+
+    # -- lifecycle -----------------------------------------------------------
+    def close(self) -> None:
+        """Stop admitting; queued tickets stay for draining."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        with self._cv:
+            return self._closed
+
+    def cancel_all(self) -> int:
+        """Fail every queued ticket with RuntimeClosed (non-drain close)."""
+        with self._cv:
+            n = len(self._dq)
+            for t in self._dq:
+                t.fail(RuntimeClosed("runtime closed"))
+                self.stats.record_cancel()
+            self._dq.clear()
+            self._cv.notify_all()
+            return n
+
+    def wake(self) -> None:
+        """Nudge any waiter (used on close and by fake-clock tests)."""
+        with self._cv:
+            self._cv.notify_all()

@@ -1,0 +1,564 @@
+"""The serving runtime: dispatch loop, device executor, lifecycle.
+
+Request path::
+
+    submit_*() → AdmissionQueue (bounded, deadline-shedding)
+        → Batcher (coalesce + pad-to-bucket, flush on full/linger)
+            → Executor.launch()  — pin view, assemble, async device dispatch
+                → Executor.collect() — sync, LSM-correct, complete futures
+
+The dispatch thread **double-buffers**: ``pump()`` launches batch N+1
+BEFORE collecting batch N's results, so host-side assembly of the next
+batch (numpy padding, anchor ordering, delta refresh) overlaps device
+execution of the current one — JAX dispatch is asynchronous, the
+``launch`` never blocks on the device.
+
+Consistency: every batch is assembled from ONE
+:class:`~hypergraphdb_tpu.ops.incremental.PinnedView` — base, device
+delta, and the host memtable captured under a single manager lock — so a
+background compaction swapping mid-batch cannot desync what the kernel
+reads from what the host correction compensates. BFS requests see
+base ∪ delta directly in the kernel (staleness bounded by
+``max_lag_edges``); pattern requests run on the base and the memtable is
+merged at collect time (the ``query/compiler.DeviceValueConjPlan`` LSM
+read-merge) against candidate records CAPTURED when the batch launched —
+never the live graph — so every answer in a batch reflects the pinned
+view's single point in the manager's event stream, however long the
+device ran.
+
+Deterministic testing: ``ServeConfig(manual=True)`` starts no thread —
+tests drive ``step()`` / ``pump()`` with an injected clock and a fake
+executor, making deadline shedding, flush policy, and drains exactly
+reproducible.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+from hypergraphdb_tpu.serve.admission import AdmissionQueue
+from hypergraphdb_tpu.serve.batcher import BUCKETS, Batcher, MicroBatch
+from hypergraphdb_tpu.serve.stats import ServeStats
+from hypergraphdb_tpu.serve.types import (
+    BFSRequest,
+    Clock,
+    PatternRequest,
+    ServeResult,
+    Ticket,
+    Unservable,
+)
+
+
+@dataclass
+class ServeConfig:
+    """Knobs of one runtime; defaults suit the streaming-bench scale."""
+
+    buckets: Sequence[int] = BUCKETS        # pad-to-bucket request widths
+    max_queue: int = 4096                   # admission queue bound
+    policy: str = "block"                   # backpressure: "block" | "fail"
+    max_linger_s: float = 0.002             # flush latency bound
+    default_deadline_s: Optional[float] = None
+    max_lag_edges: int = 0                  # delta staleness bound (BFS)
+    top_r: int = 128                        # compact result window
+    pattern_pad: int = 128                  # base-row budget per pattern
+    default_max_hops: int = 2
+    clock: Optional[Clock] = None           # injectable time source
+    manual: bool = False                    # no thread; tests call step()
+    latency_window: int = 4096
+
+
+@dataclass
+class LaunchedBatch:
+    """An in-flight batch: the async device handles plus everything
+    ``collect`` needs to turn them into per-ticket results."""
+
+    batch: MicroBatch
+    view: object = None                  # ops.incremental.PinnedView
+    dev_out: object = None               # async (counts, first_r) handles
+    lane_tickets: list = field(default_factory=list)   # [(lane, Ticket)]
+    host_tickets: list = field(default_factory=list)   # exact-fallback path
+    #: pattern batches: {handle: (target_set, type_handle)} of memtable
+    #: candidates, captured AT LAUNCH (pin time ± µs) so collect-time
+    #: corrections never read the live graph mid-ingest
+    cand_records: dict = field(default_factory=dict)
+
+
+class DeviceExecutor:
+    """The real executor: batched kernels over a pinned snapshot view.
+
+    Requests the fixed-shape kernels cannot serve exactly — seeds/anchors
+    beyond the base's id space (atoms newer than the last compaction),
+    base rows wider than ``pattern_pad``, or a snapshot without ELL
+    targets — fall back to exact host execution at collect time, counted
+    in ``stats.host_fallbacks``."""
+
+    def __init__(self, graph, config: ServeConfig,
+                 stats: Optional[ServeStats] = None):
+        if graph is None:
+            raise ValueError("DeviceExecutor needs a graph")
+        self.graph = graph
+        self.config = config
+        self.stats = stats or ServeStats()
+        # serving implies ingest-concurrent reads: the incremental
+        # (base, delta) pair IS the consistency mechanism
+        self.mgr = graph.incremental or graph.enable_incremental()
+
+    # -- launch (async: never blocks on the device) --------------------------
+    def launch(self, batch: MicroBatch) -> LaunchedBatch:
+        import jax.numpy as jnp
+
+        kind = batch.key[0]
+        # pattern batches read base + HOST corrections only — don't pay a
+        # device-delta upload on their hot path
+        view = self.mgr.pinned_view(self.config.max_lag_edges,
+                                    sync_delta=(kind == "bfs"))
+        out = LaunchedBatch(batch=batch, view=view)
+        if kind == "bfs":
+            max_hops = batch.key[1]
+            n = view.base.num_atoms
+            seeds = np.full(batch.bucket, n, dtype=np.int32)  # pad → dummy
+            lane = 0
+            for t in batch.tickets:
+                if t.request.seed >= n or t.request.seed < 0:
+                    out.host_tickets.append(t)
+                    continue
+                seeds[lane] = t.request.seed
+                out.lane_tickets.append((lane, t))
+                lane += 1
+            if out.lane_tickets:
+                from hypergraphdb_tpu.ops.serving import bfs_serve_batch
+
+                # one slot beyond top_r: an include_seed=False request
+                # drops its seed from the window, and the spare slot keeps
+                # the remaining prefix full-width (see _bfs_result)
+                top_r = min(self.config.top_r + 1, n + 1)
+                out.dev_out = bfs_serve_batch(
+                    view.device, view.delta, jnp.asarray(seeds),
+                    max_hops, top_r,
+                )
+        elif kind == "pattern":
+            from hypergraphdb_tpu.ops.serving import NO_TYPE, \
+                pattern_serve_batch
+            from hypergraphdb_tpu.ops.setops import ell_targets
+
+            P = batch.key[1]
+            n = view.base.num_atoms
+            ell = ell_targets(view.base)
+            off = view.base.inc_offsets
+            anchors = np.full((batch.bucket, P), n, dtype=np.int32)
+            type_vec = np.full(batch.bucket, NO_TYPE, dtype=np.int32)
+            lane = 0
+            for t in batch.tickets:
+                req = t.request
+                a = np.asarray(req.anchors, dtype=np.int64)
+                if ell is None or a.min() < 0 or a.max() >= n:
+                    out.host_tickets.append(t)
+                    continue
+                lens = off[a + 1].astype(np.int64) - off[a]
+                order = np.argsort(lens, kind="stable")
+                if lens[order[0]] > self.config.pattern_pad:
+                    out.host_tickets.append(t)  # base row over budget
+                    continue
+                anchors[lane] = a[order]
+                if req.type_handle is not None:
+                    type_vec[lane] = int(req.type_handle)
+                out.lane_tickets.append((lane, t))
+                lane += 1
+            if out.lane_tickets:
+                out.cand_records = self._capture_candidates(view)
+                out.dev_out = pattern_serve_batch(
+                    view.device, ell, jnp.asarray(anchors),
+                    jnp.asarray(type_vec),
+                    self.config.pattern_pad, self.config.top_r,
+                )
+        else:  # pragma: no cover - batch keys come from our own requests
+            raise Unservable(f"unknown batch kind {kind!r}")
+        if out.dev_out is not None:
+            self.stats.record_device_dispatch()
+        return out
+
+    def _capture_candidates(self, view) -> dict:
+        """Memtable candidates' (targets, type), read ONCE per batch right
+        after the view is pinned: collect-time corrections then evaluate
+        pin-time state, not whatever the live graph mutated into while the
+        device ran. A candidate whose record vanished inside the µs-wide
+        pin→capture window is treated as dead — equivalent to having
+        pinned a moment later. Node candidates (no targets) can never
+        match a pattern and drop out here too."""
+        g = self.graph
+        recs = {}
+        for h in (set(view.new_atoms) | view.revalued) - view.dead:
+            try:
+                ts = {int(t) for t in g.get_targets(h)}
+                th = int(g.get_type_handle_of(h))
+            except Exception:
+                continue
+            recs[h] = (ts, th)
+        return recs
+
+    # -- collect (sync: downloads compact results, corrects, resolves) -------
+    def collect(self, launched: LaunchedBatch) -> list:
+        from hypergraphdb_tpu.ops.setops import SENTINEL
+
+        out = []
+        view = launched.view
+        if launched.dev_out is not None:
+            counts, first_r = (np.asarray(x) for x in launched.dev_out)
+            kind = launched.batch.key[0]
+            if kind == "pattern":
+                # batch-invariant memtable views, hoisted off the
+                # per-lane path (a 1024-lane batch over a deep memtable
+                # would otherwise rebuild these sets 1024×)
+                drop = view.dead | view.revalued
+                drop_arr = (np.fromiter(drop, dtype=np.int64)
+                            if drop else np.empty(0, dtype=np.int64))
+            for lane, ticket in launched.lane_tickets:
+                row = first_r[lane]
+                matches = row[row != SENTINEL].astype(np.int64)
+                count = int(counts[lane])
+                if kind == "bfs":
+                    res = self._bfs_result(ticket.request, count, matches,
+                                           view)
+                else:
+                    res = self._pattern_result(ticket.request, count,
+                                               matches, view, drop_arr,
+                                               launched.cand_records)
+                out.append((ticket, res))
+        for ticket in launched.host_tickets:
+            self.stats.record_host_fallback()
+            try:
+                if ticket.request.kind == "bfs":
+                    out.append((ticket, self._host_bfs(ticket.request,
+                                                       view.epoch)))
+                else:
+                    out.append((ticket, self._host_pattern(ticket.request,
+                                                           view.epoch)))
+            except Exception as e:  # surface, don't kill the batch
+                out.append((ticket, e))
+        return out
+
+    # -- per-request result assembly -----------------------------------------
+    def _bfs_result(self, req: BFSRequest, count: int,
+                    matches: np.ndarray, view) -> ServeResult:
+        if not req.include_seed and count > 0:
+            # a live seed is always in its own visited set
+            count -= 1
+            matches = matches[matches != req.seed]
+        matches = matches[: self.config.top_r]  # trim the spare slot
+        truncated = count > len(matches)
+        return ServeResult("bfs", count, matches, truncated, view.epoch)
+
+    def _pattern_result(self, req: PatternRequest, count: int,
+                        matches: np.ndarray, view, drop_arr: np.ndarray,
+                        cand_records: dict) -> ServeResult:
+        truncated = count > len(matches)
+        if truncated and (len(drop_arr) or cand_records):
+            # corrections against a prefix we cannot see past are not
+            # reconstructible (a tombstone beyond the window would
+            # overcount, a fresh link would punch a hole in the prefix) —
+            # serve this rare shape exactly on host instead of bending
+            # the count/prefix contract
+            self.stats.record_host_fallback()
+            return self._host_pattern(req, view.epoch)
+        if truncated:
+            # memtable quiet (checked above): device numbers are exact
+            return ServeResult("pattern", count, matches, True, view.epoch)
+        # LSM read-merge over the COMPLETE result set: drop links
+        # tombstoned/revalued since the pack, evaluate the pattern over
+        # the captured memtable records (pin-time state — never the live
+        # graph) — exact at any delta lag.
+        if len(drop_arr) and len(matches):
+            matches = matches[~np.isin(matches, drop_arr)]
+        fresh = [
+            h for h, (ts, th) in cand_records.items()
+            if all(a in ts for a in req.anchors)
+            and (req.type_handle is None or th == int(req.type_handle))
+        ]
+        if fresh:
+            matches = np.union1d(matches,
+                                 np.asarray(fresh, dtype=np.int64))
+        count = len(matches)
+        top_r = self.config.top_r
+        if count > top_r:
+            # the merge pushed the full set past the compact window:
+            # same shape contract as every other truncated result
+            return ServeResult("pattern", count, matches[:top_r], True,
+                               view.epoch)
+        return ServeResult("pattern", count, matches, False, view.epoch)
+
+    # -- exact host fallbacks -------------------------------------------------
+    def _host_bfs(self, req: BFSRequest, epoch: int) -> ServeResult:
+        from hypergraphdb_tpu.algorithms.traversals import (
+            HGBreadthFirstTraversal,
+        )
+
+        reached = {
+            int(atom) for _, atom in HGBreadthFirstTraversal(
+                self.graph, req.seed, max_distance=req.max_hops
+            )
+        }
+        if req.include_seed:
+            reached.add(int(req.seed))
+        else:
+            reached.discard(int(req.seed))
+        arr = np.asarray(sorted(reached), dtype=np.int64)
+        top_r = self.config.top_r
+        return ServeResult("bfs", len(arr), arr[:top_r],
+                           len(arr) > top_r, epoch, served_by="host")
+
+    def _host_pattern(self, req: PatternRequest, epoch: int) -> ServeResult:
+        from hypergraphdb_tpu.query import conditions as c
+
+        clauses = [c.Incident(a) for a in req.anchors]
+        if req.type_handle is not None:
+            clauses.append(c.AtomType(int(req.type_handle)))
+        cond = clauses[0] if len(clauses) == 1 else c.And(*clauses)
+        arr = np.asarray(sorted(int(h) for h in self.graph.find_all(cond)),
+                         dtype=np.int64)
+        top_r = self.config.top_r
+        return ServeResult("pattern", len(arr), arr[:top_r],
+                           len(arr) > top_r, epoch, served_by="host")
+
+
+class ServeRuntime:
+    """The serving front door. Threaded by default; ``manual=True`` for
+    deterministic stepping (tests). Context manager: ``close(drain=True)``
+    on exit."""
+
+    def __init__(self, graph=None, config: Optional[ServeConfig] = None,
+                 executor=None):
+        self.config = config or ServeConfig()
+        self.clock: Clock = self.config.clock or time.monotonic
+        self.stats = ServeStats(self.config.latency_window)
+        self.queue = AdmissionQueue(
+            self.config.max_queue, self.config.policy, self.clock,
+            self.stats,
+        )
+        self.batcher = Batcher(self.queue, self.config.buckets,
+                               self.config.max_linger_s)
+        self.executor = (
+            executor if executor is not None
+            else DeviceExecutor(graph, self.config, self.stats)
+        )
+        self.graph = graph
+        self._pending: Optional[tuple] = None  # (tickets, executor token)
+        self._closed = False
+        self._close_started = False
+        self._draining = False
+        self._close_lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        if not self.config.manual:
+            self._thread = threading.Thread(
+                target=self._loop, name="hgdb-serve", daemon=True
+            )
+            self._thread.start()
+
+    # -- submit --------------------------------------------------------------
+    def submit(self, request, deadline_s: Optional[float] = None) -> Future:
+        """Admit one request; returns its future. Raises
+        :class:`~.types.QueueFull` under fail-fast backpressure,
+        :class:`~.types.RuntimeClosed` after close; a deadline that expires
+        while blocked lands ON the future as DeadlineExceeded."""
+        now = self.clock()
+        dl = (deadline_s if deadline_s is not None
+              else self.config.default_deadline_s)
+        ticket = Ticket(
+            request=request, submit_t=now,
+            deadline_t=None if dl is None else now + dl,
+        )
+        self.queue.submit(ticket)
+        return ticket.future
+
+    def submit_bfs(self, seed: int, max_hops: Optional[int] = None,
+                   deadline_s: Optional[float] = None,
+                   include_seed: bool = True) -> Future:
+        return self.submit(
+            BFSRequest(int(seed),
+                       max_hops if max_hops is not None
+                       else self.config.default_max_hops,
+                       include_seed),
+            deadline_s,
+        )
+
+    def submit_pattern(self, anchors: Sequence[int],
+                       type_handle: Optional[int] = None,
+                       deadline_s: Optional[float] = None) -> Future:
+        return self.submit(
+            PatternRequest(tuple(int(a) for a in anchors),
+                           None if type_handle is None
+                           else int(type_handle)),
+            deadline_s,
+        )
+
+    def submit_query(self, condition,
+                     deadline_s: Optional[float] = None) -> Future:
+        """Admit a query CONDITION (the batchable subset — see
+        ``query/bridge``). Raises :class:`~.types.Unservable` for
+        conditions outside it."""
+        from hypergraphdb_tpu.query.bridge import to_request
+
+        return self.submit(
+            to_request(self.graph, condition,
+                       default_max_hops=self.config.default_max_hops),
+            deadline_s,
+        )
+
+    # -- dispatch ------------------------------------------------------------
+    def step(self, drain: bool = False) -> bool:
+        """ONE synchronous collect→launch→finalize cycle (manual mode /
+        tests). Returns whether a batch was dispatched."""
+        batch = self.batcher.next_batch(self.clock(), drain=drain)
+        if batch is None:
+            return False
+        launched = self._launch_guarded(batch)
+        if launched is not None:
+            self.stats.record_batch(len(batch.tickets), batch.bucket)
+            self._finalize(batch.tickets, launched)
+        return True
+
+    def pump(self, drain: bool = False) -> bool:
+        """One PIPELINED cycle: launch the next batch (if any), THEN
+        finalize the previously launched one — host assembly of batch N+1
+        overlaps device execution of batch N. Returns whether a new batch
+        was consumed."""
+        batch = self.batcher.next_batch(self.clock(), drain=drain)
+        launched = None
+        if batch is not None:
+            launched = self._launch_guarded(batch)
+            if launched is not None:
+                self.stats.record_batch(len(batch.tickets), batch.bucket)
+        prev = self._take_pending()
+        if prev is not None:
+            self._finalize(*prev)
+        with self._close_lock:
+            self._pending = (
+                None if launched is None else (batch.tickets, launched)
+            )
+        return batch is not None
+
+    def _launch_guarded(self, batch):
+        """Launch, converting an executor error into per-ticket failures
+        instead of a dead dispatch thread."""
+        try:
+            return self.executor.launch(batch)
+        except Exception as e:
+            for t in batch.tickets:
+                t.fail(e)
+            return None
+
+    def _take_pending(self):
+        """Swap the in-flight (tickets, token) pair out under the state
+        lock (the lock covers only the pointer — finalize's blocking
+        download runs outside it)."""
+        with self._close_lock:
+            prev, self._pending = self._pending, None
+            return prev
+
+    def _pending_empty(self) -> bool:
+        with self._close_lock:
+            return self._pending is None
+
+    def _finalize(self, tickets, token) -> None:
+        try:
+            results = self.executor.collect(token)
+        except Exception as e:
+            for t in tickets:
+                t.fail(e)
+            return
+        now = self.clock()
+        for ticket, res in results:
+            if isinstance(res, BaseException):
+                ticket.fail(res)
+            elif ticket.resolve(res):
+                # a cancel()ed future neither raises out of the dispatch
+                # thread nor counts as a completion
+                self.stats.record_complete(now - ticket.submit_t)
+
+    def _loop(self) -> None:
+        import logging
+
+        log = logging.getLogger("hypergraphdb_tpu.serve")
+        while True:
+            try:
+                if self._closed and not self._draining:
+                    prev = self._take_pending()
+                    if prev is not None:
+                        self._finalize(*prev)
+                    self.queue.cancel_all()
+                    return
+                worked = self.pump(drain=self._draining)
+                if worked:
+                    continue  # keep forming batches while the device runs
+                # exit only once _closed is set (which happens AFTER
+                # admission closed): no submit can land behind our back
+                if (self._closed and self._draining
+                        and self.queue.depth() == 0
+                        and self._pending_empty()):
+                    return
+                ttf = self.batcher.time_to_flush(self.clock())
+                if ttf is None:
+                    # empty queue: wait_for_work's non-empty pre-check
+                    # makes the submit-before-wait race safe for an
+                    # unbounded park
+                    self.queue.wait_for_work(None)
+                else:
+                    # items queued but linger remaining: sleep the
+                    # remainder (a submit filling the bucket notifies and
+                    # wakes us early; a missed wakeup costs at most
+                    # max_linger_s)
+                    self.queue.park(ttf)
+            except Exception:
+                # the per-batch paths already route errors onto tickets;
+                # anything landing here is a runtime bug — log it and
+                # keep serving rather than stranding every future caller
+                log.exception("serve dispatch loop error (continuing)")
+                time.sleep(0.01)  # no hot-spin on a persistent fault
+
+    # -- lifecycle -----------------------------------------------------------
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = None) -> None:
+        """Stop admitting and shut down. ``drain=True`` flushes and
+        completes everything queued and in flight; ``drain=False``
+        completes only the in-flight batch and fails queued tickets with
+        RuntimeClosed."""
+        with self._close_lock:
+            already = self._close_started
+            self._close_started = True
+            if not already:
+                self._draining = drain
+        if not already:
+            # admission closes BEFORE the thread sees _closed: a submit
+            # racing close() either lands while the thread still serves or
+            # raises RuntimeClosed — never a silently stranded ticket
+            self.queue.close()
+            with self._close_lock:
+                self._closed = True
+        if self._thread is not None:
+            self._thread.join(timeout)
+            return
+        if already:
+            return
+        # manual mode: run the shutdown inline, deterministically
+        prev = self._take_pending()
+        if prev is not None:
+            self._finalize(*prev)
+        if drain:
+            while self.step(drain=True):
+                pass
+        else:
+            self.queue.cancel_all()
+
+    def __enter__(self) -> "ServeRuntime":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close(drain=True)
+
+    def stats_snapshot(self) -> dict:
+        return self.stats.snapshot(queue_depth=self.queue.depth())

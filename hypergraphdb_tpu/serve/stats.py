@@ -1,0 +1,139 @@
+"""Serving metrics: counters, occupancy, latency percentiles.
+
+One lock, no jax — safe to call from the submit path, the dispatch
+thread, and test assertions concurrently. Latencies live in a bounded
+ring buffer so a long-lived server's stats stay O(window), not O(total
+requests served).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Optional
+
+
+class ServeStats:
+    """Thread-safe metrics surface for one :class:`~.runtime.ServeRuntime`.
+
+    Counters: ``submitted``, ``completed``, ``shed_deadline`` (expired in
+    queue), ``rejected_queue_full`` (fail-fast backpressure),
+    ``cancelled`` (runtime closed without drain), ``host_fallbacks``
+    (requests served exactly on host instead of the batched device path),
+    ``batches`` (device dispatches). Occupancy is the fraction of real
+    (non-padding) lanes per dispatched bucket."""
+
+    def __init__(self, latency_window: int = 4096):
+        self._lock = threading.Lock()
+        self._lat = deque(maxlen=latency_window)
+        self.submitted = 0
+        self.completed = 0
+        self.shed_deadline = 0
+        self.rejected_queue_full = 0
+        self.cancelled = 0
+        self.host_fallbacks = 0
+        self.batches = 0
+        self.device_dispatches = 0
+        self._real_lanes = 0
+        self._padded_lanes = 0
+
+    def reset(self) -> None:
+        """Zero every counter and the latency/occupancy windows — the
+        bench's post-warmup cut so compile-time latencies never pollute
+        steady-state percentiles."""
+        with self._lock:
+            self._lat.clear()
+            self.submitted = 0
+            self.completed = 0
+            self.shed_deadline = 0
+            self.rejected_queue_full = 0
+            self.cancelled = 0
+            self.host_fallbacks = 0
+            self.batches = 0
+            self.device_dispatches = 0
+            self._real_lanes = 0
+            self._padded_lanes = 0
+
+    # -- recording (each a single locked update) ----------------------------
+    def record_submit(self) -> None:
+        with self._lock:
+            self.submitted += 1
+
+    def record_shed(self) -> None:
+        with self._lock:
+            self.shed_deadline += 1
+
+    def record_reject(self) -> None:
+        with self._lock:
+            self.rejected_queue_full += 1
+
+    def record_cancel(self) -> None:
+        with self._lock:
+            self.cancelled += 1
+
+    def record_host_fallback(self) -> None:
+        with self._lock:
+            self.host_fallbacks += 1
+
+    def record_batch(self, n_real: int, bucket: int) -> None:
+        """One successfully launched micro-batch; occupancy measures the
+        ADMISSION layer's coalescing (real requests / padded lanes)."""
+        with self._lock:
+            self.batches += 1
+            self._real_lanes += n_real
+            self._padded_lanes += bucket
+
+    def record_device_dispatch(self) -> None:
+        """One real device kernel launch (a batch whose every lane fell
+        back to host, or whose launch raised, dispatches none)."""
+        with self._lock:
+            self.device_dispatches += 1
+
+    def record_complete(self, latency_s: float) -> None:
+        with self._lock:
+            self.completed += 1
+            self._lat.append(latency_s)
+
+    # -- reading -------------------------------------------------------------
+    def occupancy(self) -> Optional[float]:
+        """Mean real-lane fraction over every dispatched bucket slot."""
+        with self._lock:
+            if not self._padded_lanes:
+                return None
+            return self._real_lanes / self._padded_lanes
+
+    def latency_percentiles_ms(self) -> dict:
+        """{"p50": ..., "p95": ..., "p99": ...} over the latency window
+        (milliseconds), or Nones before any completion."""
+        with self._lock:
+            lat = sorted(self._lat)
+        if not lat:
+            return {"p50": None, "p95": None, "p99": None}
+
+        def pct(p: float) -> float:
+            i = min(len(lat) - 1, int(round(p * (len(lat) - 1))))
+            return lat[i] * 1e3
+
+        return {"p50": pct(0.50), "p95": pct(0.95), "p99": pct(0.99)}
+
+    def snapshot(self, queue_depth: Optional[int] = None) -> dict:
+        """One coherent metrics dict (the bench's reporting unit)."""
+        with self._lock:
+            out = {
+                "submitted": self.submitted,
+                "completed": self.completed,
+                "shed_deadline": self.shed_deadline,
+                "rejected_queue_full": self.rejected_queue_full,
+                "cancelled": self.cancelled,
+                "host_fallbacks": self.host_fallbacks,
+                "batches": self.batches,
+                "device_dispatches": self.device_dispatches,
+                "batch_occupancy": (
+                    self._real_lanes / self._padded_lanes
+                    if self._padded_lanes else None
+                ),
+            }
+        out["latency_ms"] = self.latency_percentiles_ms()
+        if queue_depth is not None:
+            out["queue_depth"] = queue_depth
+        return out

@@ -1,0 +1,158 @@
+"""Serving vocabulary: requests, results, errors, tickets.
+
+No jax imports here — the deterministic tier-1 runtime tests drive the
+whole admission/batching machinery with a fake executor and never touch a
+device.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import numpy as np
+
+#: injectable time source (seconds, monotonic) — tests pass a fake
+Clock = Callable[[], float]
+
+
+class ServeError(Exception):
+    """Base class of every serving-runtime error."""
+
+
+class DeadlineExceeded(ServeError):
+    """The request's deadline expired before a device dispatch — shed in
+    the admission queue (load shedding), the dispatch was never paid."""
+
+    def __init__(self, waited_s: float):
+        super().__init__(f"deadline exceeded after {waited_s * 1e3:.1f} ms "
+                         "in the admission queue")
+        self.waited_s = waited_s
+
+
+class QueueFull(ServeError):
+    """Fail-fast admission: the bounded queue was full (backpressure)."""
+
+
+class RuntimeClosed(ServeError):
+    """Submitted to (or cancelled by) a closed runtime."""
+
+
+class Unservable(ServeError):
+    """The condition/request is outside the batchable subset — run it
+    through ``graph.find_all`` instead."""
+
+
+# ---------------------------------------------------------------- requests
+
+
+@dataclass(frozen=True)
+class BFSRequest:
+    """K-batchable BFS: atoms reachable from ``seed`` within ``max_hops``.
+
+    Matches ``query.conditions.BFS`` semantics when ``include_seed`` is
+    False (the condition's default excludes the start atom)."""
+
+    seed: int
+    max_hops: int
+    include_seed: bool = True
+
+    @property
+    def kind(self) -> str:
+        return "bfs"
+
+    @property
+    def batch_key(self) -> tuple:
+        # max_hops is a static kernel arg — one compiled program per value
+        return ("bfs", self.max_hops)
+
+
+@dataclass(frozen=True)
+class PatternRequest:
+    """Conjunctive incident pattern: links incident to ALL ``anchors``,
+    optionally restricted to ``type_handle``. The per-request type rides a
+    traced (K,) vector, so typed and untyped requests share one batch."""
+
+    anchors: tuple[int, ...]
+    type_handle: Optional[int] = None
+
+    def __post_init__(self):
+        if not self.anchors:
+            raise Unservable("pattern request needs at least one anchor")
+        object.__setattr__(
+            self, "anchors", tuple(int(a) for a in self.anchors)
+        )
+
+    @property
+    def kind(self) -> str:
+        return "pattern"
+
+    @property
+    def batch_key(self) -> tuple:
+        # anchor arity P is a device shape dim — one program per P
+        return ("pattern", len(self.anchors))
+
+
+# ---------------------------------------------------------------- results
+
+
+@dataclass(frozen=True, eq=False)  # ndarray field: dataclass eq would
+class ServeResult:                 # raise on >1-element comparisons
+    """One request's answer.
+
+    ``matches`` holds the first ``top_r`` matching atom ids ascending;
+    ``truncated`` flags a result set larger than the compact window (then
+    ``count`` is exact but ``matches`` is a prefix). ``epoch`` is the
+    compaction epoch of the pinned view that served the request;
+    ``served_by`` is ``"device"`` for the batched path or ``"host"`` for
+    the exact fallback (oversized rows / anchors beyond the base's id
+    space)."""
+
+    kind: str               # "bfs" | "pattern"
+    count: int
+    matches: np.ndarray     # int64, ascending
+    truncated: bool
+    epoch: int
+    served_by: str = "device"
+
+
+# ---------------------------------------------------------------- tickets
+
+
+@dataclass
+class Ticket:
+    """A queued request + its completion future and deadline bookkeeping
+    (absolute times per the runtime's injected clock)."""
+
+    request: object
+    future: Future = field(default_factory=Future)
+    submit_t: float = 0.0
+    deadline_t: Optional[float] = None
+
+    def expired(self, now: float) -> bool:
+        return self.deadline_t is not None and now >= self.deadline_t
+
+    # Completion goes through these tolerant helpers everywhere: a caller
+    # may have cancel()ed the future, and an InvalidStateError out of the
+    # dispatch thread would kill the whole service for one dead request.
+    def resolve(self, result) -> bool:
+        try:
+            self.future.set_result(result)
+            return True
+        except Exception:
+            return False  # cancelled/already-done: nobody is listening
+
+    def fail(self, exc: BaseException) -> bool:
+        try:
+            self.future.set_exception(exc)
+            return True
+        except Exception:
+            return False
+
+    def shed(self, now: float) -> None:
+        self.fail(DeadlineExceeded(now - self.submit_t))
+
+    @property
+    def batch_key(self) -> tuple:
+        return self.request.batch_key

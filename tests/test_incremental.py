@@ -364,3 +364,40 @@ def test_incremental_dead_only_refresh_reuses_edge_buffers(graph):
     )
     row = np.asarray(vis)[0]
     assert row[int(b)] and not row[int(c)]
+
+
+def test_wait_compacted_bounds_inflight_compaction(graph):
+    """wait_compacted blocks until the background pass settles (including
+    its coalesced catch-up) instead of callers polling delta_edges."""
+    nodes = [graph.add(f"n{i}") for i in range(8)]
+    mgr = graph.enable_incremental(
+        headroom=50.0, compact_ratio=0.0, background=True
+    )
+    assert mgr.wait_compacted(1.0)  # idle manager: returns at once
+    # enough atoms to overflow the initial 1024-id capacity → the next
+    # read requests a background pass
+    for i in range(1500):
+        graph.add_link((nodes[i % 8], nodes[(i + 1) % 8]), value=i)
+    mgr._maybe_compact()  # kicks a background pass
+    assert mgr.wait_compacted(30.0)
+    assert not mgr._compacting
+    assert mgr.compactions > 1
+    # after quiescing, the device pair reflects the new epoch immediately
+    dev, delta = mgr.device()
+    assert dev.num_atoms == mgr.base.num_atoms
+
+
+def test_pinned_view_is_one_epoch(graph):
+    """pinned_view captures base + device pair + memtable under one lock:
+    the correction sets always compensate for exactly that base."""
+    nodes = [graph.add(f"n{i}") for i in range(6)]
+    mgr = graph.enable_incremental(background=False, compact_ratio=100.0)
+    lk = graph.add_link((nodes[0], nodes[1]), value="after-pack")
+    graph.remove(int(nodes[5]))
+    pv = mgr.pinned_view()
+    assert pv.epoch == mgr.compactions
+    assert pv.base.device is pv.device
+    assert int(lk) in pv.new_atoms
+    assert int(nodes[5]) in pv.dead
+    # the delta in the view is the one uploaded for THIS marker
+    assert pv.delta is mgr._device_delta

@@ -28,6 +28,7 @@ PRODUCT_MODULES = (
     "hypergraphdb_tpu.ops.setops",
     "hypergraphdb_tpu.ops.pallas_gather",
     "hypergraphdb_tpu.ops.incremental",
+    "hypergraphdb_tpu.ops.serving",
     "hypergraphdb_tpu.parallel.sharded",
 )
 
