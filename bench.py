@@ -22,6 +22,14 @@ Python loop. The full per-config table rides in the same JSON object:
 
 Scale knobs: BENCH_ENTITIES / BENCH_LINKS / BENCH_SEEDS env vars (defaults
 reproduce the 10M-atom configs).
+
+Telemetry: ``python bench.py --telemetry [dir]`` enables hgobs tracing in
+every config subprocess and dumps ``telemetry_<config>.prom`` +
+``telemetry_<config>.trace.jsonl`` next to the results (see README
+"Observability"). ``c6_serving`` always records its batched-vs-unbatched
+ratio, occupancy, and percentiles to ``BENCH_C6_<tag>.json``
+(``BENCH_C6_TAG``, default ``local``) — the ROADMAP asks for this number
+to be recorded, not just printed.
 """
 
 from __future__ import annotations
@@ -29,10 +37,52 @@ from __future__ import annotations
 import json
 import os
 import time
+from typing import Optional
 
 import numpy as np
 
 V5E_HBM_PEAK = 819e9  # bytes/s, v5e per-chip HBM bandwidth
+
+#: set by --telemetry (inherited by config subprocesses via env)
+TELEMETRY_ENV = "BENCH_TELEMETRY_DIR"
+
+
+def _telemetry_dir():
+    return os.environ.get(TELEMETRY_ENV) or None
+
+
+def _telemetry_begin() -> None:
+    """Enable process-wide hgobs tracing when --telemetry is active. The
+    process registry and trace buffer are RESET here so each config's
+    dump reports only its own run — on the default isolated path the
+    reset is a no-op (fresh subprocess); on BENCH_ISOLATE=0 it is what
+    keeps telemetry_c4.prom from accumulating c3's counters."""
+    if _telemetry_dir():
+        from hypergraphdb_tpu import obs
+        from hypergraphdb_tpu.utils.metrics import global_metrics
+
+        # registry-level reset: the facade's reset() covers only its own
+        # memoized instruments, but anything registered directly on the
+        # default registry must be cleared too
+        global_metrics.registry.reset()
+        obs.enable().drain()
+
+
+def _telemetry_dump(name: str, registries=()) -> dict:
+    """Write the registry + trace dumps for one config; no-op without
+    --telemetry. Returns {"prometheus": path, "traces": path} or {}."""
+    out_dir = _telemetry_dir()
+    if not out_dir:
+        return {}
+    from hypergraphdb_tpu import obs
+    from hypergraphdb_tpu.utils.metrics import global_metrics
+
+    regs = list(registries) + [global_metrics.registry]
+    paths = obs.write_telemetry(
+        os.path.join(out_dir, f"telemetry_{name}"),
+        registries=regs, tracer=obs.tracer(),
+    )
+    return {"prometheus": paths["prometheus"], "traces": paths["traces"]}
 
 
 def _enable_compile_cache() -> None:
@@ -225,14 +275,18 @@ def bench_c2():
         lambda: host_bfs_vectorized(snap, seeds[:64].tolist(), HOPS)
     )
     py_eps, _ = best_of(lambda: host_bfs_python(g, seeds[:16].tolist(), HOPS))
+    telemetry = _telemetry_dump("c2", registries=[g.metrics.registry])
     g.close()
-    return {
+    out = {
         "edges_per_sec": round(device_eps, 1),
         "vs_vectorized_host": round(device_eps / host_eps, 2) if host_eps else None,
         "vs_python_engine": round(device_eps / py_eps, 2) if py_eps else None,
         "edges_per_run": edges,
         "device_ms": round(dt * 1e3, 3),
     }
+    if telemetry:
+        out["telemetry"] = telemetry
+    return out
 
 
 def _build_10m():
@@ -622,9 +676,10 @@ def bench_c5():
     lat_ms = np.asarray(latencies) * 1e3
     swap_idx = [i for i in range(1, len(epochs)) if epochs[i] != epochs[i - 1]]
     comp_stats = mgr.compaction_stats[1:]  # entry 0 is the init pack
+    telemetry = _telemetry_dump("c5", registries=[g.metrics.registry])
     g.close()
 
-    return {
+    out = {
         "base_atoms": base_atoms,
         "build_through_store_s": round(build_s, 1),
         "build_atoms_per_sec": round(base_atoms / build_s, 1),
@@ -662,6 +717,9 @@ def bench_c5():
             float(np.max([c["extract_s"] for c in comp_stats])), 3
         ) if comp_stats else None,
     }
+    if telemetry:
+        out["telemetry"] = telemetry
+    return out
 
 
 def bench_c6():
@@ -681,6 +739,7 @@ def bench_c6():
     from hypergraphdb_tpu.serve import DeadlineExceeded, ServeConfig, \
         ServeRuntime
 
+    _telemetry_begin()
     n_entities = int(os.environ.get("BENCH_C6_ENTITIES", 200_000))
     n_links = int(os.environ.get("BENCH_C6_LINKS", 400_000))
     n_requests = int(os.environ.get("BENCH_C6_REQUESTS", 4096))
@@ -787,9 +846,12 @@ def bench_c6():
     rt.close(drain=True, timeout=120)
     s = rt.stats_snapshot()
 
+    telemetry = _telemetry_dump(
+        "c6", registries=[rt.stats.registry, g.metrics.registry]
+    )
     g.close()
     batched_qps = served / wall if wall else 0.0
-    return {
+    out = {
         "offered_qps": round(offered_qps, 1),
         "served_qps": round(batched_qps, 1),
         "unbatched_baseline_qps": round(unbatched_qps, 1),
@@ -823,20 +885,81 @@ def bench_c6():
             ingested["atoms"] / ingested["s"], 1
         ) if ingested["s"] else None,
     }
+    if telemetry:
+        out["telemetry"] = telemetry
+    out["recorded_to"] = _record_c6(out)
+    return out
+
+
+def _record_c6(result: dict) -> Optional[str]:
+    """Persist the c6 serving numbers (ratio, occupancy, percentiles) to
+    ``BENCH_C6_<tag>.json`` next to this file — the committed record the
+    ROADMAP asks for. Shape documented in README "Serving runtime".
+    Best-effort: an unwritable checkout (read-only CI, site-packages)
+    must not discard the minutes-long run it is trying to record."""
+    tag = os.environ.get("BENCH_C6_TAG", "local")
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), f"BENCH_C6_{tag}.json"
+    )
+    record = {
+        "schema_version": 1,
+        "recorded_unix": int(time.time()),
+        "tag": tag,
+        "backend": _backend_name(),
+        "c6_serving": {k: v for k, v in result.items()
+                       if k not in ("telemetry", "recorded_to")},
+    }
+    try:
+        with open(path, "w") as f:
+            json.dump(record, f, indent=2, sort_keys=True)
+            f.write("\n")
+    except OSError as e:
+        import sys
+
+        print(f"bench: could not write {path}: {e}", file=sys.stderr)
+        return None
+    return os.path.basename(path)
+
+
+def _backend_name() -> str:
+    try:
+        import jax
+
+        return jax.devices()[0].platform
+    except Exception:
+        return "unknown"
+
+
+def _with_telemetry(name: str, fn) -> dict:
+    """Run one config with hgobs tracing when --telemetry is active.
+    Configs that own a graph or runtime dump their private registries
+    from inside (c2/c5: `g.metrics.registry`; c6: runtime + graph); this
+    wrapper's fallback dump covers the kernel-level global registry and
+    the trace buffer for the snapshot-only configs (c3/c4)."""
+    _telemetry_begin()
+    out = fn()
+    if "telemetry" not in out:
+        # only when the config did NOT dump for itself — re-dumping here
+        # would overwrite its files with the global-only view and an
+        # already-drained (empty) trace buffer
+        t = _telemetry_dump(name)
+        if t:
+            out["telemetry"] = t
+    return out
 
 
 def _config_c2() -> dict:
-    return bench_c2()
+    return _with_telemetry("c2", bench_c2)
 
 
 def _config_c3() -> dict:
     snap, info, _ = _build_10m()
-    return bench_c3(snap, info)
+    return _with_telemetry("c3", lambda: bench_c3(snap, info))
 
 
 def _config_c4() -> dict:
     snap, info, build_s = _build_10m()
-    out = bench_c4(snap, info)
+    out = _with_telemetry("c4", lambda: bench_c4(snap, info))
     out["_graph"] = {
         "n_atoms": info["n_atoms"],
         "total_arity": info["total_arity"],
@@ -846,7 +969,7 @@ def _config_c4() -> dict:
 
 
 def _config_c5() -> dict:
-    return bench_c5()
+    return _with_telemetry("c5", bench_c5)
 
 
 def _config_c6() -> dict:
@@ -888,6 +1011,19 @@ def _run_isolated(name: str) -> dict:
 
 
 def main() -> None:
+    import sys
+
+    if "--telemetry" in sys.argv:
+        # optional positional dir after the flag; default: next to results
+        i = sys.argv.index("--telemetry")
+        out_dir = (sys.argv[i + 1] if len(sys.argv) > i + 1
+                   and not sys.argv[i + 1].startswith("-")
+                   else os.path.dirname(os.path.abspath(__file__)))
+        os.makedirs(out_dir, exist_ok=True)
+        # env so the per-config subprocesses inherit the switch; absolute
+        # because _run_isolated children run with cwd=bench.py's dir, not
+        # the caller's
+        os.environ[TELEMETRY_ENV] = os.path.abspath(out_dir)
     if os.environ.get("BENCH_ISOLATE", "1") != "0":
         c3 = _run_isolated("c3")
         c4 = _run_isolated("c4")
@@ -897,14 +1033,14 @@ def main() -> None:
         graph = c4.pop("_graph")
     else:  # legacy in-process path (BENCH_ISOLATE=0): order still matters
         snap, info, build_s = _build_10m()
-        c3 = bench_c3(snap, info)
+        c3 = _with_telemetry("c3", lambda: bench_c3(snap, info))
         snap.__dict__.pop("device", None)  # cached_property storage
         for attr in ("_tgt_ell", "_value_cols"):
             if hasattr(snap, attr):
                 object.__delattr__(snap, attr)
-        c4 = bench_c4(snap, info)
-        c2 = bench_c2()
-        c5 = bench_c5()
+        c4 = _with_telemetry("c4", lambda: bench_c4(snap, info))
+        c2 = _with_telemetry("c2", bench_c2)
+        c5 = _with_telemetry("c5", bench_c5)
         c6 = bench_c6()
         graph = {
             "n_atoms": info["n_atoms"],

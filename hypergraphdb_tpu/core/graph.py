@@ -103,12 +103,18 @@ class HyperGraph:
         self.handles.reset(backend.max_handle())
         self.events = ev.HGEventManager()
         self._atom_cache: LRUCache = LRUCache(self.config.cache.atom_cache_size)
+        from hypergraphdb_tpu.utils.metrics import Metrics
+
+        # the tx manager mirrors its commit/abort/conflict counters into
+        # this graph's registry (tx.* namespace); attached BEFORE the
+        # typesystem bootstrap so the mirror counts the bootstrap commits
+        # the legacy `txman.committed` attribute counts — no permanent
+        # offset between the two surfaces
+        self.metrics = Metrics()
+        self.txman.metrics = self.metrics
         self.typesystem = HGTypeSystem(self)
         self.typesystem.bootstrap()
         self.stats = HGStats()
-        from hypergraphdb_tpu.utils.metrics import Metrics
-
-        self.metrics = Metrics()
         self._snapshot_cache = None
         self._snapshot_mgr = None  # incremental mode (enable_incremental)
         self._mutations = 0  # bumped on every committed structural change

@@ -1,0 +1,60 @@
+"""Device-timing hooks: dispatch wall-clock + profiler trace sessions.
+
+JAX dispatch is asynchronous: ``launch`` returns array HANDLES and the
+host only learns how long the device actually ran when something blocks
+on them. The serving runtime exploits that for pipelining — which means
+naive timestamps around ``launch`` measure host assembly, not device
+execution. :func:`block_timed` is the one honest measurement available
+without a profiler: block until the handles are ready and report the
+launch→ready wall delta, attributed to the batch's ``device`` span by the
+caller. It is OPT-IN (``ServeConfig.device_timing``) because the block
+itself serializes the pipeline's collect side a little earlier than a
+plain download would.
+
+:func:`profile` wraps ``jax.profiler.trace`` as a context manager that is
+a clean no-op when given no directory (or when jax/profiling is
+unavailable) — so call sites can carry a profile knob unconditionally.
+
+No module-level jax import: the deterministic tier-1 tests import obs
+with zero device work.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Callable, Optional
+
+
+def block_timed(handles, clock: Callable[[], float]) -> tuple:
+    """Block until ``handles`` (any pytree of jax arrays) are ready;
+    returns ``(handles, t_ready)``. Against a launch timestamp taken on
+    the same clock, ``t_ready`` gives the launch→ready wall delta — the
+    per-dispatch device attribution (see ``serve/runtime.py``)."""
+    import jax
+
+    jax.block_until_ready(handles)
+    return handles, clock()
+
+
+@contextmanager
+def profile(logdir: Optional[str]):
+    """A ``jax.profiler`` trace session writing to ``logdir``; a no-op
+    context when ``logdir`` is falsy or the profiler is unavailable (CPU
+    CI images without profiling support must not error)."""
+    if not logdir:
+        yield False
+        return
+    try:
+        import jax
+
+        jax.profiler.start_trace(logdir)
+    except Exception:
+        yield False
+        return
+    try:
+        yield True
+    finally:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:  # a torn session must not mask the workload error
+            pass

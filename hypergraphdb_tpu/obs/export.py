@@ -1,0 +1,150 @@
+"""Exportable telemetry: Prometheus text format + JSONL span traces.
+
+Two committed wire formats:
+
+- :func:`prometheus_text` renders one or more registries in the
+  Prometheus exposition format (``# TYPE`` comments; dots in metric
+  names become underscores; counters get the conventional ``_total``
+  suffix; histograms emit cumulative ``_bucket{le=...}`` series plus
+  ``_sum``/``_count``). Line-parseable — covered by a format test.
+- :func:`traces_to_jsonl` serializes finished traces one-per-line with an
+  explicit ``schema_version`` (:data:`TRACE_SCHEMA_VERSION`); span
+  attributes are restricted to scalars at record time (``Span.set``), so
+  serialization never fails mid-export. :func:`parse_traces_jsonl` is the
+  committed reader — round-tripped by tests, version-checked so a future
+  v2 cannot be misread silently.
+
+:func:`write_telemetry` is the one-call dump ``bench.py --telemetry``
+uses: ``<prefix>.prom`` + ``<prefix>.trace.jsonl`` next to the results.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+from typing import Iterable, Optional
+
+from hypergraphdb_tpu.obs.registry import Registry
+from hypergraphdb_tpu.obs.trace import Trace, Tracer
+
+#: bump on ANY change to the JSONL trace record shape
+TRACE_SCHEMA_VERSION = 1
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    out = _NAME_RE.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _fmt(v: float) -> str:
+    if v != v:  # NaN
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return repr(float(v)) if isinstance(v, float) else str(v)
+
+
+def prometheus_text(*registries: Registry) -> str:
+    """Render registries as Prometheus exposition text. Duplicate names
+    across registries render once (first registry wins) — merged dumps of
+    per-graph + per-runtime registries stay valid exposition."""
+    lines: list[str] = []
+    seen: set[str] = set()
+    for reg in registries:
+        for m in reg.instruments():
+            pname = _prom_name(m.name)
+            if pname in seen:
+                continue
+            seen.add(pname)
+            if m.kind == "counter":
+                lines.append(f"# TYPE {pname}_total counter")
+                lines.append(f"{pname}_total {m.value}")
+            elif m.kind == "gauge":
+                lines.append(f"# TYPE {pname} gauge")
+                lines.append(f"{pname} {_fmt(m.value)}")
+            else:  # histogram
+                lines.append(f"# TYPE {pname} histogram")
+                # one locked read: _bucket/_sum/_count stay mutually
+                # consistent within a scrape
+                buckets, total, count = m.export_state()
+                for edge, cum in buckets:
+                    lines.append(
+                        f'{pname}_bucket{{le="{_fmt(edge)}"}} {cum}'
+                    )
+                lines.append(f"{pname}_sum {_fmt(total)}")
+                lines.append(f"{pname}_count {count}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+# ------------------------------------------------------------------ traces
+
+
+def trace_to_dict(trace: Trace) -> dict:
+    """One trace as a plain dict (the JSONL record body)."""
+    return {
+        "schema_version": TRACE_SCHEMA_VERSION,
+        "trace_id": trace.trace_id,
+        "name": trace.name,
+        "t0": trace.t0,
+        "t1": trace.t1,
+        "dropped_spans": trace.dropped,
+        "attrs": dict(trace.attrs),
+        "spans": [
+            {
+                "span_id": sp.span_id,
+                "parent_id": sp.parent_id,
+                "name": sp.name,
+                "t0": sp.t0,
+                "t1": sp.t1,
+                "attrs": dict(sp.attrs),
+            }
+            for sp in trace.spans()
+        ],
+    }
+
+
+def traces_to_jsonl(traces: Iterable[Trace]) -> str:
+    """Finished traces, one JSON object per line."""
+    return "".join(
+        json.dumps(trace_to_dict(t), sort_keys=True) + "\n" for t in traces
+    )
+
+
+def parse_traces_jsonl(text: str) -> list[dict]:
+    """The committed reader: parses + version-checks every line."""
+    out = []
+    for i, line in enumerate(text.splitlines()):
+        if not line.strip():
+            continue
+        rec = json.loads(line)
+        ver = rec.get("schema_version")
+        if ver != TRACE_SCHEMA_VERSION:
+            raise ValueError(
+                f"trace line {i}: schema_version {ver!r} != "
+                f"{TRACE_SCHEMA_VERSION}"
+            )
+        for key in ("trace_id", "name", "t0", "spans"):
+            if key not in rec:
+                raise ValueError(f"trace line {i}: missing {key!r}")
+        out.append(rec)
+    return out
+
+
+def write_telemetry(prefix: str, registries: Iterable[Registry] = (),
+                    tracer: Optional[Tracer] = None) -> dict:
+    """Dump ``<prefix>.prom`` and ``<prefix>.trace.jsonl``; returns the
+    paths written (the bench records them next to its results)."""
+    prom_path = prefix + ".prom"
+    jsonl_path = prefix + ".trace.jsonl"
+    with open(prom_path, "w") as f:
+        f.write(prometheus_text(*registries))
+    traces = tracer.drain() if tracer is not None else []
+    with open(jsonl_path, "w") as f:
+        f.write(traces_to_jsonl(traces))
+    return {"prometheus": prom_path, "traces": jsonl_path,
+            "n_traces": len(traces)}

@@ -1,0 +1,336 @@
+"""Request tracing: lightweight span trees with explicit parenting.
+
+A :class:`Trace` is a bounded tree of :class:`Span` records, all stamped
+by ONE injectable clock (fake clocks in tests make every duration exact).
+Spans carry typed attributes; parenting is EXPLICIT (``parent=``) because
+the span chains this repo cares about cross threads — a served request's
+``submit`` span is opened on the caller's thread and its ``collect`` span
+on the dispatch thread, so an implicit thread-local "current span" could
+never link them. Every in-tree producer (the serve chain, the query
+compiler's ``compile → plan → execute``, the compaction pass's
+``compact → buffer_drain → device_swap``) uses the explicit API; a
+thread-local convenience layer (``Tracer.trace_ctx`` / ``Tracer.span``)
+is offered for ad-hoc single-thread instrumentation.
+
+Safety properties that make tracing reasonable to leave on:
+
+- **off-gate**: ``Tracer.enabled`` is a plain attribute; every
+  instrumentation site reads it (or a ``Ticket.trace is None`` it
+  derives from) ONCE and allocates nothing when tracing is off;
+- **span budget**: each trace records at most ``max_spans`` spans —
+  overflow spans are counted in ``Trace.dropped`` and discarded, never
+  accumulated (a pathological per-row instrumentation bug degrades to a
+  counter, not an OOM);
+- **bounded retention**: finished traces land in a ``maxlen`` deque on
+  the tracer (``drain()`` hands them to the exporter); a server nobody
+  scrapes stays O(max_finished), not O(requests).
+
+No jax imports — the deterministic tier-1 tests drive everything with a
+fake clock and zero device work.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Callable, Optional
+
+#: injectable time source (seconds, monotonic) — tests pass a fake
+Clock = Callable[[], float]
+
+#: attribute value types the JSONL exporter commits to (schema v1)
+ATTR_TYPES = (bool, int, float, str, type(None))
+
+_ids = itertools.count(1)
+
+
+class Span:
+    """One timed node of a trace tree. ``t1 is None`` while open."""
+
+    __slots__ = ("span_id", "parent_id", "name", "t0", "t1", "attrs",
+                 "_trace")
+
+    def __init__(self, trace: "Trace", name: str,
+                 parent_id: Optional[int], t0: float, attrs: dict):
+        self.span_id = next(_ids)
+        self.parent_id = parent_id
+        self.name = name
+        self.t0 = t0
+        self.t1: Optional[float] = None
+        self.attrs = attrs
+        self._trace = trace
+
+    def set(self, **attrs) -> "Span":
+        """Attach typed attributes (scalars only — the exporter's schema)."""
+        for k, v in attrs.items():
+            if not isinstance(v, ATTR_TYPES):
+                raise TypeError(
+                    f"span attr {k}={v!r}: only scalars are exportable"
+                )
+            self.attrs[k] = v
+        return self
+
+    def end(self, t1: Optional[float] = None) -> "Span":
+        """Close the span (idempotent — the first end wins). Taken under
+        the trace lock so the cross-thread race the serve path relies on
+        (submitter ends ``submit`` while the dispatch thread's ``finish``
+        closes everything) really is first-end-wins, not check-then-act."""
+        tr = self._trace
+        with tr._lock:
+            if self.t1 is None:
+                self.t1 = tr.clock() if t1 is None else t1
+        return self
+
+    @property
+    def duration(self) -> Optional[float]:
+        return None if self.t1 is None else self.t1 - self.t0
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"Span({self.name!r}, id={self.span_id}, "
+                f"parent={self.parent_id}, t0={self.t0}, t1={self.t1})")
+
+
+class Trace:
+    """A bounded span tree plus free-form ``marks`` (caller-owned refs to
+    spans left open across threads, e.g. the serve path's ``queue_wait``).
+
+    Thread-safe: one lock guards the span list and the budget counter —
+    a served request's spans are appended from both the submitting and
+    the dispatching thread."""
+
+    def __init__(self, name: str, clock: Clock, max_spans: int,
+                 attrs: Optional[dict] = None,
+                 owner: Optional["Tracer"] = None):
+        self.name = name
+        self.clock = clock
+        self.max_spans = max_spans
+        self._owner = owner
+        self.attrs = dict(attrs or {})
+        self.trace_id = next(_ids)
+        self.t0 = clock()
+        self.t1: Optional[float] = None
+        self.dropped = 0
+        self.marks: dict = {}     # caller-owned cross-thread span refs
+        self._lock = threading.Lock()
+        self._spans: list[Span] = []
+        self._finished = False
+
+    # -- recording -----------------------------------------------------------
+    def start_span(self, name: str, parent: Optional[Span] = None,
+                   t0: Optional[float] = None, **attrs) -> Span:
+        """Open a child span. Over-budget spans are counted and DISCARDED,
+        and spans started after ``finish()`` (a cross-thread race: e.g. a
+        submitter instrumenting a ticket the dispatch thread already
+        resolved) are silently detached — the returned span is real but
+        unrecorded in both cases, so call sites never branch."""
+        span = Span(self, name,
+                    None if parent is None else parent.span_id,
+                    self.clock() if t0 is None else t0, {})
+        if attrs:
+            span.set(**attrs)
+        with self._lock:
+            if self._finished:
+                pass  # already exported: never mutate a retained trace
+            elif len(self._spans) < self.max_spans:
+                self._spans.append(span)
+            else:
+                self.dropped += 1
+        return span
+
+    def add_span(self, name: str, t0: float, t1: float,
+                 parent: Optional[Span] = None, **attrs) -> Span:
+        """Record an already-timed interval (device timing hooks measure
+        first, attribute after)."""
+        return self.start_span(name, parent=parent, t0=t0, **attrs).end(t1)
+
+    @contextmanager
+    def span(self, name: str, parent: Optional[Span] = None, **attrs):
+        sp = self.start_span(name, parent=parent, **attrs)
+        try:
+            yield sp
+        finally:
+            sp.end()
+
+    def finish_terminal(self, name: str, parent: Optional[Span] = None,
+                        **attrs) -> None:
+        """Record a terminal span (``resolve`` / ``shed`` / ``error`` …)
+        under ``parent`` (default: the ``root`` mark) and finish the
+        trace — the ONE place the terminal-span schema lives, shared by
+        the serve, query, and compaction producers. No-op on an
+        already-finished trace."""
+        if self.finished:
+            return
+        self.start_span(
+            name,
+            parent=parent if parent is not None else self.marks.get("root"),
+            **attrs,
+        ).end()
+        self.finish()
+
+    def finish_error(self, exc: BaseException,
+                     parent: Optional[Span] = None, **attrs) -> None:
+        """The error terminal: span ``error`` with the exception's type
+        name, then finish."""
+        self.finish_terminal("error", parent=parent,
+                             error=type(exc).__name__, **attrs)
+
+    # -- lifecycle -----------------------------------------------------------
+    def finish(self) -> bool:
+        """Close the trace (idempotent) and hand it to the owning tracer's
+        finished buffer. Returns True on the first call."""
+        with self._lock:
+            if self._finished:
+                return False
+            self._finished = True
+            self.t1 = self.clock()
+            for sp in self._spans:
+                if sp.t1 is None:  # inline: Span.end takes THIS lock
+                    sp.t1 = self.t1
+        if self._owner is not None:
+            self._owner._retain(self)
+        return True
+
+    @property
+    def finished(self) -> bool:
+        with self._lock:
+            return self._finished
+
+    # -- reading -------------------------------------------------------------
+    def spans(self) -> list[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def find(self, name: str) -> Optional[Span]:
+        with self._lock:
+            for sp in self._spans:
+                if sp.name == name:
+                    return sp
+        return None
+
+    def children_of(self, span: Optional[Span]) -> list[Span]:
+        want = None if span is None else span.span_id
+        with self._lock:
+            return [s for s in self._spans if s.parent_id == want]
+
+
+class Tracer:
+    """The trace factory + finished-trace buffer. One per process by
+    default (``hypergraphdb_tpu.obs.tracer()``), instantiable for tests.
+
+    ``enabled`` is the zero-cost gate: every ``start_trace`` caller checks
+    it first (one attribute read); while False nothing is allocated and
+    ``start_trace`` returns None."""
+
+    def __init__(self, clock: Optional[Clock] = None, max_spans: int = 64,
+                 max_finished: int = 1024):
+        self.clock: Clock = clock or time.perf_counter
+        self.max_spans = max_spans
+        self.enabled = False
+        self.traces_started = 0
+        self._lock = threading.Lock()
+        self._finished: deque[Trace] = deque(maxlen=max_finished)
+        self._tls = threading.local()
+
+    # -- lifecycle -----------------------------------------------------------
+    def enable(self, clock: Optional[Clock] = None) -> "Tracer":
+        with self._lock:
+            if clock is not None:
+                self.clock = clock
+            self.enabled = True
+        return self
+
+    def disable(self) -> "Tracer":
+        with self._lock:
+            self.enabled = False
+        return self
+
+    # -- explicit API (cross-thread chains) ----------------------------------
+    def start_trace(self, name: str, **attrs) -> Optional[Trace]:
+        """A new trace, or None when tracing is off — callers thread the
+        returned handle (e.g. on a serve Ticket) and call ``finish_trace``
+        when the request resolves."""
+        if not self.enabled:
+            return None
+        tr = Trace(name, self.clock, self.max_spans, attrs, owner=self)
+        with self._lock:
+            self.traces_started += 1
+        return tr
+
+    def finish_trace(self, trace: Optional[Trace]) -> None:
+        """Close + retain a trace (idempotent, None-tolerant)."""
+        if trace is not None:
+            trace.finish()
+
+    def _retain(self, trace: Trace) -> None:
+        with self._lock:
+            self._finished.append(trace)
+
+    # -- implicit API (single-thread chains) ---------------------------------
+    @contextmanager
+    def trace_ctx(self, name: str, **attrs):
+        """Open a trace AND make it the thread's current one, so nested
+        ``tracer.span(...)`` calls attach without handle-threading. Yields
+        None when tracing is off (callers never branch — ``span`` no-ops
+        with no current trace)."""
+        tr = self.start_trace(name, **attrs)
+        if tr is None:
+            yield None
+            return
+        stack = self._stack()
+        root = tr.start_span(name)
+        stack.append((tr, root))
+        try:
+            yield tr
+        finally:
+            stack.pop()
+            root.end()
+            self.finish_trace(tr)
+
+    @contextmanager
+    def span(self, name: str, **attrs):
+        """A span under the thread's current trace (no-op without one)."""
+        stack = self._stack()
+        if not stack:
+            yield None
+            return
+        tr, parent = stack[-1]
+        sp = tr.start_span(name, parent=parent, **attrs)
+        stack.append((tr, sp))
+        try:
+            yield sp
+        finally:
+            stack.pop()
+            sp.end()
+
+    def current_trace(self) -> Optional[Trace]:
+        stack = self._stack()
+        return stack[-1][0] if stack else None
+
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    # -- reading -------------------------------------------------------------
+    def drain(self) -> list[Trace]:
+        """Pop every finished trace (export consumes the buffer)."""
+        with self._lock:
+            out = list(self._finished)
+            self._finished.clear()
+            return out
+
+    def finished_count(self) -> int:
+        with self._lock:
+            return len(self._finished)
+
+
+#: the process-wide tracer — disabled until obs.enable()
+_GLOBAL = Tracer()
+
+
+def global_tracer() -> Tracer:
+    return _GLOBAL

@@ -31,6 +31,7 @@ import numpy as np
 
 from hypergraphdb_tpu import verify as hgverify
 from hypergraphdb_tpu.core import events as ev
+from hypergraphdb_tpu.obs import global_tracer
 from hypergraphdb_tpu.ops.frontier import expand_frontier
 from hypergraphdb_tpu.ops.setops import _bucket
 from hypergraphdb_tpu.ops.snapshot import CSRSnapshot, DeviceSnapshot, _pad_to
@@ -352,18 +353,44 @@ class SnapshotManager:
     def _compact_sync(self) -> None:
         import time as _time
 
-        t0 = _time.perf_counter()
-        with self.graph.txman._commit_lock:
-            with self._lock:
-                ext = self._extract_locked()
-        t1 = _time.perf_counter()
-        self._assemble_and_swap(ext)
-        t2 = _time.perf_counter()
+        tracer = global_tracer()
+        tr = tracer.start_trace("compact") if tracer.enabled else None
+        root = None if tr is None else tr.start_span("compact")
+        try:
+            t0 = _time.perf_counter()
+            drain = None if tr is None else tr.start_span("buffer_drain",
+                                                          parent=root)
+            with self.graph.txman._commit_lock:
+                with self._lock:
+                    ext = self._extract_locked()
+            if drain is not None:
+                drain.end()
+            t1 = _time.perf_counter()
+            swap = None if tr is None else tr.start_span("device_swap",
+                                                         parent=root)
+            self._assemble_and_swap(ext)
+            if swap is not None:
+                swap.set(highwater=int(ext["highwater"])).end()
+            t2 = _time.perf_counter()
+        except BaseException as e:
+            # a failed pass is the telemetry worth keeping: export the
+            # trace with an error terminal instead of dropping it
+            if tr is not None:
+                tr.finish_error(e, parent=root)
+            self.graph.metrics.incr("compact.failures")
+            raise
+        finally:
+            if tr is not None:
+                tr.finish()
         self.compaction_stats.append({
             "extract_s": t1 - t0,       # commit lock held (writers stalled)
             "assemble_swap_s": t2 - t1,  # lock-free CSR assembly + swap
             "total_s": t2 - t0,
         })
+        m = self.graph.metrics
+        m.incr("compact.passes")
+        m.observe("compact.extract_seconds", t1 - t0)
+        m.observe("compact.assemble_swap_seconds", t2 - t1)
 
     def _request_compact(self) -> None:
         if not self.background:
@@ -568,6 +595,7 @@ class SnapshotManager:
                     dead=dead_dev,
                 )
                 self.tail_uploads += 1
+                self.graph.metrics.incr("compact.tail_uploads")
             else:
                 self._device_delta = DeviceDelta(
                     inc_links=prev.inc_links,
@@ -589,9 +617,11 @@ class SnapshotManager:
                 dead=dead_dev,
             )
             self.full_uploads += 1
+            self.graph.metrics.incr("compact.full_uploads")
         self._delta_dirty = False
         self._uploaded_marker = marker
         self._uploaded_atoms = len(self._new_atoms)
+        self.graph.metrics.gauge("compact.delta_edges", cur_len)
 
     def host_delta(self) -> dict:
         """Host-side copy of the delta memtable for OTHER planes to shard

@@ -28,6 +28,7 @@ from typing import Any, Iterable, Optional, Sequence
 import numpy as np
 
 from hypergraphdb_tpu.core.errors import QueryError
+from hypergraphdb_tpu.obs import global_tracer
 from hypergraphdb_tpu.query import conditions as c
 
 logger = logging.getLogger("hypergraphdb_tpu.query")
@@ -698,7 +699,11 @@ class PipePlan(Plan):
             return _EMPTY
         outs = []
         for k in keys.tolist():
-            sub = compile_query(graph, self.key_condition(int(k)))
+            # traced=False: these per-key compiles run their plans
+            # directly, so a trace would never finish — a pipe over 10k
+            # keys must not allocate 10k span trees that vanish
+            sub = compile_query(graph, self.key_condition(int(k)),
+                                traced=False)
             arr = sub.plan.run(graph)
             if len(arr):
                 outs.append(arr)
@@ -711,8 +716,10 @@ class PipePlan(Plan):
 
 
 def result_map(graph, condition, mapping):
-    """Compile + run ``condition`` and map results (the hg.apply DSL)."""
-    q = compile_query(graph, condition)
+    """Compile + run ``condition`` and map results (the hg.apply DSL).
+    Untraced: the plan runs through a wrapper plan, not ``execute()``, so
+    an opened query trace would never finish/export."""
+    q = compile_query(graph, condition, traced=False)
 
     def run():
         return ResultMapPlan(q.plan, mapping).run(graph)
@@ -722,8 +729,9 @@ def result_map(graph, condition, mapping):
 
 def pipe(graph, producer_condition, key_condition):
     """Compile + run a pipe: producer results keyed into a dependent
-    condition builder (``PipeQuery`` semantics)."""
-    q = compile_query(graph, producer_condition)
+    condition builder (``PipeQuery`` semantics). Untraced — see
+    :func:`result_map`."""
+    q = compile_query(graph, producer_condition, traced=False)
 
     def run():
         return PipePlan(q.plan, key_condition).run(graph)
@@ -1211,27 +1219,59 @@ def translate(graph, cond: c.HGQueryCondition, parallel_or: bool = False) -> Pla
 @dataclass
 class CompiledQuery:
     """The executable query handle (``HGQuery`` + ``AnalyzedQuery``
-    introspection: ``plan.describe()`` is the plan dump)."""
+    introspection: ``plan.describe()`` is the plan dump).
+
+    ``trace`` is the hgobs trace opened at compile time (None when
+    tracing is off): ``compile`` and ``plan`` spans are already recorded;
+    the FIRST ``execute()`` appends its span and finishes the trace —
+    one ``compile → plan → execute`` tree per query lifecycle."""
 
     graph: Any
     condition: c.HGQueryCondition
     simplified: c.HGQueryCondition
     plan: Plan
+    trace: Any = None
 
     def execute(self) -> Iterable[int]:
         def run():
             return self.plan.run(self.graph)
 
         with self.graph.metrics.timer("query.execute"):
-            arr = self.graph.txman.ensure_transaction(run, readonly=True)
+            arr = self._run_traced(
+                lambda: self.graph.txman.ensure_transaction(
+                    run, readonly=True
+                )
+            )
         self.graph.metrics.incr("query.executed")
         return iter(arr.tolist())
 
+    def _run_traced(self, runner) -> np.ndarray:
+        """Run the plan under the query trace's ``execute`` span. The
+        trace finishes on EVERY exit — a raising plan exports an ``error``
+        terminal instead of silently dropping the trace (the failing
+        query is exactly the one worth inspecting)."""
+        tr = self.trace
+        sp = (tr.start_span("execute", parent=tr.marks.get("root"))
+              if tr is not None and not tr.finished else None)
+        try:
+            arr = runner()
+        except BaseException as e:
+            if sp is not None:
+                sp.end()
+                tr.finish_error(e)
+            raise
+        if sp is not None:
+            sp.set(results=int(len(arr))).end()
+            tr.finish()
+        return arr
+
     def results(self) -> np.ndarray:
-        return self.plan.run(self.graph)
+        return self._run_traced(lambda: self.plan.run(self.graph))
 
     def count(self) -> int:
-        return int(len(self.plan.run(self.graph)))
+        return int(len(self._run_traced(
+            lambda: self.plan.run(self.graph)
+        )))
 
     def analyze(self) -> str:
         """Plan dump (AnalyzedQuery: condition → simplified form → physical
@@ -1243,15 +1283,40 @@ class CompiledQuery:
         )
 
 
-def compile_query(graph, condition: c.HGQueryCondition) -> CompiledQuery:
-    """The full pipeline (``ExpressionBasedQuery.compileProcess`` :853)."""
+def compile_query(graph, condition: c.HGQueryCondition,
+                  traced: bool = True) -> CompiledQuery:
+    """The full pipeline (``ExpressionBasedQuery.compileProcess`` :853).
+
+    ``traced=False`` skips the query trace — for INTERNAL callers whose
+    plans run outside ``execute()``/``results()``/``count()`` and would
+    leave the trace forever unfinished (pipes, result maps)."""
     if not isinstance(condition, c.HGQueryCondition):
         raise QueryError(f"not a condition: {condition!r}")
-    expanded = expand(graph, condition)
-    dnf = to_dnf(expanded)
-    simplified = simplify(graph, dnf)
-    simplified = _apply_index_substitution(graph, simplified)
-    plan = translate(
-        graph, simplified, parallel_or=graph.config.query.parallel_or
-    )
-    return CompiledQuery(graph, condition, simplified, plan)
+    tracer = global_tracer()
+    tr = (tracer.start_trace("query")
+          if traced and tracer.enabled else None)
+    root = None
+    if tr is not None:
+        root = tr.start_span("query")
+        tr.marks["root"] = root
+        sp = tr.start_span("compile", parent=root)
+    try:
+        expanded = expand(graph, condition)
+        dnf = to_dnf(expanded)
+        simplified = simplify(graph, dnf)
+        simplified = _apply_index_substitution(graph, simplified)
+        if tr is not None:
+            sp.end()
+            sp = tr.start_span("plan", parent=root)
+        plan = translate(
+            graph, simplified, parallel_or=graph.config.query.parallel_or
+        )
+    except BaseException as e:
+        # same every-exit guarantee as _run_traced: a condition the
+        # compiler rejects still exports its trace with an error terminal
+        if tr is not None:
+            tr.finish_error(e, parent=root)
+        raise
+    if tr is not None:
+        sp.set(plan=type(plan).__name__).end()
+    return CompiledQuery(graph, condition, simplified, plan, trace=tr)

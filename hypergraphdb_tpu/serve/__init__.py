@@ -8,8 +8,11 @@ library into a service using the continuous-batching shape of inference
 stacks:
 
 - requests enter a **bounded admission queue** (``admission.py``) with
-  per-request deadlines; expired requests are shed IN the queue with a
-  typed :class:`DeadlineExceeded` — never a wasted device dispatch;
+  per-request deadlines and optional **priorities** (a higher class pops
+  first at batch formation, FIFO within a class; shedding and
+  backpressure stay priority-blind); expired requests are shed IN the
+  queue with a typed :class:`DeadlineExceeded` — never a wasted device
+  dispatch;
 - a batcher (``batcher.py``) coalesces compatible requests and flushes
   **shape-bucketed micro-batches** (pad-to-bucket K ∈ {64, 256, 1024}) on
   batch-full or max-linger timeout;
@@ -19,7 +22,12 @@ stacks:
   ``SnapshotManager.pinned_view(max_lag_edges=...)`` so no request ever
   straddles a compaction swap;
 - ``stats.py`` records queue depth, batch occupancy, shed counts, and
-  latency percentiles.
+  latency percentiles into one hgobs registry (``serve.*`` namespace),
+  and with tracing on (``obs.enable()``, or an injected, **enabled**
+  tracer: ``ServeConfig(tracer=Tracer().enable())`` — injection alone
+  does not flip the gate) every request carries a
+  ``submit → queue_wait → batch_form → launch [→ device] → collect →
+  resolve`` span chain — see README "Observability".
 
 Entry point::
 

@@ -1,8 +1,22 @@
-"""Bounded admission queue: backpressure + in-queue deadline shedding.
+"""Bounded admission queue: backpressure, deadline shedding, priorities.
 
 The queue is the runtime's ONLY synchronization point between submitters
 and the dispatch thread: one condition variable guards a deque of
-:class:`~.types.Ticket`. Backpressure policy is per-queue:
+:class:`~.types.Ticket`. Pops are PRIORITY-ordered: ``front()`` (which
+picks the key the next micro-batch is formed around) returns the oldest
+ticket of the highest priority class present, and ``take`` hands tickets
+out highest-class-first, FIFO within a class — so a latency-critical
+class jumps the batch-formation line while same-class requests keep
+strict arrival order. Capacity, deadline shedding, and the ``block`` /
+``fail`` policies are priority-blind: a high-priority request that
+arrives at a full queue still waits or fails like any other. A lingered
+lower class still forces flushes (the batcher's linger clock is
+``oldest()``, priority-blind), so only genuinely saturating
+higher-priority load — dispatch never finding the queue clear of higher
+classes — delays lower ones, and deadlines bound how long a delayed
+request waits.
+
+Backpressure policy is per-queue:
 
 - ``"block"`` — ``submit`` waits for space (bounded by the request's own
   deadline when it has one: a request that would expire while waiting is
@@ -51,6 +65,10 @@ class AdmissionQueue:
         self.stats = stats or ServeStats()
         self._cv = threading.Condition()
         self._dq: deque[Ticket] = deque()
+        # priority class -> queued count (zero entries removed): with a
+        # single class present — the overwhelmingly common shape —
+        # front() stays the O(1) deque head instead of an O(n) scan
+        self._prio_counts: dict[int, int] = {}
         self._closed = False
 
     # -- submit side ---------------------------------------------------------
@@ -63,7 +81,10 @@ class AdmissionQueue:
                     raise RuntimeClosed("runtime is closed")
                 if len(self._dq) < self.capacity:
                     self._dq.append(ticket)
+                    p = ticket.priority
+                    self._prio_counts[p] = self._prio_counts.get(p, 0) + 1
                     self.stats.record_submit()
+                    self.stats.set_queue_depth(len(self._dq))
                     self._cv.notify_all()
                     return ticket
                 if self.policy == "fail":
@@ -100,32 +121,71 @@ class AdmissionQueue:
                 if t.expired(now):
                     t.shed(now)
                     self.stats.record_shed()
+                    self._prio_dec(t.priority)
                     shed += 1
                 else:
                     live.append(t)
             if shed:
                 self._dq = live
+                self.stats.set_queue_depth(len(self._dq))
                 self._cv.notify_all()  # space freed: wake blocked submits
         return shed
 
     def take(self, batch_key: tuple, max_n: int) -> list:
         """Remove and return up to ``max_n`` tickets with ``batch_key``,
-        preserving FIFO order; other keys stay queued in order."""
+        highest priority class first, FIFO within a class; other keys
+        stay queued in arrival order."""
         with self._cv:
-            out, rest = [], deque()
-            for t in self._dq:
-                if len(out) < max_n and t.batch_key == batch_key:
-                    out.append(t)
-                else:
-                    rest.append(t)
-            self._dq = rest
+            match = [t for t in self._dq if t.batch_key == batch_key]
+            if len(self._prio_counts) > 1:
+                # stable sort: equal priorities keep queue (arrival)
+                # order. Skipped entirely on the common single-class
+                # queue, where arrival order IS the answer.
+                match.sort(key=lambda t: -t.priority)
+            out = match[:max_n]
             if out:
+                chosen = {id(t) for t in out}
+                self._dq = deque(
+                    t for t in self._dq if id(t) not in chosen
+                )
+                for t in out:
+                    self._prio_dec(t.priority)
+                self.stats.set_queue_depth(len(self._dq))
                 self._cv.notify_all()
             return out
 
-    def front(self) -> Optional[Ticket]:
+    def oldest(self) -> Optional[Ticket]:
+        """The globally-oldest queued ticket regardless of priority — the
+        LINGER clock. Keeping linger on this (while ``front()`` picks
+        which key flushes) guarantees progress for every class: a
+        lingered low-priority group forces a flush, draining whatever
+        class is ahead of it until it reaches the front itself."""
         with self._cv:
             return self._dq[0] if self._dq else None
+
+    def _prio_dec(self, p: int) -> None:
+        """Drop one queued ticket from priority class ``p`` (caller holds
+        the cv)."""
+        n = self._prio_counts.get(p, 0) - 1
+        if n > 0:
+            self._prio_counts[p] = n
+        else:
+            self._prio_counts.pop(p, None)
+
+    def front(self) -> Optional[Ticket]:
+        """The oldest ticket of the highest priority class present — the
+        ticket whose key defines the next micro-batch. O(1) with one
+        class queued; a full scan only while classes actually mix."""
+        with self._cv:
+            if not self._dq:
+                return None
+            if len(self._prio_counts) <= 1:
+                return self._dq[0]
+            best = None
+            for t in self._dq:
+                if best is None or t.priority > best.priority:
+                    best = t
+            return best
 
     def count_key(self, batch_key: tuple) -> int:
         with self._cv:
@@ -173,6 +233,8 @@ class AdmissionQueue:
                 t.fail(RuntimeClosed("runtime closed"))
                 self.stats.record_cancel()
             self._dq.clear()
+            self._prio_counts.clear()
+            self.stats.set_queue_depth(0)
             self._cv.notify_all()
             return n
 

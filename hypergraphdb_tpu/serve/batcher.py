@@ -67,8 +67,13 @@ class Batcher:
 
     def next_batch(self, now: float, drain: bool = False
                    ) -> Optional[MicroBatch]:
-        """Shed expired tickets, then flush the oldest ticket's group if
-        the policy says so; None when nothing is ready yet."""
+        """Shed expired tickets, then flush if the policy says so; None
+        when nothing is ready yet. Two separate decisions: WHETHER to
+        flush is keyed to the GLOBALLY-oldest ticket's linger (so no
+        class can be starved past its linger by a trickle of
+        higher-priority arrivals — every lingered group forces flushes
+        until it reaches the front itself), WHICH key flushes follows
+        ``front()`` (the highest priority class's oldest ticket)."""
         self.queue.shed_expired(now)
         head = self.queue.front()
         if head is None:
@@ -76,7 +81,11 @@ class Batcher:
         key = head.batch_key
         pending = self.queue.count_key(key)
         full = pending >= self.max_batch
-        lingered = (now - head.submit_t) >= self.max_linger_s
+        oldest = self.queue.oldest()
+        lingered = (
+            oldest is not None
+            and (now - oldest.submit_t) >= self.max_linger_s
+        )
         if not (full or lingered or drain):
             return None
         tickets = self.queue.take(key, self.max_batch)
@@ -86,9 +95,10 @@ class Batcher:
                           bucket=bucket_for(len(tickets), self.buckets))
 
     def time_to_flush(self, now: float) -> Optional[float]:
-        """Seconds until the oldest ticket's linger expires (the dispatch
-        thread's wait timeout); None with an empty queue."""
-        head = self.queue.front()
-        if head is None:
+        """Seconds until the OLDEST ticket's linger expires (the dispatch
+        thread's wait timeout — the same clock ``next_batch`` flushes
+        on); None with an empty queue."""
+        oldest = self.queue.oldest()
+        if oldest is None:
             return None
-        return max(self.max_linger_s - (now - head.submit_t), 0.0)
+        return max(self.max_linger_s - (now - oldest.submit_t), 0.0)

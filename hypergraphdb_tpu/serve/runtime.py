@@ -42,6 +42,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from hypergraphdb_tpu.obs import global_tracer
 from hypergraphdb_tpu.serve.admission import AdmissionQueue
 from hypergraphdb_tpu.serve.batcher import BUCKETS, Batcher, MicroBatch
 from hypergraphdb_tpu.serve.stats import ServeStats
@@ -71,6 +72,8 @@ class ServeConfig:
     clock: Optional[Clock] = None           # injectable time source
     manual: bool = False                    # no thread; tests call step()
     latency_window: int = 4096
+    tracer: Optional[object] = None         # hgobs Tracer; None → global
+    device_timing: bool = False             # launch→ready deltas per batch
 
 
 @dataclass
@@ -87,6 +90,10 @@ class LaunchedBatch:
     #: candidates, captured AT LAUNCH (pin time ± µs) so collect-time
     #: corrections never read the live graph mid-ingest
     cand_records: dict = field(default_factory=dict)
+    #: (t_launch, t_ready) in the tracer's clock once collect blocked —
+    #: the batch's device-execution attribution (ServeConfig.device_timing)
+    t_device: object = None
+    _t_launch: object = None
 
 
 class DeviceExecutor:
@@ -105,6 +112,7 @@ class DeviceExecutor:
         self.graph = graph
         self.config = config
         self.stats = stats or ServeStats()
+        self.tracer = config.tracer or global_tracer()
         # serving implies ingest-concurrent reads: the incremental
         # (base, delta) pair IS the consistency mechanism
         self.mgr = graph.incremental or graph.enable_incremental()
@@ -181,6 +189,8 @@ class DeviceExecutor:
             raise Unservable(f"unknown batch kind {kind!r}")
         if out.dev_out is not None:
             self.stats.record_device_dispatch()
+            if self.config.device_timing and self.tracer.enabled:
+                out._t_launch = self.tracer.clock()
         return out
 
     def _capture_candidates(self, view) -> dict:
@@ -209,6 +219,14 @@ class DeviceExecutor:
         out = []
         view = launched.view
         if launched.dev_out is not None:
+            if launched._t_launch is not None:
+                # opt-in device attribution: block on the async handles and
+                # record the launch→ready wall delta for the batch's span
+                from hypergraphdb_tpu.obs.device import block_timed
+
+                _, t_ready = block_timed(launched.dev_out,
+                                         self.tracer.clock)
+                launched.t_device = (launched._t_launch, t_ready)
             counts, first_r = (np.asarray(x) for x in launched.dev_out)
             kind = launched.batch.key[0]
             if kind == "pattern":
@@ -335,6 +353,7 @@ class ServeRuntime:
                  executor=None):
         self.config = config or ServeConfig()
         self.clock: Clock = self.config.clock or time.monotonic
+        self.tracer = self.config.tracer or global_tracer()
         self.stats = ServeStats(self.config.latency_window)
         self.queue = AdmissionQueue(
             self.config.max_queue, self.config.policy, self.clock,
@@ -360,44 +379,81 @@ class ServeRuntime:
             self._thread.start()
 
     # -- submit --------------------------------------------------------------
-    def submit(self, request, deadline_s: Optional[float] = None) -> Future:
+    def submit(self, request, deadline_s: Optional[float] = None,
+               priority: int = 0) -> Future:
         """Admit one request; returns its future. Raises
         :class:`~.types.QueueFull` under fail-fast backpressure,
         :class:`~.types.RuntimeClosed` after close; a deadline that expires
-        while blocked lands ON the future as DeadlineExceeded."""
+        while blocked lands ON the future as DeadlineExceeded. A higher
+        ``priority`` class pops first at batch formation (FIFO within a
+        class); shedding and backpressure are priority-blind."""
         now = self.clock()
         dl = (deadline_s if deadline_s is not None
               else self.config.default_deadline_s)
         ticket = Ticket(
             request=request, submit_t=now,
             deadline_t=None if dl is None else now + dl,
+            priority=int(priority),
         )
-        self.queue.submit(ticket)
+        if self.tracer.enabled:  # the ONE gate read on the disabled path
+            self._trace_submit(ticket)
+        try:
+            self.queue.submit(ticket)
+        except Exception as e:
+            ticket._close_trace("error", error=type(e).__name__)
+            raise
+        tr = ticket.trace
+        if tr is not None:
+            # ending is race-safe: if the dispatch thread already finished
+            # the trace, the first end (finish's) won
+            tr.marks["submit"].end()
         return ticket.future
+
+    def _trace_submit(self, ticket: Ticket) -> None:
+        """Open the request's trace: ``request`` root + ``submit`` and
+        ``queue_wait`` spans. BOTH open before the ticket becomes visible
+        to the dispatch thread — the thread may form, launch, and resolve
+        the batch before ``queue.submit`` even returns to the caller, so
+        every mark it pops must already exist (under ``block``
+        backpressure ``queue_wait`` therefore includes the blocked-in-
+        submit time)."""
+        tr = self.tracer.start_trace(
+            "serve.request", kind=ticket.request.kind,
+            priority=ticket.priority,
+        )
+        if tr is None:
+            return
+        root = tr.start_span("request")
+        tr.marks["root"] = root
+        tr.marks["submit"] = tr.start_span("submit", parent=root)
+        tr.marks["queue_wait"] = tr.start_span("queue_wait", parent=root)
+        ticket.trace = tr
 
     def submit_bfs(self, seed: int, max_hops: Optional[int] = None,
                    deadline_s: Optional[float] = None,
-                   include_seed: bool = True) -> Future:
+                   include_seed: bool = True, priority: int = 0) -> Future:
         return self.submit(
             BFSRequest(int(seed),
                        max_hops if max_hops is not None
                        else self.config.default_max_hops,
                        include_seed),
-            deadline_s,
+            deadline_s, priority,
         )
 
     def submit_pattern(self, anchors: Sequence[int],
                        type_handle: Optional[int] = None,
-                       deadline_s: Optional[float] = None) -> Future:
+                       deadline_s: Optional[float] = None,
+                       priority: int = 0) -> Future:
         return self.submit(
             PatternRequest(tuple(int(a) for a in anchors),
                            None if type_handle is None
                            else int(type_handle)),
-            deadline_s,
+            deadline_s, priority,
         )
 
     def submit_query(self, condition,
-                     deadline_s: Optional[float] = None) -> Future:
+                     deadline_s: Optional[float] = None,
+                     priority: int = 0) -> Future:
         """Admit a query CONDITION (the batchable subset — see
         ``query/bridge``). Raises :class:`~.types.Unservable` for
         conditions outside it."""
@@ -406,17 +462,18 @@ class ServeRuntime:
         return self.submit(
             to_request(self.graph, condition,
                        default_max_hops=self.config.default_max_hops),
-            deadline_s,
+            deadline_s, priority,
         )
 
     # -- dispatch ------------------------------------------------------------
     def step(self, drain: bool = False) -> bool:
         """ONE synchronous collect→launch→finalize cycle (manual mode /
         tests). Returns whether a batch was dispatched."""
+        t_form = self.tracer.clock() if self.tracer.enabled else None
         batch = self.batcher.next_batch(self.clock(), drain=drain)
         if batch is None:
             return False
-        launched = self._launch_guarded(batch)
+        launched = self._launch_guarded(batch, t_form)
         if launched is not None:
             self.stats.record_batch(len(batch.tickets), batch.bucket)
             self._finalize(batch.tickets, launched)
@@ -427,10 +484,11 @@ class ServeRuntime:
         finalize the previously launched one — host assembly of batch N+1
         overlaps device execution of batch N. Returns whether a new batch
         was consumed."""
+        t_form = self.tracer.clock() if self.tracer.enabled else None
         batch = self.batcher.next_batch(self.clock(), drain=drain)
         launched = None
         if batch is not None:
-            launched = self._launch_guarded(batch)
+            launched = self._launch_guarded(batch, t_form)
             if launched is not None:
                 self.stats.record_batch(len(batch.tickets), batch.bucket)
         prev = self._take_pending()
@@ -442,15 +500,56 @@ class ServeRuntime:
             )
         return batch is not None
 
-    def _launch_guarded(self, batch):
+    def _launch_guarded(self, batch, t_form=None):
         """Launch, converting an executor error into per-ticket failures
-        instead of a dead dispatch thread."""
+        instead of a dead dispatch thread. Traced tickets get their
+        ``queue_wait`` closed and ``batch_form``/``launch`` spans here —
+        the whole block is behind one ``tracer.enabled`` read. ``t_form``
+        is the caller's pre-``next_batch`` timestamp, so ``batch_form``
+        covers the REAL formation work (shed scan, key count, priority
+        take) instead of attributing it to ``queue_wait``."""
+        tracer = self.tracer
+        traced = tracer.enabled
+        if traced:
+            if t_form is None:
+                t_form = tracer.clock()
+            n_real = len(batch.tickets)
+            pending = []
+            for t in batch.tickets:
+                tr = t.trace
+                if tr is not None and not tr.finished:
+                    qw = tr.marks.pop("queue_wait", None)
+                    # clamp per ticket: a request submitted AFTER the
+                    # caller's t_form capture but in time for take() must
+                    # not get a negative queue_wait / a batch_form that
+                    # predates its own birth
+                    t0_i = t_form
+                    if qw is not None:
+                        t0_i = max(t_form, qw.t0)
+                        qw.end(t0_i)
+                    pending.append((tr, t0_i))
+            t_l0 = tracer.clock()
+            for tr, t0_i in pending:
+                if not tr.finished:
+                    tr.add_span(
+                        "batch_form", t0_i, max(t_l0, t0_i),
+                        parent=tr.marks.get("root"), bucket=batch.bucket,
+                        n_real=n_real, n_pad=batch.bucket - n_real,
+                    )
         try:
-            return self.executor.launch(batch)
+            launched = self.executor.launch(batch)
         except Exception as e:
             for t in batch.tickets:
                 t.fail(e)
             return None
+        if traced:
+            t_l1 = tracer.clock()
+            for t in batch.tickets:
+                tr = t.trace
+                if tr is not None and not tr.finished:
+                    tr.add_span("launch", t_l0, t_l1,
+                                parent=tr.marks.get("root"))
+        return launched
 
     def _take_pending(self):
         """Swap the in-flight (tickets, token) pair out under the state
@@ -465,12 +564,29 @@ class ServeRuntime:
             return self._pending is None
 
     def _finalize(self, tickets, token) -> None:
+        tracer = self.tracer
+        traced = tracer.enabled
+        t_c0 = tracer.clock() if traced else 0.0
         try:
             results = self.executor.collect(token)
         except Exception as e:
             for t in tickets:
                 t.fail(e)
             return
+        if traced:
+            t_c1 = tracer.clock()
+            t_dev = getattr(token, "t_device", None)
+            for ticket, res in results:
+                tr = ticket.trace
+                if tr is None or tr.finished:
+                    continue
+                root = tr.marks.get("root")
+                served_by = getattr(res, "served_by", None)
+                if t_dev is not None and served_by == "device":
+                    tr.add_span("device", t_dev[0], t_dev[1], parent=root)
+                tr.add_span("collect", t_c0, t_c1, parent=root)
+                if served_by == "host":
+                    tr.add_span("host_fallback", t_c0, t_c1, parent=root)
         now = self.clock()
         for ticket, res in results:
             if isinstance(res, BaseException):

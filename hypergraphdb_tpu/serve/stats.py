@@ -1,16 +1,60 @@
-"""Serving metrics: counters, occupancy, latency percentiles.
+"""Serving metrics: a façade over one hgobs registry.
 
-One lock, no jax — safe to call from the submit path, the dispatch
-thread, and test assertions concurrently. Latencies live in a bounded
-ring buffer so a long-lived server's stats stay O(window), not O(total
-requests served).
+Pre-hgobs this module owned its own counters and a private latency ring —
+a second metrics surface disjoint from ``utils.metrics``. Every
+instrument now lives in an :class:`hypergraphdb_tpu.obs.Registry` under
+the ``serve.*`` dotted namespace (:data:`DOTTED_NAMES`); the latency ring
+became the shared histogram's bounded exact-percentile window. The public
+API is UNCHANGED — counter attributes (``stats.submitted``), the
+``record_*`` methods, and the legacy flat ``snapshot()`` keys all keep
+working; the legacy-key ↔ dotted-name mapping is committed as
+:data:`LEGACY_TO_DOTTED` (the compat shim) and ``snapshot_namespaced()``
+returns the dotted view. Prometheus rendering:
+``obs.export.prometheus_text(stats.registry)``.
+
+No jax — safe to call from the submit path, the dispatch thread, and
+test assertions concurrently.
 """
 
 from __future__ import annotations
 
 import threading
-from collections import deque
 from typing import Optional
+
+from hypergraphdb_tpu.obs.registry import Registry
+
+#: legacy ``snapshot()`` key -> dotted registry name (the compat shim;
+#: derived keys map to the instruments they are computed from)
+LEGACY_TO_DOTTED = {
+    "submitted": "serve.submitted",
+    "completed": "serve.completed",
+    "shed_deadline": "serve.shed_deadline",
+    "rejected_queue_full": "serve.rejected_queue_full",
+    "cancelled": "serve.cancelled",
+    "host_fallbacks": "serve.host_fallbacks",
+    "batches": "serve.batches",
+    "device_dispatches": "serve.device_dispatches",
+    "batch_occupancy": "serve.lanes_real",     # ÷ serve.lanes_padded
+    "latency_ms": "serve.latency_seconds",
+    "queue_depth": "serve.queue_depth",
+}
+
+#: every ``serve.*`` name this façade registers (drift-tested: the
+#: registry holds exactly these — no orphans, no duplicates)
+DOTTED_NAMES = (
+    "serve.submitted",
+    "serve.completed",
+    "serve.shed_deadline",
+    "serve.rejected_queue_full",
+    "serve.cancelled",
+    "serve.host_fallbacks",
+    "serve.batches",
+    "serve.device_dispatches",
+    "serve.lanes_real",
+    "serve.lanes_padded",
+    "serve.latency_seconds",
+    "serve.queue_depth",
+)
 
 
 class ServeStats:
@@ -20,120 +64,189 @@ class ServeStats:
     queue), ``rejected_queue_full`` (fail-fast backpressure),
     ``cancelled`` (runtime closed without drain), ``host_fallbacks``
     (requests served exactly on host instead of the batched device path),
-    ``batches`` (device dispatches). Occupancy is the fraction of real
-    (non-padding) lanes per dispatched bucket."""
+    ``batches`` (formed micro-batches), ``device_dispatches`` (real kernel
+    launches). Occupancy is the fraction of real (non-padding) lanes per
+    dispatched bucket."""
 
-    def __init__(self, latency_window: int = 4096):
+    def __init__(self, latency_window: int = 4096,
+                 registry: Optional[Registry] = None):
+        self.registry = registry if registry is not None else Registry()
+        # coherence lock: each instrument locks itself, but the accounting
+        # identity (submitted == completed + shed + cancelled + in-flight)
+        # spans SEVERAL counters — record_* and snapshot() serialize on
+        # this so a snapshot can never observe a torn multi-counter update
         self._lock = threading.Lock()
-        self._lat = deque(maxlen=latency_window)
-        self.submitted = 0
-        self.completed = 0
-        self.shed_deadline = 0
-        self.rejected_queue_full = 0
-        self.cancelled = 0
-        self.host_fallbacks = 0
-        self.batches = 0
-        self.device_dispatches = 0
-        self._real_lanes = 0
-        self._padded_lanes = 0
+        r = self.registry
+        self._submitted = r.counter("serve.submitted")
+        self._completed = r.counter("serve.completed")
+        self._shed = r.counter("serve.shed_deadline")
+        self._rejected = r.counter("serve.rejected_queue_full")
+        self._cancelled = r.counter("serve.cancelled")
+        self._host_fallbacks = r.counter("serve.host_fallbacks")
+        self._batches = r.counter("serve.batches")
+        self._device_dispatches = r.counter("serve.device_dispatches")
+        self._lanes_real = r.counter("serve.lanes_real")
+        self._lanes_padded = r.counter("serve.lanes_padded")
+        self._latency = r.histogram("serve.latency_seconds",
+                                    window=latency_window)
+        self._queue_depth = r.gauge("serve.queue_depth")
+        self._own = (
+            self._submitted, self._completed, self._shed, self._rejected,
+            self._cancelled, self._host_fallbacks, self._batches,
+            self._device_dispatches, self._lanes_real, self._lanes_padded,
+            self._latency, self._queue_depth,
+        )
 
     def reset(self) -> None:
         """Zero every counter and the latency/occupancy windows — the
         bench's post-warmup cut so compile-time latencies never pollute
-        steady-state percentiles."""
+        steady-state percentiles. Resets only THIS façade's instruments:
+        on a shared registry, foreign subsystems' counters (graph/tx/
+        compact) must survive a serving-stats cut."""
         with self._lock:
-            self._lat.clear()
-            self.submitted = 0
-            self.completed = 0
-            self.shed_deadline = 0
-            self.rejected_queue_full = 0
-            self.cancelled = 0
-            self.host_fallbacks = 0
-            self.batches = 0
-            self.device_dispatches = 0
-            self._real_lanes = 0
-            self._padded_lanes = 0
+            for m in self._own:
+                m.reset()
 
-    # -- recording (each a single locked update) ----------------------------
+    # -- recording (serialized on the coherence lock) ------------------------
     def record_submit(self) -> None:
         with self._lock:
-            self.submitted += 1
+            self._submitted.inc()
 
     def record_shed(self) -> None:
         with self._lock:
-            self.shed_deadline += 1
+            self._shed.inc()
 
     def record_reject(self) -> None:
         with self._lock:
-            self.rejected_queue_full += 1
+            self._rejected.inc()
 
     def record_cancel(self) -> None:
         with self._lock:
-            self.cancelled += 1
+            self._cancelled.inc()
 
     def record_host_fallback(self) -> None:
         with self._lock:
-            self.host_fallbacks += 1
+            self._host_fallbacks.inc()
 
     def record_batch(self, n_real: int, bucket: int) -> None:
         """One successfully launched micro-batch; occupancy measures the
         ADMISSION layer's coalescing (real requests / padded lanes)."""
         with self._lock:
-            self.batches += 1
-            self._real_lanes += n_real
-            self._padded_lanes += bucket
+            self._batches.inc()
+            self._lanes_real.inc(n_real)
+            self._lanes_padded.inc(bucket)
 
     def record_device_dispatch(self) -> None:
         """One real device kernel launch (a batch whose every lane fell
         back to host, or whose launch raised, dispatches none)."""
         with self._lock:
-            self.device_dispatches += 1
+            self._device_dispatches.inc()
 
     def record_complete(self, latency_s: float) -> None:
         with self._lock:
-            self.completed += 1
-            self._lat.append(latency_s)
+            self._completed.inc()
+            self._latency.observe(latency_s)
+
+    def set_queue_depth(self, depth: int) -> None:
+        """Pushed by the admission queue on every depth change, so a
+        direct Prometheus scrape of the registry sees a live gauge
+        without anyone calling ``snapshot()`` first."""
+        self._queue_depth.set(depth)
+
+    # -- counter attributes (pre-hgobs public surface) -----------------------
+    @property
+    def submitted(self) -> int:
+        return self._submitted.value
+
+    @property
+    def completed(self) -> int:
+        return self._completed.value
+
+    @property
+    def shed_deadline(self) -> int:
+        return self._shed.value
+
+    @property
+    def rejected_queue_full(self) -> int:
+        return self._rejected.value
+
+    @property
+    def cancelled(self) -> int:
+        return self._cancelled.value
+
+    @property
+    def host_fallbacks(self) -> int:
+        return self._host_fallbacks.value
+
+    @property
+    def batches(self) -> int:
+        return self._batches.value
+
+    @property
+    def device_dispatches(self) -> int:
+        return self._device_dispatches.value
 
     # -- reading -------------------------------------------------------------
     def occupancy(self) -> Optional[float]:
         """Mean real-lane fraction over every dispatched bucket slot."""
         with self._lock:
-            if not self._padded_lanes:
+            padded = self._lanes_padded.value
+            if not padded:
                 return None
-            return self._real_lanes / self._padded_lanes
+            return self._lanes_real.value / padded
 
     def latency_percentiles_ms(self) -> dict:
         """{"p50": ..., "p95": ..., "p99": ...} over the latency window
-        (milliseconds), or Nones before any completion."""
-        with self._lock:
-            lat = sorted(self._lat)
-        if not lat:
-            return {"p50": None, "p95": None, "p99": None}
-
-        def pct(p: float) -> float:
-            i = min(len(lat) - 1, int(round(p * (len(lat) - 1))))
-            return lat[i] * 1e3
-
-        return {"p50": pct(0.50), "p95": pct(0.95), "p99": pct(0.99)}
+        (milliseconds), or Nones before any completion. One locked read
+        of the window — concurrent completions can't tear the triple
+        (p50 > p99 impossible)."""
+        p50, p95, p99 = self._latency.percentiles((0.50, 0.95, 0.99))
+        return {
+            "p50": None if p50 is None else p50 * 1e3,
+            "p95": None if p95 is None else p95 * 1e3,
+            "p99": None if p99 is None else p99 * 1e3,
+        }
 
     def snapshot(self, queue_depth: Optional[int] = None) -> dict:
-        """One coherent metrics dict (the bench's reporting unit)."""
+        """One COHERENT metrics dict under the LEGACY flat keys (the
+        bench's reporting unit; see :data:`LEGACY_TO_DOTTED`): taken under
+        the coherence lock, so multi-counter identities hold in every
+        snapshot even under concurrent recording."""
         with self._lock:
+            padded = self._lanes_padded.value
             out = {
-                "submitted": self.submitted,
-                "completed": self.completed,
-                "shed_deadline": self.shed_deadline,
-                "rejected_queue_full": self.rejected_queue_full,
-                "cancelled": self.cancelled,
-                "host_fallbacks": self.host_fallbacks,
-                "batches": self.batches,
-                "device_dispatches": self.device_dispatches,
+                "submitted": self._submitted.value,
+                "completed": self._completed.value,
+                "shed_deadline": self._shed.value,
+                "rejected_queue_full": self._rejected.value,
+                "cancelled": self._cancelled.value,
+                "host_fallbacks": self._host_fallbacks.value,
+                "batches": self._batches.value,
+                "device_dispatches": self._device_dispatches.value,
                 "batch_occupancy": (
-                    self._real_lanes / self._padded_lanes
-                    if self._padded_lanes else None
+                    self._lanes_real.value / padded if padded else None
                 ),
             }
         out["latency_ms"] = self.latency_percentiles_ms()
         if queue_depth is not None:
+            self._queue_depth.set(queue_depth)
             out["queue_depth"] = queue_depth
+        return out
+
+    def snapshot_namespaced(self, queue_depth: Optional[int] = None) -> dict:
+        """The same snapshot under the dotted registry names (plus the
+        derived ``serve.batch_occupancy``) — what new consumers key on.
+        Latency percentiles ride under ``serve.latency_seconds`` in
+        SECONDS, matching the histogram that name denotes everywhere else
+        (only the legacy ``latency_ms`` key carries milliseconds)."""
+        legacy = self.snapshot(queue_depth)
+        out = {
+            LEGACY_TO_DOTTED[k]: v for k, v in legacy.items()
+            if k not in ("batch_occupancy", "latency_ms")
+        }
+        out["serve.batch_occupancy"] = legacy["batch_occupancy"]
+        out["serve.latency_seconds"] = {
+            k: (None if v is None else v / 1e3)
+            for k, v in legacy["latency_ms"].items()
+        }
         return out

@@ -123,15 +123,31 @@ class ServeResult:                 # raise on >1-element comparisons
 @dataclass
 class Ticket:
     """A queued request + its completion future and deadline bookkeeping
-    (absolute times per the runtime's injected clock)."""
+    (absolute times per the runtime's injected clock).
+
+    ``priority`` orders admission pops: a higher class pops first, FIFO
+    within a class (deadline shedding and backpressure are
+    priority-blind). ``trace`` is the request's hgobs trace handle —
+    ``None`` whenever tracing is off, so the disabled path allocates
+    nothing and every terminal helper gates on one attribute read. The
+    terminal span (``resolve``/``shed``/``error``) is emitted HERE so
+    every completion path — dispatch, cancel_all, executor failure —
+    closes the trace exactly once."""
 
     request: object
     future: Future = field(default_factory=Future)
     submit_t: float = 0.0
     deadline_t: Optional[float] = None
+    priority: int = 0
+    trace: object = None
 
     def expired(self, now: float) -> bool:
         return self.deadline_t is not None and now >= self.deadline_t
+
+    def _close_trace(self, terminal: str, **attrs) -> None:
+        tr = self.trace
+        if tr is not None:
+            tr.finish_terminal(terminal, **attrs)
 
     # Completion goes through these tolerant helpers everywhere: a caller
     # may have cancel()ed the future, and an InvalidStateError out of the
@@ -139,19 +155,25 @@ class Ticket:
     def resolve(self, result) -> bool:
         try:
             self.future.set_result(result)
-            return True
+            ok = True
         except Exception:
-            return False  # cancelled/already-done: nobody is listening
+            ok = False  # cancelled/already-done: nobody is listening
+        self._close_trace("resolve", delivered=ok)
+        return ok
 
     def fail(self, exc: BaseException) -> bool:
         try:
             self.future.set_exception(exc)
-            return True
+            ok = True
         except Exception:
-            return False
+            ok = False
+        if not isinstance(exc, DeadlineExceeded):  # shed() emits its own
+            self._close_trace("error", error=type(exc).__name__)
+        return ok
 
     def shed(self, now: float) -> None:
         self.fail(DeadlineExceeded(now - self.submit_t))
+        self._close_trace("shed", waited_s=now - self.submit_t)
 
     @property
     def batch_key(self) -> tuple:

@@ -170,10 +170,12 @@ class HGTransactionManager:
         #: id(tx) -> start_version for every live top-level transaction
         self._active: dict[int, int] = {}
         # stats (reference: TxMonitor.java:14 + conflicted/successful counters
-        # at HGTransactionManager.java:40-41)
+        # at HGTransactionManager.java:40-41); mirrored into the owning
+        # graph's hgobs registry (tx.* namespace) when `metrics` is set
         self.committed = 0
         self.conflicted = 0
         self.aborted = 0
+        self.metrics = None  # utils.metrics.Metrics, attached by the graph
 
     # -- context ---------------------------------------------------------------
     def _stack(self) -> list[HGTransaction]:
@@ -230,6 +232,9 @@ class HGTransactionManager:
             # += on a shared counter is load/add/store — concurrent aborts
             # lose counts without the lock (hglint HG402)
             self.aborted += 1
+        m = self.metrics
+        if m is not None:
+            m.incr("tx.aborts")
 
     def commit(self, tx: HGTransaction) -> None:
         st = self._stack()
@@ -246,12 +251,18 @@ class HGTransactionManager:
                     # same torn-increment hazard as `aborted` (hglint HG402);
                     # the write path below already counts under the lock
                     self.committed += 1
+                m = self.metrics
+                if m is not None:
+                    m.incr("tx.commits")
                 self._run_commit_hooks(tx)
                 return
             with self._commit_lock:
                 for cell, observed in tx.read_set.items():
                     if self._versions.get(cell, 0) != observed:
                         self.conflicted += 1
+                        m = self.metrics
+                        if m is not None:
+                            m.incr("tx.conflicts")
                         raise TransactionConflict(f"cell {cell!r} changed")
                 self._clock += 1
                 v = self._clock
@@ -266,6 +277,12 @@ class HGTransactionManager:
                 for key in tx.idx:
                     self._versions[("idx",) + key] = v
                 self.committed += 1
+                # mirror bumped ADJACENT to the legacy counter: an
+                # exception later in the commit (e.g. _gc_history) must
+                # not leave the two surfaces permanently disagreeing
+                m = self.metrics
+                if m is not None:
+                    m.incr("tx.commits")
                 self._gc_history()
         finally:
             self._active.pop(id(tx), None)

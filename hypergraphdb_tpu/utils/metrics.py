@@ -1,42 +1,59 @@
-"""Structured metrics — counters, gauges, timers per graph instance.
+"""Structured metrics — a thin façade over the hgobs registry.
 
 The reference's observability is a handful of ad-hoc counters (``HGStats``
 atom access counts ``atom/HGStats.java:20``, ``TxMonitor`` tx bookkeeping,
-``HGIndexStats`` planner estimates) with no unified surface. SURVEY §5
-asks for structured metrics from day one: ingest rate, frontier sizes,
-kernel timings, query latencies — one registry, one ``snapshot()`` dump.
+``HGIndexStats`` planner estimates) with no unified surface — and until
+hgobs, this repro had TWO disjoint surfaces of its own (this module's
+timing triples vs ``serve.stats``'s latency ring). ``Metrics`` keeps its
+day-one API (``incr``/``gauge``/``observe``/``timer``/``snapshot``) but
+every instrument now lives in an :class:`hypergraphdb_tpu.obs.Registry`:
+timers are shared log-bucketed histograms, and the whole surface renders
+to Prometheus via ``obs.export.prometheus_text(metrics.registry)``.
 
-Thread-safe; cheap enough to stay on in production (a dict update and a
-perf_counter per event)."""
+Thread-safe; cheap enough to stay on in production (a locked int bump
+or one histogram insert per event)."""
 
 from __future__ import annotations
 
-import threading
 import time
 from contextlib import contextmanager
+from typing import Optional
+
+from hypergraphdb_tpu.obs.registry import Registry, default_registry
 
 
 class Metrics:
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self.counters: dict[str, int] = {}
-        self.gauges: dict[str, float] = {}
-        # name -> (count, total_seconds, max_seconds)
-        self.timings: dict[str, tuple[int, float, float]] = {}
+    """Counters / gauges / timers for one graph instance (or the process,
+    via :data:`global_metrics`), all backed by ``self.registry``."""
+
+    def __init__(self, registry: Optional[Registry] = None) -> None:
+        self.registry = registry if registry is not None else Registry()
+        # hot-path memo: name -> instrument, so repeat events touch only
+        # the instrument's own lock, not the registry's get-or-create
+        # (plain dict ops are GIL-atomic; a racing miss just resolves the
+        # same instrument twice)
+        self._memo: dict = {}
 
     # -- primitives ----------------------------------------------------------
+    # memo keys carry the kind so a kind-mismatched name still surfaces
+    # the registry's ValueError instead of hitting a cached wrong type
     def incr(self, name: str, n: int = 1) -> None:
-        with self._lock:
-            self.counters[name] = self.counters.get(name, 0) + n
+        m = self._memo.get(("c", name))
+        if m is None:
+            m = self._memo[("c", name)] = self.registry.counter(name)
+        m.inc(n)
 
     def gauge(self, name: str, value: float) -> None:
-        with self._lock:
-            self.gauges[name] = float(value)
+        m = self._memo.get(("g", name))
+        if m is None:
+            m = self._memo[("g", name)] = self.registry.gauge(name)
+        m.set(value)
 
     def observe(self, name: str, seconds: float) -> None:
-        with self._lock:
-            cnt, tot, mx = self.timings.get(name, (0, 0.0, 0.0))
-            self.timings[name] = (cnt + 1, tot + seconds, max(mx, seconds))
+        m = self._memo.get(("h", name))
+        if m is None:
+            m = self._memo[("h", name)] = self.registry.histogram(name)
+        m.observe(seconds)
 
     @contextmanager
     def timer(self, name: str):
@@ -46,31 +63,58 @@ class Metrics:
         finally:
             self.observe(name, time.perf_counter() - t0)
 
+    # -- compat views (the pre-hgobs public attributes) ----------------------
+    @property
+    def counters(self) -> dict:
+        return {m.name: m.value for m in self.registry.instruments()
+                if m.kind == "counter"}
+
+    @property
+    def gauges(self) -> dict:
+        return {m.name: m.value for m in self.registry.instruments()
+                if m.kind == "gauge"}
+
+    @property
+    def timings(self) -> dict:
+        """name -> (count, total_seconds, max_seconds) — the legacy triple
+        view over the shared histograms (each triple read under one
+        lock, so it never tears against a concurrent observe)."""
+        out = {}
+        for m in self.registry.instruments():
+            if m.kind == "histogram":
+                s = m.summary()
+                out[m.name] = (s["count"], s["total"], s["max"])
+        return out
+
     # -- reporting -----------------------------------------------------------
     def snapshot(self) -> dict:
         """One structured dump: counters, gauges, and per-timer
-        count/total/mean/max (seconds)."""
-        with self._lock:
-            return {
-                "counters": dict(self.counters),
-                "gauges": dict(self.gauges),
-                "timings": {
-                    k: {
-                        "count": c,
-                        "total_s": t,
-                        "mean_s": (t / c if c else 0.0),
-                        "max_s": m,
-                    }
-                    for k, (c, t, m) in self.timings.items()
-                },
-            }
+        count/total/mean/max (seconds) — shape unchanged from day one."""
+        counters, gauges, timings = {}, {}, {}
+        for m in self.registry.instruments():
+            if m.kind == "counter":
+                counters[m.name] = m.value
+            elif m.kind == "gauge":
+                gauges[m.name] = m.value
+            else:
+                s = m.summary()  # one lock: the triple can't tear
+                timings[m.name] = {
+                    "count": s["count"],
+                    "total_s": s["total"],
+                    "mean_s": s["mean"],
+                    "max_s": s["max"],
+                }
+        return {"counters": counters, "gauges": gauges, "timings": timings}
 
     def reset(self) -> None:
-        with self._lock:
-            self.counters.clear()
-            self.gauges.clear()
-            self.timings.clear()
+        """Zero every instrument THIS façade created (every event routes
+        through the memo, so that is all of them) — on a shared registry,
+        instruments other façades registered are left alone. Iterates a
+        snapshot: a concurrent first-time recording inserting into the
+        memo must not blow up the reset loop."""
+        for m in list(self._memo.values()):
+            m.reset()
 
 
 #: process-wide registry for code without a graph in reach (kernel wrappers)
-global_metrics = Metrics()
+global_metrics = Metrics(registry=default_registry())

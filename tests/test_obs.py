@@ -1,0 +1,447 @@
+"""hgobs unit tests: span trees, the registry, and the export formats.
+
+Everything here is deterministic — injected fake clocks for traces,
+synthetic samples for histograms, and pure-text assertions for the
+Prometheus / JSONL wire formats (parsed line-by-line / round-tripped, per
+the committed schema).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+
+import pytest
+
+from hypergraphdb_tpu import obs
+from hypergraphdb_tpu.obs.registry import (
+    DEFAULT_BOUNDS,
+    Histogram,
+    Registry,
+)
+from hypergraphdb_tpu.obs.trace import Tracer
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ------------------------------------------------------------------ tracing
+
+
+def make_tracer(**kw):
+    clock = FakeClock()
+    tr = Tracer(clock=clock, **kw)
+    tr.enable()
+    return tr, clock
+
+
+def test_span_tree_parenting_and_durations():
+    tracer, clock = make_tracer()
+    tr = tracer.start_trace("serve.request", kind="bfs")
+    root = tr.start_span("request")
+    clock.advance(1.0)
+    child = tr.start_span("queue_wait", parent=root)
+    clock.advance(2.0)
+    child.end()
+    grand = tr.start_span("collect", parent=child)
+    clock.advance(0.5)
+    grand.end()
+    root.end()
+    tr.finish()
+
+    assert tr.attrs == {"kind": "bfs"}
+    assert child.parent_id == root.span_id
+    assert grand.parent_id == child.span_id
+    assert root.parent_id is None
+    assert child.duration == pytest.approx(2.0)
+    assert root.duration == pytest.approx(3.5)
+    assert tr.children_of(root) == [child]
+    assert tr.children_of(None) == [root]
+    # nested: every child's window sits inside its parent's
+    assert root.t0 <= child.t0 <= child.t1 <= root.t1
+
+
+def test_span_attributes_typed():
+    tracer, _ = make_tracer()
+    tr = tracer.start_trace("t")
+    sp = tr.start_span("s", bucket=64, n_real=3)
+    sp.set(occupancy=0.25, key="bfs", flag=True, nothing=None)
+    assert sp.attrs["bucket"] == 64
+    assert sp.attrs["occupancy"] == 0.25
+    with pytest.raises(TypeError):
+        sp.set(bad=[1, 2, 3])  # non-scalar attrs are not exportable
+
+
+def test_span_budget_overflow_counts_drops():
+    tracer, _ = make_tracer(max_spans=4)
+    tr = tracer.start_trace("t")
+    spans = [tr.start_span(f"s{i}") for i in range(10)]
+    assert len(tr.spans()) == 4
+    assert tr.dropped == 6
+    # overflow spans are real objects — call sites never branch
+    spans[-1].end()
+    tr.finish()
+    assert tr.dropped == 6
+
+
+def test_off_gate_allocates_nothing():
+    tracer = Tracer(clock=FakeClock())
+    assert tracer.enabled is False
+    assert tracer.start_trace("t") is None
+    assert tracer.traces_started == 0
+    with tracer.trace_ctx("t") as tr:
+        assert tr is None
+        with tracer.span("child") as sp:
+            assert sp is None
+    assert tracer.traces_started == 0
+    assert tracer.drain() == []
+
+
+def test_finish_idempotent_and_retains_once():
+    tracer, clock = make_tracer()
+    tr = tracer.start_trace("t")
+    sp = tr.start_span("open")  # left open: finish closes it
+    clock.advance(1.0)
+    assert tr.finish() is True
+    assert tr.finish() is False
+    tracer.finish_trace(tr)  # tolerant second path
+    assert sp.t1 == pytest.approx(1.0)
+    assert tracer.finished_count() == 1
+    assert [t.name for t in tracer.drain()] == ["t"]
+    assert tracer.drain() == []  # drain consumes
+
+
+def test_span_after_finish_is_detached():
+    """Cross-thread race hardening: a span started after finish() must
+    never mutate the already-retained trace (no forever-open spans in the
+    export)."""
+    tracer, clock = make_tracer()
+    tr = tracer.start_trace("t")
+    tr.start_span("before")
+    tr.finish()
+    late = tr.start_span("late")          # loser of a finish race
+    late.end()                            # harmless on the detached span
+    assert [s.name for s in tr.spans()] == ["before"]
+    assert all(s.t1 is not None for s in tr.spans())
+    (done,) = tracer.drain()
+    assert done is tr
+
+
+def test_trace_ctx_implicit_nesting():
+    tracer, clock = make_tracer()
+    with tracer.trace_ctx("query") as tr:
+        with tracer.span("compile"):
+            clock.advance(1.0)
+            with tracer.span("inner"):
+                clock.advance(0.5)
+        with tracer.span("plan", plan="IntersectPlan"):
+            clock.advance(0.25)
+    (done,) = tracer.drain()
+    assert done is tr
+    names = [s.name for s in done.spans()]
+    assert names == ["query", "compile", "inner", "plan"]
+    root = done.find("query")
+    inner = done.find("inner")
+    assert done.find("compile").parent_id == root.span_id
+    assert inner.parent_id == done.find("compile").span_id
+    assert done.find("plan").attrs == {"plan": "IntersectPlan"}
+    assert tracer.current_trace() is None
+
+
+def test_finished_buffer_is_bounded():
+    tracer, _ = make_tracer(max_finished=3)
+    for i in range(10):
+        tracer.finish_trace(tracer.start_trace(f"t{i}"))
+    assert tracer.finished_count() == 3
+    assert [t.name for t in tracer.drain()] == ["t7", "t8", "t9"]
+
+
+# ---------------------------------------------------------------- registry
+
+
+def test_registry_get_or_create_and_kind_conflict():
+    r = Registry()
+    c1 = r.counter("serve.submitted")
+    c1.inc()
+    c1.inc(4)
+    assert r.counter("serve.submitted") is c1
+    assert c1.value == 5
+    r.gauge("serve.queue_depth").set(7)
+    with pytest.raises(ValueError):
+        r.gauge("serve.submitted")  # same name, different kind
+    with pytest.raises(ValueError):
+        r.counter("")
+    assert r.names() == ["serve.queue_depth", "serve.submitted"]
+    r.reset()
+    assert c1.value == 0
+
+
+def test_histogram_param_drift_guard():
+    """Explicit non-default bounds/window must match the existing
+    instrument — a requested exact-percentile window cannot silently
+    degrade to bucket estimates (default-arg calls are pure gets)."""
+    r = Registry()
+    h = r.histogram("lat", window=16)
+    assert r.histogram("lat") is h              # default args: pure get
+    assert r.histogram("lat", window=16) is h   # matching params fine
+    with pytest.raises(ValueError, match="window"):
+        r.histogram("lat", window=32)
+    r2 = Registry()
+    r2.histogram("b", bounds=(1.0, 2.0))
+    with pytest.raises(ValueError, match="bounds"):
+        r2.histogram("b", bounds=(1.0, 4.0))
+    with pytest.raises(ValueError, match="window"):
+        r2.histogram("b", window=8)  # windowless registered first
+
+
+def test_histogram_bucket_boundaries():
+    h = Histogram("h", bounds=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.0, 1.5, 4.0, 100.0):  # edges land in their own bucket
+        h.observe(v)
+    buckets = h.bucket_counts()
+    assert [b for b, _ in buckets] == [1.0, 2.0, 4.0, math.inf]
+    assert [c for _, c in buckets] == [2, 3, 4, 5]  # cumulative
+    assert h.count == 5
+    assert h.max == 100.0
+    assert h.total == pytest.approx(107.0)
+    assert h.mean == pytest.approx(107.0 / 5)
+    with pytest.raises(ValueError):
+        Histogram("bad", bounds=(2.0, 1.0))
+
+
+def test_histogram_percentiles_exact_window_vs_oracle():
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    samples = rng.exponential(0.01, size=500).tolist()
+    h = Histogram("h", window=1024)
+    for s in samples:
+        h.observe(s)
+    lat = sorted(samples)
+
+    def oracle(p):
+        return lat[min(len(lat) - 1, int(round(p * (len(lat) - 1))))]
+
+    for p in (0.5, 0.95, 0.99):
+        assert h.percentile(p) == pytest.approx(oracle(p))
+    # the one-locked-read triple matches and is monotone by construction
+    p50, p95, p99 = h.percentiles((0.5, 0.95, 0.99))
+    assert p50 == pytest.approx(oracle(0.5))
+    assert p50 <= p95 <= p99
+
+
+def test_histogram_percentiles_bucketed_within_one_ratio():
+    import numpy as np
+
+    rng = np.random.default_rng(11)
+    samples = rng.exponential(0.01, size=2000).tolist()
+    h = Histogram("h")  # no window: log-bucket estimate, DEFAULT_BOUNDS ×2
+    for s in samples:
+        h.observe(s)
+    lat = sorted(samples)
+    for p in (0.5, 0.95, 0.99):
+        est = h.percentile(p)
+        truth = lat[min(len(lat) - 1, int(round(p * (len(lat) - 1))))]
+        assert truth <= est <= truth * 2.0  # upper edge, one ×2 bucket off
+    assert Histogram("e").percentile(0.5) is None
+    with pytest.raises(ValueError):
+        h.percentile(1.5)
+
+
+def test_default_bounds_are_log_spaced():
+    ratios = [b / a for a, b in zip(DEFAULT_BOUNDS, DEFAULT_BOUNDS[1:])]
+    assert all(r == pytest.approx(2.0) for r in ratios)
+
+
+# ---------------------------------------------------------------- exports
+
+PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{le=\"[^\"]+\"\})? -?[0-9.eE+-]+$"
+    r"|^[a-zA-Z_:][a-zA-Z0-9_:]*(\{le=\"\+Inf\"\})? [0-9]+$"
+)
+
+
+def _sample_registry():
+    r = Registry()
+    r.counter("serve.submitted").inc(3)
+    r.gauge("serve.queue_depth").set(2.5)
+    h = r.histogram("serve.latency_seconds", bounds=(0.001, 0.01, 0.1))
+    for v in (0.0005, 0.02, 0.5):
+        h.observe(v)
+    return r
+
+
+def test_prometheus_text_parses_line_by_line():
+    text = obs.prometheus_text(_sample_registry())
+    lines = text.strip().splitlines()
+    assert lines, "empty exposition"
+    for ln in lines:
+        if ln.startswith("# TYPE "):
+            assert re.match(r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
+                            r"(counter|gauge|histogram)$", ln)
+        else:
+            assert PROM_LINE.match(ln), f"unparseable line: {ln!r}"
+    assert "serve_submitted_total 3" in lines
+    assert "serve_queue_depth 2.5" in lines
+    # histogram: cumulative buckets, +Inf == count, sum present
+    buckets = [ln for ln in lines if ln.startswith("serve_latency_seconds_bucket")]
+    counts = [int(ln.rsplit(" ", 1)[1]) for ln in buckets]
+    assert counts == sorted(counts)
+    assert buckets[-1] == 'serve_latency_seconds_bucket{le="+Inf"} 3'
+    assert "serve_latency_seconds_count 3" in lines
+
+
+def test_prometheus_merged_registries_dedupe():
+    a, b = _sample_registry(), _sample_registry()
+    b.counter("other.thing").inc()
+    text = obs.prometheus_text(a, b)
+    samples = [ln for ln in text.splitlines()
+               if ln.startswith("serve_submitted_total ")]
+    assert samples == ["serve_submitted_total 3"]  # first registry wins
+    assert "other_thing_total 1" in text
+
+
+def test_traces_jsonl_round_trip():
+    tracer, clock = make_tracer()
+    tr = tracer.start_trace("serve.request", kind="bfs")
+    root = tr.start_span("request")
+    clock.advance(1.0)
+    tr.start_span("submit", parent=root, bucket=64).end()
+    tr.finish()
+    text = obs.traces_to_jsonl(tracer.drain())
+    recs = obs.parse_traces_jsonl(text)
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["schema_version"] == obs.TRACE_SCHEMA_VERSION
+    assert rec["name"] == "serve.request"
+    assert rec["attrs"] == {"kind": "bfs"}
+    names = [s["name"] for s in rec["spans"]]
+    assert names == ["request", "submit"]
+    by_name = {s["name"]: s for s in rec["spans"]}
+    assert by_name["submit"]["parent_id"] == by_name["request"]["span_id"]
+    assert by_name["submit"]["attrs"] == {"bucket": 64}
+    assert rec["t1"] >= rec["t0"]
+
+
+def test_traces_jsonl_rejects_wrong_schema():
+    tracer, _ = make_tracer()
+    tracer.finish_trace(tracer.start_trace("t"))
+    text = obs.traces_to_jsonl(tracer.drain())
+    bumped = text.replace(f'"schema_version": {obs.TRACE_SCHEMA_VERSION}',
+                          '"schema_version": 99')
+    with pytest.raises(ValueError, match="schema_version"):
+        obs.parse_traces_jsonl(bumped)
+    with pytest.raises(ValueError, match="missing"):
+        obs.parse_traces_jsonl('{"schema_version": 1}\n')
+    assert obs.parse_traces_jsonl("") == []
+
+
+def test_write_telemetry_files(tmp_path):
+    tracer, _ = make_tracer()
+    tracer.finish_trace(tracer.start_trace("t"))
+    out = obs.write_telemetry(str(tmp_path / "tele"),
+                              registries=[_sample_registry()],
+                              tracer=tracer)
+    assert out["n_traces"] == 1
+    prom = open(out["prometheus"]).read()
+    assert "serve_submitted_total 3" in prom
+    recs = obs.parse_traces_jsonl(open(out["traces"]).read())
+    assert [r["name"] for r in recs] == ["t"]
+
+
+def test_profile_noop_without_logdir():
+    with obs.profile(None) as active:
+        assert active is False
+    with obs.profile("") as active:
+        assert active is False
+
+
+# ------------------------------------------------------------ the façades
+
+
+def test_metrics_facade_shapes_unchanged():
+    from hypergraphdb_tpu.utils.metrics import Metrics
+
+    m = Metrics()
+    m.incr("graph.mutations", 2)
+    m.gauge("snapshot.num_atoms", 10)
+    m.observe("snapshot.pack", 0.25)
+    with m.timer("query.execute"):
+        pass
+    snap = m.snapshot()
+    assert snap["counters"]["graph.mutations"] == 2
+    assert snap["gauges"]["snapshot.num_atoms"] == 10.0
+    assert snap["timings"]["snapshot.pack"]["count"] == 1
+    assert snap["timings"]["snapshot.pack"]["max_s"] == pytest.approx(0.25)
+    assert snap["timings"]["query.execute"]["count"] == 1
+    # legacy attribute views still read
+    assert m.counters == {"graph.mutations": 2}
+    assert m.timings["snapshot.pack"][0] == 1
+    # and the whole surface is one registry — renderable as Prometheus
+    assert "graph_mutations_total 2" in obs.prometheus_text(m.registry)
+    m.reset()
+    assert m.snapshot()["counters"] == {"graph.mutations": 0}
+
+
+def test_serve_stats_namespace_no_drift():
+    """The metric-name-drift gate: ServeStats registers EXACTLY the
+    committed dotted names, every legacy snapshot key maps to a live
+    instrument, and nothing in the registry is orphaned."""
+    from hypergraphdb_tpu.serve.stats import (
+        DOTTED_NAMES,
+        LEGACY_TO_DOTTED,
+        ServeStats,
+    )
+
+    s = ServeStats(latency_window=16)
+    assert s.registry.names() == sorted(DOTTED_NAMES)      # no orphans
+    assert len(set(DOTTED_NAMES)) == len(DOTTED_NAMES)     # no duplicates
+    for legacy, dotted in LEGACY_TO_DOTTED.items():
+        assert s.registry.get(dotted) is not None, (legacy, dotted)
+    # every snapshot key is covered by the shim
+    snap = s.snapshot(queue_depth=0)
+    assert set(snap) == set(LEGACY_TO_DOTTED)
+    # namespaced view mirrors the legacy one
+    s.record_submit()
+    s.record_batch(n_real=1, bucket=4)
+    ns = s.snapshot_namespaced(queue_depth=3)
+    assert ns["serve.submitted"] == 1
+    assert ns["serve.queue_depth"] == 3
+    assert ns["serve.batch_occupancy"] == pytest.approx(0.25)
+    assert s.registry.get("serve.queue_depth").value == 3.0
+
+
+def test_serve_stats_shared_namespace_with_graph_metrics():
+    """ServeStats and Metrics can share ONE process registry without
+    name collisions — the unified-surface claim."""
+    from hypergraphdb_tpu.serve.stats import ServeStats
+    from hypergraphdb_tpu.utils.metrics import Metrics
+
+    reg = Registry()
+    m = Metrics(registry=reg)
+    s = ServeStats(latency_window=8, registry=reg)
+    m.incr("graph.mutations")
+    s.record_submit()
+    names = set(reg.names())
+    assert "graph.mutations" in names and "serve.submitted" in names
+    text = obs.prometheus_text(reg)
+    assert "graph_mutations_total 1" in text
+    assert "serve_submitted_total 1" in text
+    # reset scope: each façade zeroes only ITS instruments — a serving
+    # post-warmup cut must not wipe graph/tx counters sharing the registry
+    s.reset()
+    assert reg.get("serve.submitted").value == 0
+    assert reg.get("graph.mutations").value == 1
+    m.incr("graph.mutations")
+    m.reset()
+    assert reg.get("graph.mutations").value == 0
+    s.record_submit()
+    assert reg.get("serve.submitted").value == 1  # untouched by m.reset

@@ -1,0 +1,502 @@
+"""hgobs × serving integration: the overhead contract, the span chain,
+admission priorities, and the cross-layer wiring (query / compaction / tx).
+
+The acceptance-critical pair:
+
+- **tracing off** (the default): a serving loop executes the IDENTICAL
+  dispatch sequence as before hgobs existed (event-order differential
+  against the fake executor) and allocates nothing per request beyond the
+  one gate read — asserted by poisoning ``Tracer.start_trace``;
+- **tracing on**: a served request's trace carries the full
+  ``submit → queue_wait → batch_form → launch → collect → resolve``
+  chain (+ ``device`` with timing opt-in, ``host_fallback`` on the exact
+  path, ``shed`` on deadline expiry) with non-negative, properly nested
+  durations.
+
+Deterministic throughout: manual-mode runtimes, one FakeClock shared by
+the runtime and the tracer, fake executors everywhere the device does not
+matter.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from hypergraphdb_tpu.obs.trace import Tracer
+from hypergraphdb_tpu.serve import (
+    DeadlineExceeded,
+    ServeConfig,
+    ServeResult,
+    ServeRuntime,
+)
+from tests.test_serve_runtime import FakeClock, FakeExecutor
+
+
+def make_runtime(tracer=None, clock=None, buckets=(4, 16), linger=0.010,
+                 **kw):
+    clock = clock or FakeClock()
+    cfg = ServeConfig(buckets=buckets, max_linger_s=linger, clock=clock,
+                      manual=True, tracer=tracer, **kw)
+    ex = FakeExecutor()
+    return ServeRuntime(graph=None, config=cfg, executor=ex), ex, clock
+
+
+def traced_runtime(**kw):
+    clock = FakeClock()
+    tracer = Tracer(clock=clock)
+    tracer.enable()
+    rt, ex, _ = make_runtime(tracer=tracer, clock=clock, **kw)
+    return rt, ex, clock, tracer
+
+
+def run_workload(rt, clock):
+    """A fixed mixed workload; returns the executor's event log."""
+    rt.submit_bfs(1)
+    rt.submit_bfs(2)
+    rt.pump(drain=True)          # launch B0
+    rt.submit_pattern([1, 2])
+    rt.submit_bfs(3, max_hops=5)
+    clock.advance(0.02)          # linger both remaining groups
+    while rt.pump(drain=True):
+        pass
+    rt.close(drain=True)
+
+
+# ------------------------------------------------------------- off-gate
+
+
+def test_tracing_off_identical_dispatch_sequence():
+    """Differential: the event order with obs wired in (disabled) matches
+    the machinery's committed pipelining contract exactly."""
+    rt, ex, clock = make_runtime()
+    assert rt.tracer.enabled is False
+    run_workload(rt, clock)
+    assert ex.events == [
+        ("launch", 0), ("launch", 1), ("collect", 0),
+        ("launch", 2), ("collect", 1), ("collect", 2),
+    ]
+
+
+def test_tracing_off_allocates_no_trace_objects(monkeypatch):
+    """The disabled path must never reach trace construction: poison
+    start_trace and run the full serving workload."""
+    def boom(self, name, **attrs):  # pragma: no cover - must not run
+        raise AssertionError("start_trace called with tracing off")
+
+    monkeypatch.setattr(Tracer, "start_trace", boom)
+    rt, ex, clock = make_runtime()
+    run_workload(rt, clock)
+    assert len(ex.batches) == 3
+
+
+def test_tracing_off_tickets_carry_no_trace():
+    rt, ex, clock = make_runtime()
+    rt.submit_bfs(1)
+    (t,) = rt.queue._dq
+    assert t.trace is None
+    rt.close(drain=True)
+
+
+# ------------------------------------------------------------ span chain
+
+
+def test_served_request_full_span_chain():
+    rt, ex, clock, tracer = traced_runtime(linger=0.0)
+    fut = rt.submit_bfs(7, max_hops=2)
+    clock.advance(0.003)
+    assert rt.step(drain=True)
+    assert fut.result(timeout=0).kind == "bfs"
+    (tr,) = tracer.drain()
+
+    assert tr.name == "serve.request"
+    assert tr.attrs == {"kind": "bfs", "priority": 0}
+    names = [s.name for s in tr.spans()]
+    assert names == ["request", "submit", "queue_wait", "batch_form",
+                     "launch", "collect", "resolve"]
+    root = tr.find("request")
+    by = {s.name: s for s in tr.spans()}
+    # every stage is a child of the root request span
+    for n in names[1:]:
+        assert by[n].parent_id == root.span_id, n
+    # chain is ordered, durations non-negative, all nested in the root
+    for a, b in zip(names[1:], names[2:]):
+        assert by[a].t0 <= by[b].t0, (a, b)
+    for s in tr.spans():
+        assert s.t1 is not None and s.t1 >= s.t0
+        assert root.t0 <= s.t0 and s.t1 <= root.t1
+    assert by["queue_wait"].duration == pytest.approx(0.003)
+    assert by["batch_form"].attrs == {"bucket": 4, "n_real": 1, "n_pad": 3}
+    assert by["resolve"].attrs == {"delivered": True}
+    assert tr.dropped == 0
+
+
+def test_shed_request_trace_ends_with_shed():
+    rt, ex, clock, tracer = traced_runtime()
+    fut = rt.submit_bfs(1, deadline_s=0.5)
+    clock.advance(1.0)
+    assert rt.step(drain=True) is False
+    with pytest.raises(DeadlineExceeded):
+        fut.result(timeout=0)
+    (tr,) = tracer.drain()
+    names = [s.name for s in tr.spans()]
+    assert names == ["request", "submit", "queue_wait", "shed"]
+    assert tr.find("shed").attrs["waited_s"] == pytest.approx(1.0)
+    assert ex.batches == []  # still no dispatch for a dead request
+
+
+def test_launch_error_trace_ends_with_error():
+    from tests.test_serve_runtime import ExplodingExecutor
+
+    clock = FakeClock()
+    tracer = Tracer(clock=clock)
+    tracer.enable()
+    cfg = ServeConfig(buckets=(4,), clock=clock, manual=True,
+                      max_linger_s=0.0, tracer=tracer)
+    rt = ServeRuntime(graph=None, config=cfg, executor=ExplodingExecutor())
+    fut = rt.submit_bfs(1)
+    rt.step(drain=True)
+    with pytest.raises(RuntimeError):
+        fut.result(timeout=0)
+    (tr,) = tracer.drain()
+    assert [s.name for s in tr.spans()][-1] == "error"
+    assert tr.find("error").attrs == {"error": "RuntimeError"}
+
+
+def test_host_fallback_span_recorded():
+    class HostExecutor(FakeExecutor):
+        def collect(self, token):
+            idx, batch = token
+            self.events.append(("collect", idx))
+            return [
+                (t, ServeResult(t.request.kind, 0,
+                                np.empty(0, dtype=np.int64), False, 0,
+                                served_by="host"))
+                for t in batch.tickets
+            ]
+
+    clock = FakeClock()
+    tracer = Tracer(clock=clock)
+    tracer.enable()
+    cfg = ServeConfig(buckets=(4,), clock=clock, manual=True,
+                      max_linger_s=0.0, tracer=tracer)
+    rt = ServeRuntime(graph=None, config=cfg, executor=HostExecutor())
+    fut = rt.submit_bfs(1)
+    rt.step(drain=True)
+    assert fut.result(timeout=0).served_by == "host"
+    (tr,) = tracer.drain()
+    names = [s.name for s in tr.spans()]
+    assert "host_fallback" in names
+    assert names[-1] == "resolve"
+
+
+def test_span_budget_bounds_a_request_trace():
+    rt, ex, clock, tracer = traced_runtime(linger=0.0)
+    tracer.max_spans = 3
+    fut = rt.submit_bfs(1)
+    rt.step(drain=True)
+    fut.result(timeout=0)
+    (tr,) = tracer.drain()
+    assert len(tr.spans()) == 3
+    assert tr.dropped > 0
+
+
+# ------------------------------------------------------------- priorities
+
+
+def test_higher_priority_class_pops_first():
+    rt, ex, clock = make_runtime(linger=1e9)
+    lo = rt.submit_bfs(1, max_hops=2, priority=0)
+    lo2 = rt.submit_bfs(2, max_hops=2, priority=0)
+    hi = rt.submit_pattern([1, 2], priority=5)
+    # batch formation follows the HIGHEST class present, not arrival order
+    assert rt.step(drain=True)
+    assert ex.batches[0].key == ("pattern", 2)
+    assert rt.step(drain=True)
+    assert ex.batches[1].key == ("bfs", 2)
+    assert [t.request.seed for t in ex.batches[1].tickets] == [1, 2]
+    for f in (lo, lo2, hi):
+        assert f.result(timeout=0) is not None
+
+
+def test_priority_fifo_within_class_and_lane_order():
+    rt, ex, clock = make_runtime(linger=1e9)
+    rt.submit_bfs(1, priority=0)
+    rt.submit_bfs(2, priority=9)
+    rt.submit_bfs(3, priority=9)
+    rt.submit_bfs(4, priority=0)
+    assert rt.step(drain=True)
+    (batch,) = ex.batches
+    # one batch (same key); lanes ordered class-desc, FIFO within class
+    assert [t.request.seed for t in batch.tickets] == [2, 3, 1, 4]
+    assert [t.priority for t in batch.tickets] == [9, 9, 0, 0]
+
+
+def test_lingered_low_priority_not_starved_by_hi_trickle():
+    """A lingered low-priority group must keep flushing the queue until
+    it reaches the front — a trickle of fresh high-priority arrivals
+    (each younger than the linger) cannot park it forever."""
+    rt, ex, clock = make_runtime(linger=0.10)
+    lo = rt.submit_bfs(1, max_hops=2, priority=0)      # key A at t=0
+    clock.advance(0.08)
+    rt.submit_pattern([1, 2], priority=5)              # key B, fresh
+    clock.advance(0.03)                                # t=0.11: lo lingered
+    # lo's linger forces a flush even though the hi-pri head is young;
+    # priority still decides WHICH key goes first
+    assert rt.step() is True
+    assert ex.batches[0].key == ("pattern", 2)
+    # the very next cycle reaches the lingered low-priority group
+    assert rt.step() is True
+    assert ex.batches[1].key == ("bfs", 2)
+    assert lo.result(timeout=0).kind == "bfs"
+    # and the dispatch thread's sleep is keyed to the oldest ticket too
+    rt.submit_bfs(9, priority=0)
+    clock.advance(0.05)
+    rt.submit_pattern([3, 4], priority=5)
+    assert rt.batcher.time_to_flush(clock()) == pytest.approx(0.05)
+
+
+def test_priority_deadline_shedding_unchanged():
+    rt, ex, clock = make_runtime()
+    hi_dead = rt.submit_bfs(1, deadline_s=0.5, priority=9)
+    lo_live = rt.submit_bfs(2, deadline_s=10.0, priority=0)
+    clock.advance(1.0)
+    assert rt.step(drain=True)
+    with pytest.raises(DeadlineExceeded):
+        hi_dead.result(timeout=0)  # priority does not outrank a deadline
+    assert lo_live.result(timeout=0).kind == "bfs"
+    assert rt.stats.shed_deadline == 1
+
+
+def test_priority_backpressure_unchanged():
+    from hypergraphdb_tpu.serve import QueueFull
+
+    rt, ex, clock = make_runtime(policy="fail", max_queue=2)
+    rt.submit_bfs(1, priority=0)
+    rt.submit_bfs(2, priority=0)
+    with pytest.raises(QueueFull):
+        rt.submit_bfs(3, priority=9)  # a full queue is priority-blind
+    assert rt.stats.rejected_queue_full == 1
+
+
+def test_priority_rides_into_trace_attrs():
+    rt, ex, clock, tracer = traced_runtime(linger=0.0)
+    fut = rt.submit_bfs(1, priority=3)
+    rt.step(drain=True)
+    fut.result(timeout=0)
+    (tr,) = tracer.drain()
+    assert tr.attrs["priority"] == 3
+
+
+# ------------------------------------------------- cross-layer wiring
+
+
+@pytest.fixture
+def global_tracing():
+    """Enable the PROCESS tracer for one test, restore after."""
+    from hypergraphdb_tpu import obs
+
+    tracer = obs.tracer()
+    tracer.enable()
+    tracer.drain()
+    try:
+        yield tracer
+    finally:
+        tracer.disable()
+        tracer.drain()
+
+
+def test_query_trace_compile_plan_execute(graph, global_tracing):
+    from hypergraphdb_tpu.query import dsl
+    from hypergraphdb_tpu.query.compiler import compile_query
+
+    h = graph.add("obs-q")
+    cq = compile_query(graph, dsl.value("obs-q"))
+    assert list(cq.execute()) == [int(h)]
+    traces = [t for t in global_tracing.drain() if t.name == "query"]
+    assert traces, "no query trace recorded"
+    tr = traces[-1]
+    names = [s.name for s in tr.spans()]
+    assert names == ["query", "compile", "plan", "execute"]
+    root = tr.find("query")
+    for s in tr.spans()[1:]:
+        assert s.parent_id == root.span_id
+        assert s.t1 is not None and s.t1 >= s.t0
+    assert tr.find("execute").attrs["results"] == 1
+    assert "plan" in tr.find("plan").attrs
+    # a second execute() must not grow the finished trace
+    list(cq.execute())
+    assert [t.name for t in global_tracing.drain()].count("query") == 0
+
+
+def test_query_trace_finishes_via_results_and_count(graph, global_tracing):
+    from hypergraphdb_tpu.query import dsl
+    from hypergraphdb_tpu.query.compiler import compile_query
+
+    graph.add("obs-r")
+    assert len(compile_query(graph, dsl.value("obs-r")).results()) == 1
+    assert compile_query(graph, dsl.value("obs-r")).count() == 1
+    finished = [t for t in global_tracing.drain() if t.name == "query"]
+    assert len(finished) == 2  # both read paths export their trace
+    for tr in finished:
+        assert tr.find("execute") is not None
+
+
+def test_query_trace_exported_when_execute_raises(graph, global_tracing):
+    from hypergraphdb_tpu.query import dsl
+    from hypergraphdb_tpu.query.compiler import compile_query
+
+    cq = compile_query(graph, dsl.value("whatever"))
+
+    class BrokenPlan:
+        def run(self, g):
+            raise RuntimeError("plan fell over")
+
+    cq.plan = BrokenPlan()
+    with pytest.raises(RuntimeError, match="plan fell over"):
+        list(cq.execute())
+    (tr,) = [t for t in global_tracing.drain() if t.name == "query"]
+    # the failing query is the one worth keeping: closed execute span
+    # plus the shared error terminal
+    assert tr.find("execute").t1 is not None
+    assert tr.find("error").attrs == {"error": "RuntimeError"}
+    assert tr.finished
+
+
+def test_compact_trace_exported_when_swap_raises(graph, global_tracing,
+                                                 monkeypatch):
+    mgr = graph.enable_incremental()
+    global_tracing.drain()
+    monkeypatch.setattr(
+        mgr, "_assemble_and_swap",
+        lambda ext: (_ for _ in ()).throw(RuntimeError("swap OOM")),
+    )
+    with pytest.raises(RuntimeError, match="swap OOM"):
+        mgr._compact_sync()
+    (tr,) = [t for t in global_tracing.drain() if t.name == "compact"]
+    assert tr.find("error").attrs == {"error": "RuntimeError"}
+    assert tr.find("buffer_drain") is not None
+    snap = graph.metrics.snapshot()
+    assert snap["counters"]["compact.failures"] == 1
+
+
+def test_compaction_trace_and_metrics(graph, global_tracing):
+    for i in range(4):
+        graph.add(f"c{i}")
+    mgr = graph.enable_incremental()
+    global_tracing.drain()  # drop the init-pack trace
+    a, b = graph.add("x"), graph.add("y")
+    graph.add_link([a, b], value="e")
+    mgr._compact_sync()
+    traces = [t for t in global_tracing.drain() if t.name == "compact"]
+    assert traces
+    tr = traces[-1]
+    names = [s.name for s in tr.spans()]
+    assert names == ["compact", "buffer_drain", "device_swap"]
+    root = tr.find("compact")
+    for s in tr.spans()[1:]:
+        assert s.parent_id == root.span_id
+        assert root.t0 <= s.t0 <= s.t1 <= root.t1
+    snap = graph.metrics.snapshot()
+    assert snap["counters"]["compact.passes"] >= 1
+    assert snap["timings"]["compact.extract_seconds"]["count"] >= 1
+
+
+def test_tx_counters_mirrored_into_registry(graph):
+    before = graph.metrics.snapshot()["counters"].get("tx.commits", 0)
+    graph.add("tx-obs")
+    after = graph.metrics.snapshot()["counters"]["tx.commits"]
+    assert after > before
+    # the mirror attaches before the typesystem bootstrap: the registry
+    # counter and the legacy attribute agree EXACTLY, from atom zero
+    assert graph.txman.committed == after
+
+
+def test_query_trace_exported_when_compile_raises(graph, global_tracing):
+    from hypergraphdb_tpu.core.errors import QueryError
+    from hypergraphdb_tpu.query.compiler import compile_query
+
+    with pytest.raises(QueryError):
+        compile_query(graph, "not a condition at all")
+    # pre-trace validation (no trace started) — now force a mid-compile
+    # failure so the trace exists and must still export
+    from hypergraphdb_tpu.query import dsl
+    import hypergraphdb_tpu.query.compiler as qc
+
+    orig = qc.translate
+
+    def boom(*a, **k):
+        raise QueryError("translate fell over")
+
+    qc.translate = boom
+    try:
+        with pytest.raises(QueryError, match="translate fell over"):
+            compile_query(graph, dsl.value("x"))
+    finally:
+        qc.translate = orig
+    traces = [t for t in global_tracing.drain() if t.name == "query"]
+    (tr,) = traces
+    assert tr.find("error").attrs == {"error": "QueryError"}
+    assert tr.finished
+
+
+def test_device_timing_span_on_real_executor(graph):
+    """Opt-in device attribution: a real DeviceExecutor batch carries a
+    ``device`` span whose window sits between launch and collect."""
+    import time
+
+    for i in range(8):
+        graph.add(f"d{i}")
+    a, b = graph.add("da"), graph.add("db")
+    graph.add_link([a, b], value="de")
+    tracer = Tracer(clock=time.perf_counter)
+    tracer.enable()
+    cfg = ServeConfig(buckets=(4,), manual=True, max_linger_s=0.0,
+                      tracer=tracer, device_timing=True, top_r=16)
+    rt = ServeRuntime(graph, cfg)
+    fut = rt.submit_bfs(int(a), max_hops=1)
+    rt.step(drain=True)
+    res = fut.result(timeout=30)
+    assert res.served_by == "device"
+    rt.close(drain=True)
+    (tr,) = [t for t in tracer.drain() if t.name == "serve.request"]
+    by = {s.name: s for s in tr.spans()}
+    assert "device" in by, [s.name for s in tr.spans()]
+    dev, launch, collect = by["device"], by["launch"], by["collect"]
+    assert dev.duration >= 0.0
+    assert launch.t0 <= dev.t0          # dispatched after launch began
+    assert dev.t1 <= collect.t1         # ready before collect finished
+
+
+def test_queue_depth_gauge_live_without_snapshot():
+    """A direct registry scrape must see the real queue depth — the gauge
+    is pushed on every admission mutation, not set as a snapshot() side
+    effect."""
+    rt, ex, clock = make_runtime(linger=1e9)
+    gauge = rt.stats.registry.get("serve.queue_depth")
+    rt.submit_bfs(1)
+    rt.submit_bfs(2)
+    assert gauge.value == 2.0
+    rt.step(drain=True)
+    assert gauge.value == 0.0
+    import hypergraphdb_tpu.obs as obs
+
+    assert "serve_queue_depth 2.0" not in obs.prometheus_text(
+        rt.stats.registry
+    )
+    rt.close(drain=True)
+
+
+def test_stats_snapshot_namespaced_through_runtime():
+    rt, ex, clock = make_runtime(linger=0.0)
+    fut = rt.submit_bfs(1)
+    rt.step(drain=True)
+    fut.result(timeout=0)
+    legacy = rt.stats_snapshot()
+    ns = rt.stats.snapshot_namespaced(queue_depth=legacy["queue_depth"])
+    assert ns["serve.submitted"] == legacy["submitted"] == 1
+    assert ns["serve.completed"] == legacy["completed"] == 1
+    # the dotted key carries SECONDS (the unit its histogram commits to)
+    assert ns["serve.latency_seconds"]["p50"] == pytest.approx(
+        legacy["latency_ms"]["p50"] / 1e3
+    )
