@@ -1,0 +1,82 @@
+"""hgfault — deterministic fault injection and the self-healing vocabulary.
+
+The reference HyperGraphDB survives faults at every layer: transactional
+MVCC storage, BDB checkpoint/replay, and P2P activities with explicit
+failure FSM states. This package is the rebuild's equivalent spine, in
+three parts:
+
+- **errors** (:mod:`~hypergraphdb_tpu.fault.errors`): the typed fault
+  vocabulary — :class:`TransientFault` (retry may help),
+  :class:`PermanentFault` (it will not), :class:`InjectedCrash` (a
+  simulated kill, deliberately a ``BaseException``), and the
+  :func:`is_transient` classifier every retry ladder keys off;
+- **registry** (:mod:`~hypergraphdb_tpu.fault.registry`): seeded,
+  deterministic fault injection at named points
+  (``serve.launch`` / ``serve.collect`` / ``peer.transport.send`` /
+  ``ckpt.save_npz`` / ``ckpt.save_plans`` / ``tx.commit.pre`` /
+  ``tx.commit.apply``) with per-point probability/count/index schedules.
+  Zero-cost when disabled: one attribute read per site, nothing
+  allocated — the ``Tracer.enabled`` discipline, regression-tested by an
+  event-order differential with a poisoned ``check``;
+- **breaker** (:mod:`~hypergraphdb_tpu.fault.breaker`): a per-key
+  circuit breaker (closed → open → half-open probe → closed) the serving
+  runtime uses to trip flaky device buckets onto the exact host-fallback
+  path and recover automatically.
+
+Wired consumers: ``serve/runtime.py`` (bounded deadline-aware retries +
+breaker degradation), ``peer/`` (send retry, redelivery, resumable
+snapshot transfer), ``ops/checkpoint.py`` (crash-atomic saves),
+``tx/manager.py`` (the ingest crash drill). The chaos gate is
+``tools/chaos.sh``; see README "Fault tolerance & degraded modes".
+"""
+
+from hypergraphdb_tpu.fault.breaker import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    STATE_CODES,
+    CircuitBreaker,
+)
+from hypergraphdb_tpu.fault.errors import (
+    DEFAULT_TRANSIENT,
+    FaultError,
+    InjectedCrash,
+    PermanentFault,
+    TransientFault,
+    is_transient,
+)
+from hypergraphdb_tpu.fault.registry import FaultRegistry, global_faults
+
+#: every fault point wired into the tree (name → where it fires) — the
+#: README table and the crash-drill parameterization read this
+WIRED_POINTS = {
+    "serve.launch": "DeviceExecutor.launch, before any device work",
+    "serve.collect": "DeviceExecutor.collect, before the result download",
+    "peer.transport.send": "transport send (loopback + TCP): a fired "
+                           "fault IS a dropped wire message",
+    "ckpt.save_npz": "save_snapshot, after the tmp npz is written, "
+                     "before os.replace publishes it",
+    "ckpt.save_plans": "save_snapshot, after the tmp plans sidecar is "
+                       "written, before os.replace publishes it",
+    "tx.commit.pre": "HGTransactionManager.commit, top-level write "
+                     "commit, before the commit lock",
+    "tx.commit.apply": "HGTransactionManager.commit, inside the commit "
+                       "lock, after conflict checks, before apply",
+}
+
+__all__ = [
+    "CLOSED",
+    "CircuitBreaker",
+    "DEFAULT_TRANSIENT",
+    "FaultError",
+    "FaultRegistry",
+    "HALF_OPEN",
+    "InjectedCrash",
+    "OPEN",
+    "PermanentFault",
+    "STATE_CODES",
+    "TransientFault",
+    "WIRED_POINTS",
+    "global_faults",
+    "is_transient",
+]

@@ -19,12 +19,20 @@ from __future__ import annotations
 
 import base64
 import json
+import logging
 import os
 from typing import Optional, Sequence
 
 import numpy as np
 
+from hypergraphdb_tpu.fault import global_faults
 from hypergraphdb_tpu.ops.snapshot import CSRSnapshot
+
+#: process fault registry, bound once (singleton contract — the enabled
+#: gate is ONE attribute read per crash point)
+_FAULTS = global_faults()
+
+_log = logging.getLogger("hypergraphdb_tpu.ops.checkpoint")
 
 
 # ------------------------------------------------------------- device snapshot
@@ -39,12 +47,48 @@ def _plans_path(path: str) -> str:
     return _npz_path(path)[:-4] + ".plans.npz"
 
 
+def _atomic_write(path: str, writer, crash_point: str) -> None:
+    """Crash-atomic publish: write a same-directory tmp, fsync, then
+    ``os.replace`` — a death at ANY instant (including the registered
+    ``crash_point`` between write and publish, which the recovery drill
+    arms with :class:`~hypergraphdb_tpu.fault.InjectedCrash`) leaves
+    either the old complete file or the new complete file on disk, never
+    a torn one. An ordinary write failure cleans the tmp up; a simulated
+    crash (``BaseException``) leaves it behind exactly like a real kill
+    would — loaders never look at ``*.tmp``, and the next save overwrites
+    it."""
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "wb") as f:
+            writer(f)
+            f.flush()
+            os.fsync(f.fileno())
+        if _FAULTS.enabled:
+            _FAULTS.check(crash_point, path=path)
+        os.replace(tmp, path)
+    except Exception:
+        # ordinary failure: clean up. A simulated kill (InjectedCrash is
+        # a BaseException) skips this on purpose — a real crash leaves
+        # its tmp behind too, and loaders never read *.tmp
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
 def save_snapshot(snap: CSRSnapshot, path: str,
                   with_plans: bool = False) -> None:
     """Persist the CSR arrays; ``with_plans=True`` additionally writes the
     pull-BFS plan pyramid next to the npz (``<path>.plans.npz``), so a
     reopened session skips the plan rebuild (the reference never rebuilds
-    its indexes on open either — ``HGStore.java:282``)."""
+    its indexes on open either — ``HGStore.java:282``).
+
+    Both files publish crash-atomically (tmp + ``os.replace``): a save
+    that dies mid-write leaves the PREVIOUS checkpoint fully loadable.
+    The npz replaces first, the sidecar second — a crash between the two
+    leaves a fingerprint-mismatched sidecar, which the loader treats as
+    absent (quiet plan rebuild), so every interleaving is safe."""
     by_type_keys = np.asarray(sorted(snap.by_type), dtype=np.int64)
     arrays = {
         "version": np.asarray([snap.version], dtype=np.int64),
@@ -66,37 +110,63 @@ def save_snapshot(snap: CSRSnapshot, path: str,
     }
     for k in by_type_keys.tolist():
         arrays[f"bt_{k}"] = snap.by_type[int(k)]
-    np.savez_compressed(_npz_path(path), **arrays)
+    _atomic_write(
+        _npz_path(path),
+        lambda f: np.savez_compressed(f, **arrays),
+        "ckpt.save_npz",
+    )
     pp = _plans_path(path)
     if with_plans:
         from hypergraphdb_tpu.ops.ellbfs import (
             plans_for, save_plans, snapshot_fingerprint)
 
-        save_plans(plans_for(snap), pp,
-                   fingerprint=snapshot_fingerprint(snap))
+        plans = plans_for(snap)
+        fp = snapshot_fingerprint(snap)
+        _atomic_write(
+            pp,
+            lambda f: save_plans(plans, f, fingerprint=fp),
+            "ckpt.save_plans",
+        )
     elif os.path.exists(pp):
         # overwriting a snapshot without plans must not leave a stale
-        # sidecar behind for the loader to pick up
+        # sidecar behind for the loader to pick up (a crash between the
+        # npz replace and this remove leaves a fingerprint-mismatched
+        # sidecar — treated as absent on load)
         os.remove(pp)
 
 
 def load_snapshot(path: str) -> CSRSnapshot:
     """Restore a snapshot; a sibling ``.plans.npz`` (see
-    :func:`save_snapshot`) is attached so ``plans_for`` is a no-op."""
+    :func:`save_snapshot`) is attached so ``plans_for`` is a no-op.
+
+    Sidecar triage: a STALE sidecar (well-formed, wrong fingerprint or
+    plan format — the overwrite-without-plans / crash-between-replaces
+    shapes) rebuilds quietly by design; a CORRUPT/unreadable sidecar is a
+    real fault — logged, counted (``fault.sidecar_corrupt``), and then
+    rebuilt the same way (plans are derived data; the snapshot itself is
+    intact)."""
     with np.load(_npz_path(path)) as z:
         snap = _snapshot_from_npz(z)
     pp = _plans_path(path)
     if os.path.exists(pp):
         from hypergraphdb_tpu.ops.ellbfs import (
-            load_plans, snapshot_fingerprint)
+            StalePlans, load_plans, snapshot_fingerprint)
 
         try:
             plans = load_plans(
                 pp, expect_fingerprint=snapshot_fingerprint(snap)
             )
             object.__setattr__(snap, "_pull_plans", plans)
+        except StalePlans:
+            pass  # another snapshot's plans (by design) → plans_for rebuilds
         except Exception:
-            pass  # stale/mismatched sidecar → plans_for rebuilds
+            from hypergraphdb_tpu.utils.metrics import global_metrics
+
+            _log.warning(
+                "checkpoint sidecar %s is corrupt/unreadable; plans will "
+                "be rebuilt", pp, exc_info=True,
+            )
+            global_metrics.incr("fault.sidecar_corrupt")
     return snap
 
 

@@ -421,13 +421,21 @@ def build_pull_plans(
 PLAN_FORMAT = 1
 
 
-def save_plans(plans: PullBFSPlans, path: str,
-               fingerprint: str = "") -> None:
+class StalePlans(ValueError):
+    """The sidecar is WELL-FORMED but belongs to a different snapshot or
+    plan format — the quiet-rebuild case loaders treat as "no sidecar",
+    deliberately distinct from a corrupt/unreadable file (which
+    ``load_snapshot`` logs and counts as ``fault.sidecar_corrupt``)."""
+
+
+def save_plans(plans: PullBFSPlans, path, fingerprint: str = "") -> None:
     """Persist a plan pyramid as an .npz (uncompressed — load speed is the
     point: rebuilding at 10M scale costs ~15 s of host cumsums, loading
-    costs one sequential read). ``fingerprint`` (see
-    :func:`snapshot_fingerprint`) travels with the file so loaders can
-    reject a sidecar that no longer matches its snapshot."""
+    costs one sequential read). ``path`` may be an open binary file
+    object (the crash-atomic checkpoint writer hands in its tmp file).
+    ``fingerprint`` (see :func:`snapshot_fingerprint`) travels with the
+    file so loaders can reject a sidecar that no longer matches its
+    snapshot."""
     arrs: dict = {
         "fingerprint": np.frombuffer(
             fingerprint.encode("ascii"), dtype=np.uint8
@@ -454,7 +462,7 @@ def load_plans(path: str,
                expect_fingerprint: Optional[str] = None) -> PullBFSPlans:
     with np.load(path) as z:
         if int(z["format"]) != PLAN_FORMAT:
-            raise ValueError(
+            raise StalePlans(
                 f"plan file {path}: format {int(z['format'])} != "
                 f"{PLAN_FORMAT}"
             )
@@ -462,7 +470,7 @@ def load_plans(path: str,
             got = bytes(z["fingerprint"]).decode("ascii") \
                 if "fingerprint" in z else ""
             if got != expect_fingerprint:
-                raise ValueError(
+                raise StalePlans(
                     f"plan file {path}: fingerprint {got!r} does not match "
                     f"the snapshot ({expect_fingerprint!r}) — stale sidecar"
                 )

@@ -129,9 +129,15 @@ class ActivityManager:
     aging rule), drained by a small worker pool.
     """
 
-    def __init__(self, peer, workers: int = 2, age_weight: float = 0.001):
+    def __init__(self, peer, workers: int = 2, age_weight: float = 0.001,
+                 tick_interval: float = 0.25):
         self.peer = peer
         self.age_weight = age_weight
+        #: watchdog cadence: live activities exposing a ``tick(now)``
+        #: method (e.g. TransferGraphClient's stall-resume) get called
+        #: every interval — the timer infrastructure the message-driven
+        #: FSMs otherwise lack; 0 disables the ticker
+        self.tick_interval = tick_interval
         self._activities: dict[tuple[str, str], Activity] = {}
         self._factories: dict[str, Callable[..., Activity]] = {}
         self._queues: dict[tuple[str, str], list] = {}
@@ -143,6 +149,10 @@ class ActivityManager:
                              daemon=True)
             for i in range(workers)
         ]
+        self._stop_evt = threading.Event()
+        self._ticker = threading.Thread(
+            target=self._tick_loop, name="activity-ticker", daemon=True
+        )
         self._seq = 0
 
     # -- registry -------------------------------------------------------------
@@ -158,13 +168,46 @@ class ActivityManager:
         self._running = True
         for w in self._workers:
             w.start()
+        if self.tick_interval:
+            self._ticker.start()
 
     def stop(self) -> None:
         self._running = False
+        self._stop_evt.set()
         with self._cv:
             self._cv.notify_all()
         for w in self._workers:
             w.join(timeout=5)
+        if self._ticker.is_alive():
+            self._ticker.join(timeout=5)
+
+    def _tick_loop(self) -> None:
+        import logging
+
+        while not self._stop_evt.wait(self.tick_interval):
+            with self._cv:
+                acts = list(self._activities.values())
+            now = time.monotonic()
+            for act in acts:
+                tick = getattr(act, "tick", None)
+                if tick is not None:
+                    try:
+                        tick(now)
+                    except Exception:  # a bug must not kill the timer
+                        logging.getLogger(
+                            "hypergraphdb_tpu.peer"
+                        ).exception("activity tick failed")
+                if act.state in TERMINAL:
+                    # reap activities that reached a terminal state
+                    # OUTSIDE a handle() transition (e.g. a watchdog
+                    # fail(), or completion inside initiate()): _work
+                    # only cleans up after messages, so these would
+                    # otherwise sit in the registry forever
+                    with self._cv:
+                        key = (act.TYPE, act.id)
+                        if self._activities.get(key) is act:
+                            self._activities.pop(key, None)
+                            self._queues.pop(key, None)
 
     # -- activity lifecycle ----------------------------------------------------
     def initiate(self, activity: Activity) -> Activity:

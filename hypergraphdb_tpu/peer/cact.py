@@ -297,27 +297,76 @@ class TransferGraphClient(Activity):
     On completion the replication clock for the server jumps to the
     server's op-log head AT SNAPSHOT TIME, so a follow-up catch-up replays
     only what committed during/after the transfer — the convergence story
-    for a peer whose incremental catch-up fell past the log floor."""
+    for a peer whose incremental catch-up fell past the log floor.
+
+    Self-healing (hgfault): pages are POSITION-addressed — every pull
+    carries the client's next wanted position and every chunk echoes the
+    position it starts at, so a dropped or duplicated chunk is detected
+    and idempotently re-requested instead of corrupting the stream. The
+    :meth:`tick` watchdog (driven by the ActivityManager's ticker) resumes
+    a stalled transfer: re-pull the current page, or re-open the whole
+    conversation when the opening exchange itself was eaten."""
 
     TYPE = "cact-transfer"
 
     def __init__(self, peer, target: Optional[str] = None, page: int = 256,
-                 activity_id: Optional[str] = None):
+                 activity_id: Optional[str] = None,
+                 retry_after_s: float = 1.0, max_resumes: int = 8):
         super().__init__(peer, activity_id)
         self.target = target
         self.page = page
         self.stored = 0
         self.log_head: Optional[int] = None
+        self.expected = 0            # next page START we will apply
+        self._snap: Optional[str] = None  # the server snapshot token
+        self.retry_after_s = float(retry_after_s)
+        self.max_resumes = int(max_resumes)
+        self._resumes = 0
+        self._last_rx = 0.0
 
     def initiate(self) -> None:
-        self.send(self.target, M.QUERY_REF, {"page": self.page})
+        import time as _time
+
+        self._last_rx = _time.monotonic()
+        self.send(self.target, M.QUERY_REF,
+                  {"page": self.page, "pos": 0})
 
     @from_state(STARTED, M.INFORM)
     def on_chunk(self, sender: str, msg: dict) -> None:
+        import time as _time
+
+        self._last_rx = _time.monotonic()
         c = msg["content"]
+        tok = c.get("snap")
+        if self._snap is not None and tok != self._snap:
+            # the server re-snapshotted (fresh activity after a lost eof
+            # or a restart): positions from the old snapshot are NOT
+            # comparable — removals shift every later index, so resuming
+            # mid-stream could silently skip atoms. Restart from 0: the
+            # gid-keyed write-through makes the re-apply idempotent, and
+            # the new snapshot's log_head re-anchors catch-up.
+            self._snap = tok
+            self.log_head = int(c.get("log_head", 0))
+            self.expected = 0
+            if int(c.get("pos", -1)) != 0:
+                self.reply(sender, msg, M.CONFIRM, {"pos": 0})
+                return
+        elif self._snap is None:
+            self._snap = tok
         if self.log_head is None:
             self.log_head = int(c.get("log_head", 0))
+        pos = int(c.get("pos", self.expected))
+        if pos != self.expected:
+            # duplicated/stale chunk (a redelivered page we already
+            # applied, or one past a gap): applying would double-store or
+            # skip — idempotently re-request OUR position instead
+            self.reply(sender, msg, M.CONFIRM, {"pos": self.expected})
+            return
         self.stored += len(transfer.store_closure(self.peer.graph, c["atoms"]))
+        self.expected = int(c.get("next", self.expected))
+        self._resumes = 0  # progress: the resume budget is PER STALL —
+        # a long transfer over a mildly lossy link must not exhaust a
+        # cumulative budget while every individual resume succeeds
         if c["eof"]:
             rep = getattr(self.peer, "replication", None)
             if rep is not None and self.log_head:
@@ -328,17 +377,56 @@ class TransferGraphClient(Activity):
                 rep.needs_full_sync.discard(sender)
             self.complete(self.stored)
         else:
-            self.reply(sender, msg, M.CONFIRM)
+            self.reply(sender, msg, M.CONFIRM, {"pos": self.expected})
 
     @from_state(STARTED, M.FAILURE)
     def on_failure(self, sender: str, msg: dict) -> None:
         self.fail(RuntimeError(str(msg["content"])))
 
+    def tick(self, now: Optional[float] = None) -> bool:
+        """Stall watchdog (ActivityManager ticker / tests call directly):
+        when no chunk has arrived for ``retry_after_s``, re-request the
+        current position — bounded by ``max_resumes`` consecutive
+        no-progress resumes (the counter resets on every applied chunk),
+        after which the transfer fails typed (``TransientFault``) instead
+        of hanging the caller's future forever. Returns whether a resume
+        was sent."""
+        import time as _time
+
+        from hypergraphdb_tpu.fault import TransientFault
+
+        with self._handle_lock:
+            if self.state != STARTED:
+                return False
+            if now is None:
+                now = _time.monotonic()
+            if now - self._last_rx < self.retry_after_s:
+                return False
+            self._resumes += 1
+            if self._resumes > self.max_resumes:
+                self.fail(TransientFault(
+                    f"graph transfer from {self.target} stalled after "
+                    f"{self.max_resumes} resume attempts"
+                ))
+                return False
+            self._last_rx = now
+            self.peer.graph.metrics.incr("peer.transfer_resumes")
+            if self.log_head is None and self.expected == 0:
+                # nothing ever arrived: the opening exchange itself was
+                # eaten — re-open (the server side re-opens idempotently)
+                self.send(self.target, M.QUERY_REF,
+                          {"page": self.page, "pos": 0})
+            else:
+                self.send(self.target, M.CONFIRM, {"pos": self.expected})
+            return True
+
 
 class TransferGraphServer(Activity):
     """Server side: snapshots the atom id list ONCE (ascending handle order
     IS dependencies-first — links are created after their targets), then
-    streams serialized pages on CONFIRM pulls."""
+    streams serialized pages on position-addressed CONFIRM pulls (a pull
+    may rewind ``pos`` — that is exactly what a client resuming past a
+    dropped chunk does)."""
 
     TYPE = "cact-transfer"
 
@@ -348,32 +436,74 @@ class TransferGraphServer(Activity):
         self.pos = 0
         self.page = 256
         self.log_head = 0
+        self.snap_token: Optional[str] = None
+
+    def _snapshot(self) -> None:
+        import uuid
+
+        rep = getattr(self.peer, "replication", None)
+        # head BEFORE the snapshot: anything later re-ships via catch-up
+        self.log_head = rep.log.head if rep is not None else 0
+        self.handles = sorted(int(h) for h in self.peer.graph.atoms())
+        # snapshot identity: positions are only comparable WITHIN one
+        # handle-list snapshot — a re-snapshot (fresh server after a lost
+        # eof / restart) may have shifted positions past removals, so
+        # chunks carry the token and the client restarts on a change
+        self.snap_token = uuid.uuid4().hex
 
     @from_state(STARTED, M.QUERY_REF)
     def on_open(self, sender: str, msg: dict) -> None:
+        c = msg["content"] or {}
         try:
-            self.page = max(1, int((msg["content"] or {}).get("page", 256)))
-            rep = getattr(self.peer, "replication", None)
-            # head BEFORE the snapshot: anything later re-ships via catch-up
-            self.log_head = rep.log.head if rep is not None else 0
-            self.handles = sorted(int(h) for h in self.peer.graph.atoms())
+            self.page = max(1, int(c.get("page", 256)))
+            self._snapshot()
         except Exception as e:
             self.reply(sender, msg, M.FAILURE, f"{type(e).__name__}: {e}")
             self.fail(e)
             return
         self.state = "Streaming"
-        self._send_page(sender, msg)
+        self._send_page(sender, msg, pos=int(c.get("pos", 0)))
+
+    @from_state("Streaming", M.QUERY_REF)
+    def on_reopen(self, sender: str, msg: dict) -> None:
+        # the client's opening chunk(s) were lost and it re-opened: serve
+        # from its requested position over the SAME snapshot (idempotent)
+        c = msg["content"] or {}
+        self._send_page(sender, msg, pos=int(c.get("pos", 0)))
+
+    @from_state(STARTED, M.CONFIRM)
+    def on_resume_fresh(self, sender: str, msg: dict) -> None:
+        """A pull for a conversation this side no longer holds (the
+        server completed on an eof chunk the client never saw, or
+        restarted mid-transfer): re-snapshot and serve from the requested
+        position. The fresh ``snap`` token on every chunk tells the
+        client positions changed meaning — it restarts from 0
+        (idempotent) rather than trusting indices a removal may have
+        shifted."""
+        c = msg["content"] or {}
+        try:
+            self._snapshot()
+        except Exception as e:
+            self.reply(sender, msg, M.FAILURE, f"{type(e).__name__}: {e}")
+            self.fail(e)
+            return
+        self.state = "Streaming"
+        self._send_page(sender, msg, pos=int(c.get("pos", 0)))
 
     @from_state("Streaming", M.CONFIRM)
     def on_pull(self, sender: str, msg: dict) -> None:
-        self._send_page(sender, msg)
+        self._send_page(sender, msg,
+                        pos=(msg["content"] or {}).get("pos"))
 
     @from_state("Streaming", M.CANCEL)
     def on_cancel(self, sender: str, msg: dict) -> None:
         self.complete(None)
 
-    def _send_page(self, sender: str, msg: dict) -> None:
+    def _send_page(self, sender: str, msg: dict, pos=None) -> None:
         g = self.peer.graph
+        if pos is not None:
+            self.pos = max(0, min(int(pos), len(self.handles)))
+        start = self.pos
         atoms = []
         while self.pos < len(self.handles) and len(atoms) < self.page:
             h = self.handles[self.pos]
@@ -385,8 +515,10 @@ class TransferGraphServer(Activity):
             except KeyError:
                 continue
         eof = self.pos >= len(self.handles)
+        g.metrics.incr("peer.transfer_chunks")
         self.reply(sender, msg, M.INFORM, {
             "atoms": atoms, "eof": eof, "log_head": self.log_head,
+            "pos": start, "next": self.pos, "snap": self.snap_token,
         })
         if eof:
             self.complete(self.pos)

@@ -79,6 +79,10 @@ class HyperGraphPeer:
         if self._started:
             return
         self.interface.peer_id = self.identity
+        if getattr(self.interface, "metrics", None) is None:
+            # peer.* observability rides the graph's metrics registry —
+            # one Prometheus scrape covers graph + tx + peer planes
+            self.interface.metrics = self.graph.metrics
         self.interface.on_message(self._dispatch)
         self.interface.start()
         self.activities.start()
@@ -239,14 +243,21 @@ class HyperGraphPeer:
         )["installed"]
 
     def transfer_graph_from(self, target: str, page: int = 256,
-                            timeout: float = 60.0) -> int:
+                            timeout: float = 60.0,
+                            retry_after_s: float = 1.0,
+                            max_resumes: int = 8) -> int:
         """Pull the ENTIRE remote graph (TransferGraph bootstrap): pages of
         dependency-ordered atoms; on completion the replication clock for
         ``target`` advances to the server's log head at snapshot time, so a
         follow-up ``replication.catch_up(target)`` converges the tail.
+        Self-healing: a chunk lost on the wire is re-requested after
+        ``retry_after_s`` of silence (the activity ticker drives the
+        watchdog), up to ``max_resumes`` times before failing typed.
         Returns how many atoms were stored."""
         act = self.activities.initiate(
-            cact.TransferGraphClient(self, target=target, page=page)
+            cact.TransferGraphClient(self, target=target, page=page,
+                                     retry_after_s=retry_after_s,
+                                     max_resumes=max_resumes)
         )
         return act.future.result(timeout=timeout)
 

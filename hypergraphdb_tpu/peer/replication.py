@@ -17,6 +17,8 @@ stance (SURVEY §7 hard part 5)."""
 from __future__ import annotations
 
 import threading
+import time
+from collections import deque
 from typing import Any, Optional
 
 from hypergraphdb_tpu.core import events as ev
@@ -297,8 +299,6 @@ class Replication:
         # a single worker thread (order-preserving, so log sequence numbers
         # follow commit order). The reference pushes via activities off the
         # event thread for the same reason (RememberTaskClient.java:54).
-        from collections import deque
-
         # lock-free enqueue: deque.append is atomic under the GIL, so the
         # mutation path pays ONE C-level call — no lock, no notify (the
         # worker polls on short timeouts; flush() wakes it explicitly)
@@ -336,6 +336,44 @@ class Replication:
         #: the deferred backlog
         self.debounce_s = 0.05
         self.max_backlog = 20_000
+        # -- self-healing send plane (hgfault): pushes get bounded retry
+        # with capped backoff ON THE WORKER THREAD (never the mutation
+        # path), then land in a PER-PEER ORDERED redelivery queue. Order
+        # is the invariant: once a peer has queued redeliveries (or is
+        # down-marked), every later push to it queues BEHIND them and the
+        # retry pass drains in order, stopping at the first failure — a
+        # redelivered remove can never land after a newer re-add.
+        # Receivers apply idempotently (store_closure is a write-through
+        # upsert keyed by gid) and the SeenMap records only applied
+        # progress, so a duplicated push is a no-op. Honest limit: a
+        # message dropped past max_redeliveries is a real gap — the
+        # receiver's max-applied ack may already have advanced past it,
+        # so incremental catch-up alone does not refetch it (pre-existing
+        # semantics for any lost push); full convergence for such a peer
+        # is the TransferGraph bootstrap, and gap-aware acks are a seeded
+        # ROADMAP follow-up.
+        self.send_attempts = 3
+        self.send_backoff_s = 0.02
+        self.send_backoff_max_s = 0.25
+        self.max_redeliveries = 4
+        #: spacing between redelivery passes when the drain queue is
+        #: otherwise idle: back-to-back passes would burn the whole
+        #: ladder in a fraction of a second, covering no realistic
+        #: outage (flush() skips the spacing — "settle now" semantics)
+        self.redelivery_interval_s = 0.25
+        self.max_redelivery_backlog = 10_000
+        #: pid -> deque[(message, attempt)] — worker-thread-owned;
+        #: emptied entries are popped so dict truthiness == "work queued"
+        self._redelivery: dict[str, Any] = {}
+        self._redelivery_n = 0
+        #: peers whose LAST ladder exhausted → fresh pushes skip straight
+        #: to the redelivery queue until the grace expires, so one dead
+        #: peer's backoff sleeps cannot head-of-line-block the worker's
+        #: pushes to healthy peers (the redelivery pass probes ONE head
+        #: message per down peer per pass and clears the mark on success)
+        self.down_peer_grace_s = 0.5
+        self._down_until: dict[str, float] = {}
+        self._sleep = time.sleep  # injectable (tests)
 
     # -- wiring ---------------------------------------------------------------
     def attach(self) -> None:
@@ -379,16 +417,17 @@ class Replication:
             self._apply_worker = None
 
     def flush(self, timeout: float = 10.0) -> bool:
-        """Block until every enqueued mutation has been logged and pushed,
-        AND every received push/catch-up batch has been applied (both
-        worker pipelines drained)."""
+        """Block until every enqueued mutation has been logged and pushed
+        (including the redelivery queue settling — delivered or dropped
+        after ``max_redeliveries``), AND every received push/catch-up
+        batch has been applied (both worker pipelines drained)."""
         import time as _time
 
         deadline = _time.monotonic() + timeout
         with self._cv:
             self._flush_asap = True
             self._cv.notify_all()
-            while self._pending or self._draining:
+            while self._pending or self._draining or self._redelivery:
                 remaining = deadline - _time.monotonic()
                 if remaining <= 0:
                     return False
@@ -427,27 +466,40 @@ class Replication:
     def _drain(self) -> None:
         while True:
             with self._cv:
-                while not self._pending and not self._stopping:
+                while (not self._pending and not self._redelivery
+                       and not self._stopping):
                     self._flush_asap = False
                     self._cv.wait(0.1)
                 if not self._pending and self._stopping:
+                    # redelivery is best-effort on shutdown: catch-up is
+                    # the documented convergence path for whatever is left
                     return
-                # debounce: while the writer is hot (queue still growing)
-                # hold off, unless stopping/flushing or backlog-capped
-                last = len(self._pending)
-                while (not self._stopping and not self._flush_asap
-                       and last < self.max_backlog):
-                    self._cv.wait(self.debounce_s)
-                    now = len(self._pending)
-                    if now == last:
-                        break  # quiet gap: the writer paused
-                    last = now
+                if (not self._pending and self._redelivery
+                        and not self._stopping and not self._flush_asap):
+                    # redelivery-only cycle: space the passes out so the
+                    # bounded ladder spans a real outage window instead
+                    # of burning out back-to-back (a submit/flush/stop
+                    # notification still wakes us early)
+                    self._cv.wait(self.redelivery_interval_s)
                 batch = []
-                while self._pending:
-                    batch.append(self._pending.popleft())
+                if self._pending:
+                    # debounce: while the writer is hot (queue growing)
+                    # hold off, unless stopping/flushing or backlog-capped
+                    last = len(self._pending)
+                    while (not self._stopping and not self._flush_asap
+                           and last < self.max_backlog):
+                        self._cv.wait(self.debounce_s)
+                        now = len(self._pending)
+                        if now == last:
+                            break  # quiet gap: the writer paused
+                        last = now
+                    while self._pending:
+                        batch.append(self._pending.popleft())
                 self._draining += len(batch)
             try:
-                log_batch, pushes = self._prepare_batch(batch)
+                log_batch, pushes = (
+                    self._prepare_batch(batch) if batch else ([], [])
+                )
             except Exception:
                 import logging
 
@@ -467,6 +519,23 @@ class Replication:
 
                 logging.getLogger("hypergraphdb_tpu.peer").warning(
                     "replication batch persist/push failed", exc_info=True
+                )
+            try:
+                if self._redelivery:
+                    # busy-marked so flush() cannot observe "all queues
+                    # empty" while a popped message is still in flight
+                    with self._cv:
+                        self._draining += 1
+                    try:
+                        self._retry_redeliveries()
+                    finally:
+                        with self._cv:
+                            self._draining -= 1
+            except Exception:
+                import logging
+
+                logging.getLogger("hypergraphdb_tpu.peer").warning(
+                    "replication redelivery pass failed", exc_info=True
                 )
             finally:
                 with self._cv:
@@ -600,11 +669,94 @@ class Replication:
             return False
 
     def _push(self, pid: str, kind: str, entry: dict) -> None:
-        self.peer.interface.send(pid, M.make_message(
+        msg = M.make_message(
             M.INFORM, self.ACTIVITY_TYPE,
             {"what": "push", "kind": kind, "entry": entry,
              "seq": self.log.head},
-        ))
+        )
+        if (self._redelivery.get(pid)
+                or time.monotonic() < self._down_until.get(pid, 0.0)):
+            # ORDER: the peer already has queued redeliveries (or just
+            # exhausted a ladder) — this push must line up behind them,
+            # never overtake (and we skip paying 3 backoff sleeps per
+            # message to a down peer)
+            self._queue_redelivery(pid, msg, 1)
+            return
+        if not self._send_reliable(pid, msg):
+            self._queue_redelivery(pid, msg, 1)
+
+    def _send_reliable(self, pid: str, message: dict) -> bool:
+        """Send with bounded retry + capped backoff. Worker-thread only —
+        the mutation path never sleeps here. Returns whether the
+        transport accepted the message (delivery stays at-most-once;
+        end-to-end convergence is redelivery + catch-up's job). Tracks
+        per-peer down-marks: an exhausted ladder marks the peer down for
+        ``down_peer_grace_s`` (fresh pushes skip the ladder), any success
+        clears the mark."""
+        m = self.peer.graph.metrics
+        m.incr("peer.sends")
+        for attempt in range(self.send_attempts):
+            if attempt:
+                m.incr("peer.send_retries")
+                self._sleep(min(
+                    self.send_backoff_s * (2.0 ** (attempt - 1)),
+                    self.send_backoff_max_s,
+                ))
+            try:
+                if self.peer.interface.send(pid, message):
+                    self._down_until.pop(pid, None)
+                    return True
+            except Exception:  # transport failure == unreachable now
+                pass
+        self._down_until[pid] = time.monotonic() + self.down_peer_grace_s
+        m.incr("peer.send_failures")
+        return False
+
+    def _queue_redelivery(self, pid: str, message: dict,
+                          attempt: int) -> None:
+        if self._redelivery_n >= self.max_redelivery_backlog:
+            # a long-dead peer must not grow an unbounded queue; such a
+            # peer re-joins via the TransferGraph bootstrap anyway
+            self.peer.graph.metrics.incr("peer.redelivery_dropped")
+            return
+        q = self._redelivery.get(pid)
+        if q is None:
+            q = self._redelivery[pid] = deque()
+        q.append((message, attempt))
+        self._redelivery_n += 1
+        with self._cv:
+            self._cv.notify_all()
+
+    def _retry_redeliveries(self) -> None:
+        """One redelivery pass (worker thread, after the regular drain):
+        per peer, drain the queue IN ORDER and stop at the first failure
+        — one probe ladder per down peer per pass, so a dead peer with a
+        deep backlog costs one bounded ladder, not sleeps-per-message.
+        A head message failing past ``max_redeliveries`` drops with a
+        counter (a real gap; see the class comment for the honest
+        convergence story)."""
+        m = self.peer.graph.metrics
+        for pid in list(self._redelivery):
+            q = self._redelivery.get(pid)
+            while q:
+                msg, attempt = q[0]
+                m.incr("peer.redeliveries")
+                if self._send_reliable(pid, msg):
+                    q.popleft()
+                    self._redelivery_n -= 1
+                    continue
+                # ladder failed: leave the rest queued behind the head
+                # (per-peer order is the invariant), probe again next
+                # pass — unless the head is out of budget
+                if attempt >= self.max_redeliveries:
+                    q.popleft()
+                    self._redelivery_n -= 1
+                    m.incr("peer.redelivery_dropped")
+                else:
+                    q[0] = (msg, attempt + 1)
+                break
+            if not q:
+                self._redelivery.pop(pid, None)
 
     # -- interest publication ---------------------------------------------------
     def publish_interest(self, condition) -> None:
@@ -619,8 +771,11 @@ class Replication:
 
     # -- catch-up ---------------------------------------------------------------
     def catch_up(self, pid: str) -> None:
-        """Ask ``pid`` for its log entries after my recorded position."""
-        self.peer.interface.send(pid, M.make_message(
+        """Ask ``pid`` for its log entries after my recorded position
+        (reliable-send: a dropped request retries with backoff — losing
+        it would silently stall convergence until the next manual call)."""
+        self.peer.graph.metrics.incr("peer.catchups")
+        self._send_reliable(pid, M.make_message(
             M.REQUEST, self.ACTIVITY_TYPE,
             {"what": "catchup", "since": self.last_seen.get(pid, 0)},
         ))
@@ -666,6 +821,7 @@ class Replication:
                          "entry": self._expand_for_wire(kind, entry)}
                         for seq, kind, entry in raw
                     ]
+            self.peer.graph.metrics.incr("peer.catchup_pages")
             self.peer.interface.send(sender, M.make_message(
                 M.INFORM, self.ACTIVITY_TYPE,
                 {"what": "catchup-result", "entries": entries,
@@ -740,6 +896,7 @@ class Replication:
                             continue
                         try:
                             self._apply(sender, kind, entry)
+                            self.peer.graph.metrics.incr("peer.applies")
                         except Exception:
                             import logging
 
@@ -771,6 +928,7 @@ class Replication:
                         )
                         continue
                     try:
+                        self.peer.graph.metrics.incr("peer.acks")
                         self.peer.interface.send(sender, M.make_message(
                             M.INFORM, self.ACTIVITY_TYPE,
                             {"what": "ack", "seq": hi},

@@ -14,6 +14,16 @@ live XMPP server (SURVEY §4 calls this out as the gap to fix). Here:
 
 Messages are JSON-serializable dicts. Delivery is async and at-most-once;
 ordering is per-sender-pair (both transports preserve send order).
+
+Fault story: both transports carry the ``peer.transport.send`` fault
+point — a fired fault IS a dropped wire message (``send`` returns False,
+nothing delivered), which is how the chaos tests model lossy networks
+deterministically. The TCP transport additionally bounds every connect
+and send with ``connect_timeout`` and retries a stale connection with
+capped backoff; the layers above (replication retry/redelivery, transfer
+resume) own end-to-end healing. ``metrics`` (an optional
+``utils.metrics.Metrics``, wired to the graph's by ``HyperGraphPeer``)
+records ``peer.transport_*`` counters.
 """
 
 from __future__ import annotations
@@ -22,9 +32,16 @@ import json
 import socket
 import socketserver
 import threading
+import time
 from typing import Callable, Optional
 
+from hypergraphdb_tpu.fault import FaultError, global_faults
+
 MessageHandler = Callable[[str, dict], None]  # (sender_id, message)
+
+#: the process fault registry, bound once (module-global: the singleton
+#: contract makes the enabled gate ONE attribute read per send)
+_FAULTS = global_faults()
 
 
 class PeerInterface:
@@ -32,9 +49,30 @@ class PeerInterface:
     target peer's registered handler on a receiver thread."""
 
     peer_id: str
+    #: optional utils.metrics.Metrics surface (peer.transport_* counters);
+    #: HyperGraphPeer.start() wires the graph's in
+    metrics = None
 
     def start(self) -> None: ...
     def stop(self) -> None: ...
+
+    def _dropped_by_fault(self, target: str, message: dict) -> bool:
+        """Shared injection hook: True when the armed schedule ate this
+        message (the wire dropped it)."""
+        if not _FAULTS.enabled:
+            return False
+        try:
+            _FAULTS.check(
+                "peer.transport.send", target=target,
+                performative=message.get("performative"),
+                activity=message.get("activity_type"),
+            )
+        except FaultError:
+            m = self.metrics
+            if m is not None:
+                m.incr("peer.transport_drops")
+            return True
+        return False
 
     def send(self, target: str, message: dict) -> bool:
         """Queue a message; False if the target is unknown/unreachable."""
@@ -112,9 +150,16 @@ class LoopbackPeerInterface(PeerInterface):
             self._thread.join(timeout=5)
 
     def send(self, target: str, message: dict) -> bool:
+        if self._dropped_by_fault(target, message):
+            return False
         # serialize/deserialize to enforce the same wire constraints as TCP
         payload = json.loads(json.dumps(message))
-        return self.network._route(self.peer_id, target, payload)
+        ok = self.network._route(self.peer_id, target, payload)
+        m = self.metrics
+        if m is not None:
+            m.incr("peer.transport_sends" if ok
+                   else "peer.transport_drops")
+        return ok
 
     def peers(self) -> list[str]:
         return [p for p in self.network.peer_ids() if p != self.peer_id]
@@ -175,8 +220,18 @@ class TCPPeerInterface(PeerInterface):
     """JSON-over-TCP transport: one listening socket per peer, one
     connection per outgoing peer (kept open, reconnected on failure)."""
 
-    def __init__(self, peer_id: str, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, peer_id: str, host: str = "127.0.0.1", port: int = 0,
+                 connect_timeout: float = 5.0, send_attempts: int = 2,
+                 retry_backoff_s: float = 0.05,
+                 retry_backoff_max_s: float = 0.5):
         self.peer_id = peer_id
+        #: bounds BOTH the connect and every subsequent sendall (the
+        #: timeout sticks to the socket): a hung peer costs a bounded
+        #: wait, never a wedged sender thread
+        self.connect_timeout = float(connect_timeout)
+        self.send_attempts = max(1, int(send_attempts))
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.retry_backoff_max_s = float(retry_backoff_max_s)
         self._handler: Optional[MessageHandler] = None
         self._server = _TCPServer((host, port), _TCPHandler)
         self._server.iface = self  # type: ignore[attr-defined]
@@ -224,6 +279,8 @@ class TCPPeerInterface(PeerInterface):
             self._known[peer_id] = addr
 
     def send(self, target: str, message: dict) -> bool:
+        if self._dropped_by_fault(target, message):
+            return False
         return self._write(target, {"from": self.peer_id, "msg": message})
 
     def _write(self, target: str, envelope: dict) -> bool:
@@ -233,21 +290,37 @@ class TCPPeerInterface(PeerInterface):
         if addr is None:
             return False
         data = (json.dumps(envelope) + "\n").encode("utf-8")
+        m = self.metrics
         with send_lock:
-            for _attempt in (1, 2):  # one reconnect on stale connection
+            # reconnect-with-backoff on stale/refused connections; every
+            # attempt's connect AND send are bounded by connect_timeout
+            for attempt in range(self.send_attempts):
+                if attempt:
+                    if m is not None:
+                        m.incr("peer.transport_reconnects")
+                    time.sleep(min(
+                        self.retry_backoff_s * (2.0 ** (attempt - 1)),
+                        self.retry_backoff_max_s,
+                    ))
                 with self._lock:
                     conn = self._conns.get(target)
                 try:
                     if conn is None:
-                        conn = socket.create_connection(addr, timeout=5)
+                        conn = socket.create_connection(
+                            addr, timeout=self.connect_timeout
+                        )
                         with self._lock:
                             self._conns[target] = conn
                     conn.sendall(data)
+                    if m is not None:
+                        m.incr("peer.transport_sends")
                     return True
                 except OSError:
                     with self._lock:
                         self._conns.pop(target, None)
                     conn = None
+        if m is not None:
+            m.incr("peer.transport_drops")
         return False
 
     def peers(self) -> list[str]:

@@ -41,11 +41,17 @@ def bucket_for(n: int, buckets: Sequence[int] = BUCKETS) -> int:
 
 @dataclass
 class MicroBatch:
-    """One flushed group: the tickets plus the padded device shape."""
+    """One flushed group: the tickets plus the padded device shape.
+
+    ``force_host`` is set by the runtime when the batch key's circuit
+    breaker is OPEN (or a degraded re-route is needed): the executor then
+    serves every ticket on the exact host path and never touches the
+    device."""
 
     key: tuple
     tickets: list
     bucket: int
+    force_host: bool = False
 
     @property
     def occupancy(self) -> float:

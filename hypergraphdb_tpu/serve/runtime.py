@@ -34,14 +34,21 @@ reproducible.
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from concurrent.futures import Future
 from dataclasses import dataclass, field
-from typing import Optional, Sequence
+from typing import Callable, Optional, Sequence
 
 import numpy as np
 
+from hypergraphdb_tpu.fault import (
+    OPEN,
+    CircuitBreaker,
+    global_faults,
+    is_transient,
+)
 from hypergraphdb_tpu.obs import global_tracer
 from hypergraphdb_tpu.serve.admission import AdmissionQueue
 from hypergraphdb_tpu.serve.batcher import BUCKETS, Batcher, MicroBatch
@@ -74,6 +81,17 @@ class ServeConfig:
     latency_window: int = 4096
     tracer: Optional[object] = None         # hgobs Tracer; None → global
     device_timing: bool = False             # launch→ready deltas per batch
+    # -- self-healing (hgfault) ----------------------------------------------
+    max_retries: int = 2                    # transient launch re-attempts
+    retry_base_s: float = 0.005             # backoff seed: base * 2^(n-1)
+    retry_max_s: float = 0.25               # backoff cap
+    retry_jitter: float = 0.5               # multiplicative jitter frac
+    retry_seed: int = 0                     # deterministic jitter stream
+    breaker_threshold: int = 3              # consecutive failures → OPEN
+    breaker_cooldown_s: float = 0.25        # OPEN → HALF_OPEN probe delay
+    transient_errors: tuple = ()            # extra types to retry
+    sleep: Optional[Callable] = None        # injectable backoff sleeper
+    faults: Optional[object] = None         # fault registry; None → global
 
 
 @dataclass
@@ -113,6 +131,7 @@ class DeviceExecutor:
         self.config = config
         self.stats = stats or ServeStats()
         self.tracer = config.tracer or global_tracer()
+        self.faults = config.faults or global_faults()
         # serving implies ingest-concurrent reads: the incremental
         # (base, delta) pair IS the consistency mechanism
         self.mgr = graph.incremental or graph.enable_incremental()
@@ -122,6 +141,18 @@ class DeviceExecutor:
         import jax.numpy as jnp
 
         kind = batch.key[0]
+        if getattr(batch, "force_host", False):
+            # breaker-degraded mode: the WHOLE batch takes the exact host
+            # path under the pinned epoch — no device work, no delta sync
+            view = self.mgr.pinned_view(self.config.max_lag_edges,
+                                        sync_delta=False)
+            out = LaunchedBatch(batch=batch, view=view)
+            out.host_tickets = list(batch.tickets)
+            return out
+        if self.faults.enabled:  # the ONE gate read on the disabled path
+            # models the DEVICE dispatch failing — deliberately after the
+            # force_host branch, so breaker-degraded batches stay immune
+            self.faults.check("serve.launch", kind=kind)
         # pattern batches read base + HOST corrections only — don't pay a
         # device-delta upload on their hot path
         view = self.mgr.pinned_view(self.config.max_lag_edges,
@@ -219,6 +250,11 @@ class DeviceExecutor:
         out = []
         view = launched.view
         if launched.dev_out is not None:
+            if self.faults.enabled:
+                # models the device RESULT download failing — host-only
+                # batches (breaker-degraded / all-fallback) stay immune
+                self.faults.check("serve.collect",
+                                  kind=launched.batch.key[0])
             if launched._t_launch is not None:
                 # opt-in device attribution: block on the async handles and
                 # record the launch→ready wall delta for the batch's span
@@ -248,15 +284,33 @@ class DeviceExecutor:
                                                matches, view, drop_arr,
                                                launched.cand_records)
                 out.append((ticket, res))
-        for ticket in launched.host_tickets:
+        out.extend(self._serve_host(launched.host_tickets, view.epoch))
+        return out
+
+    def collect_host(self, launched: LaunchedBatch) -> list:
+        """Exact host re-serve of the WHOLE batch — the collect-failure
+        recovery path: the device handles are poisoned but the pinned
+        epoch is still the right consistency label, so every ticket is
+        answered by the exact host executors instead of erroring."""
+        view = launched.view
+        return self._serve_host(launched.batch.tickets,
+                                0 if view is None else view.epoch)
+
+    def _serve_host(self, tickets, epoch: int) -> list:
+        """The ONE exact host-serving loop (fallback lanes, degraded
+        batches, collect recovery): per-ticket dispatch with per-ticket
+        exception capture — one failing request surfaces, never kills
+        its batch."""
+        out = []
+        for ticket in tickets:
             self.stats.record_host_fallback()
             try:
                 if ticket.request.kind == "bfs":
                     out.append((ticket, self._host_bfs(ticket.request,
-                                                       view.epoch)))
+                                                       epoch)))
                 else:
                     out.append((ticket, self._host_pattern(ticket.request,
-                                                           view.epoch)))
+                                                           epoch)))
             except Exception as e:  # surface, don't kill the batch
                 out.append((ticket, e))
         return out
@@ -355,6 +409,19 @@ class ServeRuntime:
         self.clock: Clock = self.config.clock or time.monotonic
         self.tracer = self.config.tracer or global_tracer()
         self.stats = ServeStats(self.config.latency_window)
+        self.faults = self.config.faults or global_faults()
+        # per-batch-key breaker: a flaky device bucket trips to the exact
+        # host-fallback path and recovers via half-open probes
+        self.breaker = CircuitBreaker(
+            threshold=self.config.breaker_threshold,
+            cooldown_s=self.config.breaker_cooldown_s,
+            clock=self.clock,
+            on_state=self.stats.set_breaker_state,
+            on_trip=self.stats.record_breaker_trip,
+        )
+        self._sleep: Callable = self.config.sleep or time.sleep
+        # seeded jitter: retries are reproducible under a fixed seed
+        self._retry_rng = random.Random(self.config.retry_seed)
         self.queue = AdmissionQueue(
             self.config.max_queue, self.config.policy, self.clock,
             self.stats,
@@ -366,7 +433,10 @@ class ServeRuntime:
             else DeviceExecutor(graph, self.config, self.stats)
         )
         self.graph = graph
-        self._pending: Optional[tuple] = None  # (tickets, executor token)
+        #: in-flight batch: (tickets, executor token, batch key,
+        #: device_attempted) — what _finalize needs, incl. the breaker's
+        #: success/failure bookkeeping
+        self._pending: Optional[tuple] = None
         self._closed = False
         self._close_started = False
         self._draining = False
@@ -473,10 +543,10 @@ class ServeRuntime:
         batch = self.batcher.next_batch(self.clock(), drain=drain)
         if batch is None:
             return False
-        launched = self._launch_guarded(batch, t_form)
-        if launched is not None:
-            self.stats.record_batch(len(batch.tickets), batch.bucket)
-            self._finalize(batch.tickets, launched)
+        inflight = self._launch_guarded(batch, t_form)
+        if inflight is not None:
+            self.stats.record_batch(len(inflight[0]), batch.bucket)
+            self._finalize(*inflight)
         return True
 
     def pump(self, drain: bool = False) -> bool:
@@ -486,28 +556,36 @@ class ServeRuntime:
         was consumed."""
         t_form = self.tracer.clock() if self.tracer.enabled else None
         batch = self.batcher.next_batch(self.clock(), drain=drain)
-        launched = None
+        inflight = None
         if batch is not None:
-            launched = self._launch_guarded(batch, t_form)
-            if launched is not None:
-                self.stats.record_batch(len(batch.tickets), batch.bucket)
+            inflight = self._launch_guarded(batch, t_form)
+            if inflight is not None:
+                self.stats.record_batch(len(inflight[0]), batch.bucket)
         prev = self._take_pending()
         if prev is not None:
             self._finalize(*prev)
         with self._close_lock:
-            self._pending = (
-                None if launched is None else (batch.tickets, launched)
-            )
+            self._pending = inflight
         return batch is not None
 
     def _launch_guarded(self, batch, t_form=None):
-        """Launch, converting an executor error into per-ticket failures
-        instead of a dead dispatch thread. Traced tickets get their
-        ``queue_wait`` closed and ``batch_form``/``launch`` spans here —
-        the whole block is behind one ``tracer.enabled`` read. ``t_form``
-        is the caller's pre-``next_batch`` timestamp, so ``batch_form``
-        covers the REAL formation work (shed scan, key count, priority
-        take) instead of attributing it to ``queue_wait``."""
+        """Launch with the self-healing ladder, converting executor
+        errors into per-ticket outcomes instead of a dead dispatch
+        thread: transient failures get bounded exponential backoff +
+        seeded jitter that respects each ticket's remaining deadline
+        (a ticket whose deadline falls inside the next sleep is shed NOW,
+        never parked past it); permanent failures surface typed to every
+        caller; K consecutive device failures trip the batch key's
+        circuit breaker, and a tripped/OPEN key re-routes the batch —
+        including the one that tripped it — to the exact host-fallback
+        path. Returns ``(tickets, token, key, device_attempted)`` for
+        ``_finalize``, or None when every ticket was already completed.
+
+        Traced tickets get their ``queue_wait`` closed and
+        ``batch_form``/``launch`` spans here — the whole block is behind
+        one ``tracer.enabled`` read; the ``launch`` span covers ALL
+        attempts. ``t_form`` is the caller's pre-``next_batch``
+        timestamp, so ``batch_form`` covers the REAL formation work."""
         tracer = self.tracer
         traced = tracer.enabled
         if traced:
@@ -536,12 +614,37 @@ class ServeRuntime:
                         parent=tr.marks.get("root"), bucket=batch.bucket,
                         n_real=n_real, n_pad=batch.bucket - n_real,
                     )
-        try:
-            launched = self.executor.launch(batch)
-        except Exception as e:
-            for t in batch.tickets:
-                t.fail(e)
-            return None
+        key = batch.key
+        cfg = self.config
+        attempt = 0
+        while True:
+            device = not batch.force_host and self.breaker.allow(key)
+            batch.force_host = not device
+            try:
+                launched = self.executor.launch(batch)
+            except Exception as e:
+                if not device:
+                    # the DEGRADED path itself failed: no ladder left
+                    self._fail_batch(batch.tickets, e)
+                    return None
+                self.breaker.record_failure(key)
+                if not is_transient(e, cfg.transient_errors):
+                    self._fail_batch(batch.tickets, e)
+                    return None
+                attempt += 1
+                if self.breaker.state_of(key) == OPEN:
+                    # this failure tripped the breaker: serve THIS batch
+                    # on host immediately — degraded throughput, not a
+                    # batch of errors (and no backoff: host is local)
+                    continue
+                if attempt > cfg.max_retries:
+                    self._fail_batch(batch.tickets, e)
+                    return None
+                self.stats.record_retry()
+                if not self._backoff(batch, attempt):
+                    return None  # every ticket's deadline < next attempt
+                continue
+            break
         if traced:
             t_l1 = tracer.clock()
             for t in batch.tickets:
@@ -549,7 +652,36 @@ class ServeRuntime:
                 if tr is not None and not tr.finished:
                     tr.add_span("launch", t_l0, t_l1,
                                 parent=tr.marks.get("root"))
-        return launched
+        return batch.tickets, launched, key, device
+
+    def _backoff(self, batch, attempt: int) -> bool:
+        """Sleep the capped exponential backoff (seeded jitter) before
+        re-attempting a transient launch failure — deadline-aware:
+        tickets whose deadline falls inside the sleep are shed NOW (the
+        retry could never answer them), and with none left the batch is
+        abandoned. Returns whether anything is left to retry."""
+        cfg = self.config
+        dt = min(cfg.retry_base_s * (2.0 ** (attempt - 1)), cfg.retry_max_s)
+        dt *= 1.0 + cfg.retry_jitter * self._retry_rng.random()
+        now = self.clock()
+        wake = now + dt
+        live = []
+        for t in batch.tickets:
+            if t.expired(wake):
+                t.shed(now)
+                self.stats.record_shed()
+            else:
+                live.append(t)
+        batch.tickets = live
+        if not live:
+            return False
+        self._sleep(dt)
+        return True
+
+    def _fail_batch(self, tickets, exc: BaseException) -> None:
+        for t in tickets:
+            if t.fail(exc):
+                self.stats.record_error()
 
     def _take_pending(self):
         """Swap the in-flight (tickets, token) pair out under the state
@@ -563,16 +695,19 @@ class ServeRuntime:
         with self._close_lock:
             return self._pending is None
 
-    def _finalize(self, tickets, token) -> None:
+    def _finalize(self, tickets, token, key=None, device=False) -> None:
         tracer = self.tracer
         traced = tracer.enabled
         t_c0 = tracer.clock() if traced else 0.0
         try:
             results = self.executor.collect(token)
         except Exception as e:
-            for t in tickets:
-                t.fail(e)
-            return
+            results = self._recover_collect(tickets, token, key, device, e)
+            if results is None:
+                return
+        else:
+            if device and key is not None:
+                self.breaker.record_success(key)
         if traced:
             t_c1 = tracer.clock()
             t_dev = getattr(token, "t_device", None)
@@ -590,11 +725,33 @@ class ServeRuntime:
         now = self.clock()
         for ticket, res in results:
             if isinstance(res, BaseException):
-                ticket.fail(res)
+                if ticket.fail(res):
+                    self.stats.record_error()
             elif ticket.resolve(res):
                 # a cancel()ed future neither raises out of the dispatch
                 # thread nor counts as a completion
                 self.stats.record_complete(now - ticket.submit_t)
+
+    def _recover_collect(self, tickets, token, key, device,
+                         exc: BaseException):
+        """A collect failure poisons the whole batch's device handles;
+        the recovery is an exact host re-serve under the same pinned
+        epoch (the executor's ``collect_host`` hook), not a device retry
+        — the async results are gone either way. Feeds the breaker like
+        any other device failure. Returns replacement results, or None
+        after failing every ticket typed."""
+        if device and key is not None:
+            self.breaker.record_failure(key)
+        host = getattr(self.executor, "collect_host", None)
+        if host is not None and is_transient(exc,
+                                             self.config.transient_errors):
+            self.stats.record_retry()
+            try:
+                return host(token)
+            except Exception as e2:
+                exc = e2
+        self._fail_batch(tickets, exc)
+        return None
 
     def _loop(self) -> None:
         import logging

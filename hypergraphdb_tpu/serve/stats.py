@@ -31,9 +31,13 @@ LEGACY_TO_DOTTED = {
     "shed_deadline": "serve.shed_deadline",
     "rejected_queue_full": "serve.rejected_queue_full",
     "cancelled": "serve.cancelled",
+    "errors": "serve.errors",
     "host_fallbacks": "serve.host_fallbacks",
     "batches": "serve.batches",
     "device_dispatches": "serve.device_dispatches",
+    "retries": "serve.retries",
+    "breaker_trips": "serve.breaker_trips",
+    "breaker_state": "serve.breaker_state",
     "batch_occupancy": "serve.lanes_real",     # ÷ serve.lanes_padded
     "latency_ms": "serve.latency_seconds",
     "queue_depth": "serve.queue_depth",
@@ -47,9 +51,13 @@ DOTTED_NAMES = (
     "serve.shed_deadline",
     "serve.rejected_queue_full",
     "serve.cancelled",
+    "serve.errors",
     "serve.host_fallbacks",
     "serve.batches",
     "serve.device_dispatches",
+    "serve.retries",
+    "serve.breaker_trips",
+    "serve.breaker_state",
     "serve.lanes_real",
     "serve.lanes_padded",
     "serve.latency_seconds",
@@ -82,9 +90,13 @@ class ServeStats:
         self._shed = r.counter("serve.shed_deadline")
         self._rejected = r.counter("serve.rejected_queue_full")
         self._cancelled = r.counter("serve.cancelled")
+        self._errors = r.counter("serve.errors")
         self._host_fallbacks = r.counter("serve.host_fallbacks")
         self._batches = r.counter("serve.batches")
         self._device_dispatches = r.counter("serve.device_dispatches")
+        self._retries = r.counter("serve.retries")
+        self._breaker_trips = r.counter("serve.breaker_trips")
+        self._breaker_state = r.gauge("serve.breaker_state")
         self._lanes_real = r.counter("serve.lanes_real")
         self._lanes_padded = r.counter("serve.lanes_padded")
         self._latency = r.histogram("serve.latency_seconds",
@@ -92,9 +104,10 @@ class ServeStats:
         self._queue_depth = r.gauge("serve.queue_depth")
         self._own = (
             self._submitted, self._completed, self._shed, self._rejected,
-            self._cancelled, self._host_fallbacks, self._batches,
-            self._device_dispatches, self._lanes_real, self._lanes_padded,
-            self._latency, self._queue_depth,
+            self._cancelled, self._errors, self._host_fallbacks,
+            self._batches, self._device_dispatches, self._retries,
+            self._breaker_trips, self._breaker_state, self._lanes_real,
+            self._lanes_padded, self._latency, self._queue_depth,
         )
 
     def reset(self) -> None:
@@ -127,6 +140,31 @@ class ServeStats:
     def record_host_fallback(self) -> None:
         with self._lock:
             self._host_fallbacks.inc()
+
+    def record_error(self) -> None:
+        """A request failed with a typed non-deadline error (executor
+        fault surfaced to the caller) — the accounting identity's fifth
+        terminal: submitted == completed + shed + cancelled + errors +
+        in-flight."""
+        with self._lock:
+            self._errors.inc()
+
+    def record_retry(self) -> None:
+        """One transient-failure re-attempt (device launch retry or a
+        collect-failure host re-serve)."""
+        with self._lock:
+            self._retries.inc()
+
+    def record_breaker_trip(self) -> None:
+        with self._lock:
+            self._breaker_trips.inc()
+
+    def set_breaker_state(self, code: int) -> None:
+        """Pushed by the circuit breaker on every state change (worst
+        state across batch keys: 0 closed, 1 half-open, 2 open) — a
+        single instrument write, deliberately outside the coherence lock
+        (the breaker calls this from its own callback path)."""
+        self._breaker_state.set(code)
 
     def record_batch(self, n_real: int, bucket: int) -> None:
         """One successfully launched micro-batch; occupancy measures the
@@ -175,6 +213,18 @@ class ServeStats:
         return self._cancelled.value
 
     @property
+    def errors(self) -> int:
+        return self._errors.value
+
+    @property
+    def retries(self) -> int:
+        return self._retries.value
+
+    @property
+    def breaker_trips(self) -> int:
+        return self._breaker_trips.value
+
+    @property
     def host_fallbacks(self) -> int:
         return self._host_fallbacks.value
 
@@ -220,9 +270,13 @@ class ServeStats:
                 "shed_deadline": self._shed.value,
                 "rejected_queue_full": self._rejected.value,
                 "cancelled": self._cancelled.value,
+                "errors": self._errors.value,
                 "host_fallbacks": self._host_fallbacks.value,
                 "batches": self._batches.value,
                 "device_dispatches": self._device_dispatches.value,
+                "retries": self._retries.value,
+                "breaker_trips": self._breaker_trips.value,
+                "breaker_state": self._breaker_state.value,
                 "batch_occupancy": (
                     self._lanes_real.value / padded if padded else None
                 ),

@@ -33,9 +33,16 @@ import numpy as np
 
 from hypergraphdb_tpu.core.errors import TransactionAborted, TransactionConflict
 from hypergraphdb_tpu.core.handles import HGHandle
+from hypergraphdb_tpu.fault import global_faults
 from hypergraphdb_tpu.storage.api import HGSortedResultSet, StorageBackend
 
 T = TypeVar("T")
+
+#: process fault registry (singleton contract): the ingest crash drill
+#: arms ``tx.commit.pre`` / ``tx.commit.apply`` with InjectedCrash and
+#: kills the process at the k-th write commit — one attribute read per
+#: commit while disabled
+_FAULTS = global_faults()
 
 _TOMBSTONE = object()
 
@@ -256,6 +263,10 @@ class HGTransactionManager:
                     m.incr("tx.commits")
                 self._run_commit_hooks(tx)
                 return
+            if _FAULTS.enabled:
+                # registered crash point: dying HERE loses this commit
+                # entirely (nothing staged) — replay must be a no-op
+                _FAULTS.check("tx.commit.pre")
             with self._commit_lock:
                 for cell, observed in tx.read_set.items():
                     if self._versions.get(cell, 0) != observed:
@@ -267,6 +278,11 @@ class HGTransactionManager:
                 self._clock += 1
                 v = self._clock
                 self._capture_history(tx, v)
+                if _FAULTS.enabled:
+                    # registered crash point: dying mid-commit before the
+                    # write-through — the WAL sees no (or a torn) batch
+                    # and must discard it on replay
+                    _FAULTS.check("tx.commit.apply")
                 self._apply(tx)
                 for h in tx.links:
                     self._versions[("link", h)] = v

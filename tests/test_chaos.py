@@ -1,0 +1,286 @@
+"""Seeded chaos soaks: the full ServeRuntime + concurrent ingest (+
+replication) under randomized-but-reproducible fault schedules.
+
+The acceptance contract per seed:
+
+- **stats identity**: submitted == completed + shed + cancelled + errors
+  (+ 0 in flight after close) — no double counting under any failure
+  interleaving;
+- **no stranded tickets**: every future is done after close;
+- **correct or typed**: every response is either exactly the precomputed
+  ground truth (the fault schedule may reroute it through retries, host
+  fallback, or a breaker-degraded batch — never change the answer) or a
+  typed ``ServeError``/``FaultError``;
+- **reproducible by construction**: the schedule is RANDOMIZED by
+  pre-drawing fire indices from the seed, and the journal must equal
+  that draw's offline replay — thread interleaving cannot change which
+  hit indices fire.
+
+Ground truth stays valid under concurrent ingest because the ingest
+thread only creates atoms/links in a FRESH disconnected cluster: old
+seeds reach nothing new, old anchors gain no incident links.
+
+The short multi-seed soak is tier-1 (tools/chaos.sh gates on it); the
+big combined soak is ``slow``, mirroring the PR-4 convention.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+import pytest
+
+import hypergraphdb_tpu as hg
+from hypergraphdb_tpu.algorithms.traversals import HGBreadthFirstTraversal
+from hypergraphdb_tpu.fault import FaultError, FaultRegistry, global_faults
+from hypergraphdb_tpu.peer.peer import HyperGraphPeer
+from hypergraphdb_tpu.peer.transport import LoopbackNetwork
+from hypergraphdb_tpu.query import conditions as c
+from hypergraphdb_tpu.query import dsl as q
+from hypergraphdb_tpu.serve import ServeConfig, ServeError, ServeRuntime
+
+def draw_schedule(seed):
+    """The randomized-but-reproducible schedule: fire indices pre-drawn
+    from the seed (launch faults bursty on purpose — consecutive indices
+    exercise the breaker trip)."""
+    rng = random.Random(f"schedule:{seed}")
+    launch_at = set(rng.sample(range(1, 10), 4))
+    collect_at = set(rng.sample(range(1, 6), 2))
+    return launch_at, collect_at
+
+
+def build_graph(n_nodes=60, n_links=90):
+    g = hg.HyperGraph()
+    rng = random.Random(42)
+    nodes = [int(g.add(f"s{i}")) for i in range(n_nodes)]
+    for j in range(n_links):
+        a, b = rng.sample(nodes, 2)
+        g.add_link((a, b), value=f"e{j}")
+    return g, nodes
+
+
+def bfs_truth(g, seed, hops):
+    reached = {
+        int(a) for _, a in HGBreadthFirstTraversal(g, seed,
+                                                   max_distance=hops)
+    }
+    reached.add(int(seed))  # include_seed=True (the submit default)
+    return reached
+
+
+def pattern_truth(g, anchor):
+    return sorted(int(h) for h in g.find_all(c.Incident(anchor)))
+
+
+def make_requests(g, nodes, seed, n=40):
+    rng = random.Random(seed)
+    reqs = []
+    for _ in range(n):
+        if rng.random() < 0.6:
+            s = rng.choice(nodes)
+            reqs.append(("bfs", s, bfs_truth(g, s, 2)))
+        else:
+            a = rng.choice(nodes)
+            reqs.append(("pattern", a, pattern_truth(g, a)))
+    return reqs
+
+
+def start_ingest(g, seed, stop):
+    """Mutations in a DISCONNECTED fresh cluster: real compaction/delta
+    pressure, zero effect on the precomputed truths."""
+    def work():
+        irng = random.Random(seed + 1)
+        fresh = []
+        i = 0
+        while not stop.is_set():
+            fresh.append(int(g.add(f"x{seed}-{i}")))
+            if len(fresh) >= 2 and irng.random() < 0.3:
+                a, b = irng.sample(fresh, 2)
+                g.add_link((a, b), value=f"xl{seed}-{i}")
+            i += 1
+            time.sleep(0.001)
+
+    t = threading.Thread(target=work, name="chaos-ingest", daemon=True)
+    t.start()
+    return t
+
+
+def check_outcome(kind, truth, fut):
+    """correct-or-typed: returns 'ok' | 'typed'."""
+    try:
+        res = fut.result(timeout=60)
+    except (ServeError, FaultError):
+        return "typed"
+    if kind == "bfs":
+        assert res.count == len(truth)
+        got = set(res.matches.tolist())
+        if res.truncated:
+            assert got <= truth
+        else:
+            assert got == truth
+    else:
+        got = res.matches.tolist()
+        if res.truncated:
+            assert got == truth[: len(got)]
+        else:
+            assert got == truth
+    return "ok"
+
+
+def assert_fault_sequence_reproducible(faults, point, at):
+    """The journal must equal the armed draw's offline replay: every
+    reached index fired, in ascending order, nothing else — thread
+    interleaving cannot perturb it (per-point schedule indexing)."""
+    hits = faults.hits(point)
+    expected = sorted(i for i in at if i <= hits)
+    got = [idx for (name, idx) in faults.journal if name == point]
+    assert got == expected
+
+
+def run_serve_soak(seed, n_requests=45, n_nodes=60, n_links=90):
+    launch_at, collect_at = draw_schedule(seed)
+    faults = FaultRegistry().enable(seed=seed)
+    faults.arm("serve.launch", at=launch_at)
+    faults.arm("serve.collect", at=collect_at)
+    g, nodes = build_graph(n_nodes, n_links)
+    reqs = make_requests(g, nodes, seed, n_requests)
+    cfg = ServeConfig(
+        buckets=(64,), max_linger_s=0.001, default_deadline_s=10.0,
+        max_retries=2, retry_base_s=0.0005, retry_max_s=0.005,
+        retry_seed=seed, breaker_threshold=3, breaker_cooldown_s=0.01,
+        max_lag_edges=100_000, faults=faults,
+    )
+    rt = ServeRuntime(g, cfg)
+    stop = threading.Event()
+    ingester = start_ingest(g, seed, stop)
+    try:
+        # waves of 3: enough dispatches that every armed index is
+        # reached, while requests still coalesce into real micro-batches
+        outcomes = []
+        futs = []
+        for w in range(0, len(reqs), 3):
+            wave = []
+            for kind, arg, truth in reqs[w:w + 3]:
+                if kind == "bfs":
+                    wave.append((kind, truth,
+                                 rt.submit_bfs(arg, max_hops=2)))
+                else:
+                    wave.append((kind, truth, rt.submit_pattern([arg])))
+            futs.extend(wave)
+            outcomes.extend(check_outcome(k, t, f) for k, t, f in wave)
+    finally:
+        stop.set()
+        ingester.join(timeout=10)
+        rt.close(drain=True)
+
+    # no stranded tickets: every future reached a terminal state
+    assert all(f.done() for _, _, f in futs)
+    # the stats identity, post-drain (in-flight == 0)
+    s = rt.stats
+    assert s.submitted == (
+        s.completed + s.shed_deadline + s.cancelled + s.errors
+    ), s.snapshot()
+    assert s.submitted == len(reqs)
+    assert rt.queue.depth() == 0
+    # every armed index was reached: the schedule REALLY injected
+    assert faults.hits("serve.launch") >= max(launch_at)
+    assert faults.fired("serve.launch") == len(launch_at)
+    assert faults.fired("serve.collect") == len(
+        [i for i in collect_at if i <= faults.hits("serve.collect")]
+    )
+    assert faults.fired("serve.collect") >= 1
+    assert outcomes.count("ok") > 0
+    # reproducible by construction: offline replay == journal
+    assert_fault_sequence_reproducible(faults, "serve.launch", launch_at)
+    assert_fault_sequence_reproducible(faults, "serve.collect",
+                                       collect_at)
+    g.close()
+    return outcomes, s
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
+def test_chaos_serve_ingest_soak(seed):
+    run_serve_soak(seed)
+
+
+def test_chaos_replication_converges():
+    """Lossy-wire replication: pre-drawn deterministic drops on the
+    transport; redelivery + catch-up converge the replica exactly."""
+    faults = global_faults()
+    faults.reset()
+    seed = 11
+    rng = random.Random(seed)
+    drops = set(rng.sample(range(1, 60), 10))
+
+    net = LoopbackNetwork()
+    ga, gb = hg.HyperGraph(), hg.HyperGraph()
+    pa = HyperGraphPeer.loopback(ga, net, identity="chaos-a")
+    pb = HyperGraphPeer.loopback(gb, net, identity="chaos-b")
+    for p in (pa, pb):
+        p.replication.send_backoff_s = 0.001
+        p.replication.send_backoff_max_s = 0.005
+        p.replication.debounce_s = 0.005
+    pa.start()
+    pb.start()
+    try:
+        pb.replication.publish_interest(None)
+        deadline = time.monotonic() + 10
+        while "chaos-b" not in pa.replication.peer_interests:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        # arm AFTER the interest handshake: only replication pushes/acks
+        # ride the lossy wire
+        faults.enable(seed=seed)
+        faults.arm(
+            "peer.transport.send", at=drops,
+            when=lambda ctx: ctx.get("activity") == "replication",
+        )
+        markers = []
+        hs = []
+        for i in range(30):
+            h = ga.add(f"c{i}")
+            hs.append(h)
+            markers.append(f"c{i}")
+            if i % 5 == 4:
+                lm = f"cl{i}"
+                ga.add_link((hs[i - 1], h), value=lm)
+                markers.append(lm)
+        assert pa.replication.flush(timeout=30)
+        n_dropped = faults.fired("peer.transport.send")
+        # heal the tail: disarm, catch up, drain both pipelines
+        faults.disarm("peer.transport.send")
+        pb.replication.catch_up("chaos-a")
+        assert pb.replication.flush(timeout=30)
+        deadline = time.monotonic() + 20
+        missing = list(markers)
+        while missing and time.monotonic() < deadline:
+            missing = [m for m in missing if not q.find_all(gb, q.value(m))]
+            time.sleep(0.02)
+        assert not missing, f"replica missing {missing[:5]}..."
+        # no duplicates despite redelivery
+        for m in markers:
+            assert len(q.find_all(gb, q.value(m))) == 1
+        # the wire really dropped, deterministically: the journal is the
+        # ascending subset of the pre-drawn indices that were reached
+        assert n_dropped > 0
+        dropped = [idx for (name, idx) in faults.journal
+                   if name == "peer.transport.send"]
+        assert dropped == sorted(dropped) and set(dropped) <= drops
+    finally:
+        pa.stop()
+        pb.stop()
+        faults.reset()
+        faults.disable()
+
+
+@pytest.mark.slow
+def test_chaos_full_stack_soak_long():
+    """The combined long soak: serving + ingest chaos across more seeds
+    and a larger graph, with the replication leg riding the same run."""
+    for seed in (21, 22, 23):
+        outcomes, stats = run_serve_soak(seed, n_requests=120,
+                                         n_nodes=120, n_links=200)
+        assert outcomes.count("ok") >= len(outcomes) * 0.5
+    test_chaos_replication_converges()

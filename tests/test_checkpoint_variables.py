@@ -164,6 +164,137 @@ def test_plans_persist_with_snapshot(tmp_path, graph):
     assert np.array_equal(p0.out_map, p1.out_map)
 
 
+# ------------------------------------------- crash-atomic saves (hgfault)
+
+
+@pytest.fixture
+def faults():
+    from hypergraphdb_tpu.fault import global_faults
+
+    f = global_faults()
+    f.reset()
+    yield f
+    f.reset()
+    f.disable()
+
+
+def _two_snapshots(graph):
+    make_random_hypergraph(graph, n_nodes=40, n_links=60, seed=3)
+    snap_a = graph.snapshot()
+    for i in range(25):
+        graph.add(f"extra-{i}")
+    snap_b = graph.snapshot()
+    assert snap_b.num_atoms > snap_a.num_atoms
+    return snap_a, snap_b
+
+
+def test_crash_mid_npz_save_previous_checkpoint_survives(graph, tmp_path,
+                                                         faults):
+    from hypergraphdb_tpu.fault import InjectedCrash
+
+    snap_a, snap_b = _two_snapshots(graph)
+    p = str(tmp_path / "snap.npz")
+    save_snapshot(snap_a, p)
+    faults.enable(seed=0)
+    faults.arm("ckpt.save_npz", at={1}, error=InjectedCrash)
+    with pytest.raises(InjectedCrash):
+        save_snapshot(snap_b, p)
+    # the "kill" happened after the tmp write, before publish: the
+    # previous checkpoint is fully loadable, never a torn file
+    back = load_snapshot(p)
+    assert back.num_atoms == snap_a.num_atoms
+    np.testing.assert_array_equal(back.inc_offsets, snap_a.inc_offsets)
+    # once the schedule clears, the next save publishes normally
+    save_snapshot(snap_b, p)
+    assert load_snapshot(p).num_atoms == snap_b.num_atoms
+
+
+def test_crash_mid_plans_save_leaves_loadable_state(graph, tmp_path,
+                                                    faults):
+    from hypergraphdb_tpu.fault import InjectedCrash
+    from hypergraphdb_tpu.ops.checkpoint import _plans_path
+
+    snap_a, snap_b = _two_snapshots(graph)
+    p = str(tmp_path / "snap.npz")
+    save_snapshot(snap_a, p, with_plans=True)
+    faults.enable(seed=0)
+    faults.arm("ckpt.save_plans", at={1}, error=InjectedCrash)
+    with pytest.raises(InjectedCrash):
+        save_snapshot(snap_b, p, with_plans=True)
+    # npz published (B), sidecar still A's: the fingerprint mismatch is
+    # the DESIGNED stale shape — load succeeds, plans rebuild quietly
+    back = load_snapshot(p)
+    assert back.num_atoms == snap_b.num_atoms
+    assert getattr(back, "_pull_plans", None) is None
+    import os
+
+    assert os.path.exists(_plans_path(p))  # old sidecar intact on disk
+    save_snapshot(snap_b, p, with_plans=True)
+    assert getattr(load_snapshot(p), "_pull_plans", None) is not None
+
+
+def test_ordinary_save_failure_cleans_tmp(graph, tmp_path, faults):
+    from hypergraphdb_tpu.fault import PermanentFault
+
+    snap_a, snap_b = _two_snapshots(graph)
+    p = str(tmp_path / "snap.npz")
+    save_snapshot(snap_a, p)
+    import os
+
+    # a real (non-crash) failure between write and publish cleans up: the
+    # Exception path unlinks the tmp, the BaseException crash path leaves
+    # it (like a real kill would) — test the crash side leaves tmp behind
+    from hypergraphdb_tpu.fault import InjectedCrash
+
+    faults.enable(seed=0)
+    faults.arm("ckpt.save_npz", at={1}, error=InjectedCrash)
+    with pytest.raises(InjectedCrash):
+        save_snapshot(snap_b, p)
+    assert os.path.exists(p + ".tmp")
+    faults.disarm("ckpt.save_npz")
+    save_snapshot(snap_b, p)          # next save overwrites + publishes
+    assert not os.path.exists(p + ".tmp")
+    assert load_snapshot(p).num_atoms == snap_b.num_atoms
+    with pytest.raises(PermanentFault):  # Exception path: tmp cleaned
+        faults.arm("ckpt.save_npz", at={1}, error=PermanentFault)
+        save_snapshot(snap_a, p)
+    assert not os.path.exists(p + ".tmp")
+
+
+def test_stale_sidecar_rebuilds_quietly_corrupt_sidecar_counts(
+        graph, tmp_path, faults):
+    """The load_snapshot triage: fingerprint mismatch (stale by design) is
+    silent; an unreadable sidecar logs + bumps fault.sidecar_corrupt."""
+    from hypergraphdb_tpu.ops.checkpoint import _plans_path
+    from hypergraphdb_tpu.utils.metrics import global_metrics
+
+    snap_a, snap_b = _two_snapshots(graph)
+    pa_ = str(tmp_path / "a.npz")
+    pb_ = str(tmp_path / "b.npz")
+    save_snapshot(snap_a, pa_, with_plans=True)
+    save_snapshot(snap_b, pb_, with_plans=True)
+
+    c = global_metrics.registry.counter("fault.sidecar_corrupt")
+    before = c.value
+
+    # stale: b's npz with a's plans → quiet rebuild, counter untouched
+    import shutil
+
+    shutil.copyfile(_plans_path(pa_), _plans_path(pb_))
+    back = load_snapshot(pb_)
+    assert back.num_atoms == snap_b.num_atoms
+    assert getattr(back, "_pull_plans", None) is None
+    assert c.value == before
+
+    # corrupt: garbage bytes → logged warning + counter, load still fine
+    with open(_plans_path(pb_), "wb") as f:
+        f.write(b"this is not an npz file at all")
+    back = load_snapshot(pb_)
+    assert back.num_atoms == snap_b.num_atoms
+    assert getattr(back, "_pull_plans", None) is None
+    assert c.value == before + 1
+
+
 def test_plan_cache_env_roundtrip(tmp_path, graph, monkeypatch):
     import numpy as np
 

@@ -1,0 +1,271 @@
+"""hgfault unit tests: registry schedules, determinism, classification,
+and the circuit-breaker state machine.
+
+Everything here is single-threaded and clock-injected — the registry's
+reproducibility properties (same seed → same fire sequence; per-point
+decisions independent of cross-point interleaving) are asserted directly,
+because they are what make the chaos soaks replayable.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from hypergraphdb_tpu.fault import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+    FaultError,
+    FaultRegistry,
+    InjectedCrash,
+    PermanentFault,
+    TransientFault,
+    is_transient,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ------------------------------------------------------------- registry
+
+
+def test_disabled_registry_never_fires_or_counts():
+    f = FaultRegistry()
+    f.arm("p", times=100)
+    f.check("p")                      # enabled is False: pure no-op
+    assert f.hits("p") == 0
+    assert f.fired("p") == 0
+
+
+def test_times_schedule_fails_first_n_hits():
+    f = FaultRegistry().enable(seed=0)
+    f.arm("p", times=2)
+    for _ in range(2):
+        with pytest.raises(TransientFault):
+            f.check("p")
+    f.check("p")                      # third hit passes
+    f.check("p")
+    assert f.hits("p") == 4
+    assert f.fired("p") == 2
+    assert f.journal == [("p", 1), ("p", 2)]
+
+
+def test_at_schedule_fires_exact_hit_indices():
+    f = FaultRegistry().enable(seed=0)
+    f.arm("p", at={2, 4}, error=PermanentFault)
+    outcomes = []
+    for _ in range(5):
+        try:
+            f.check("p")
+            outcomes.append("ok")
+        except PermanentFault:
+            outcomes.append("boom")
+    assert outcomes == ["ok", "boom", "ok", "boom", "ok"]
+
+
+def test_prob_schedule_same_seed_same_sequence():
+    def fired_pattern(seed):
+        f = FaultRegistry().enable(seed=seed)
+        f.arm("p", prob=0.5)
+        out = []
+        for _ in range(64):
+            try:
+                f.check("p")
+                out.append(0)
+            except TransientFault:
+                out.append(1)
+        return out
+
+    a = fired_pattern(7)
+    assert a == fired_pattern(7)      # reproducible by construction
+    assert a != fired_pattern(8)      # and the seed actually matters
+    assert 0 < sum(a) < 64            # a real mix at p=0.5 over 64 draws
+
+
+def test_per_point_decisions_independent_of_interleaving():
+    """Point p1's fire/pass pattern depends only on ITS OWN hit index —
+    thread interleaving across points cannot change the fault sequence."""
+    def run(order):
+        f = FaultRegistry().enable(seed=3)
+        f.arm("p1", prob=0.4)
+        f.arm("p2", prob=0.4)
+        fired = {"p1": [], "p2": []}
+        for name in order:
+            try:
+                f.check(name)
+            except TransientFault:
+                fired[name].append(f.hits(name))
+        return fired
+
+    interleaved = run(["p1", "p2"] * 32)
+    sequential = run(["p1"] * 32 + ["p2"] * 32)
+    assert interleaved == sequential
+
+
+def test_when_predicate_filters_by_ctx():
+    f = FaultRegistry().enable(seed=0)
+    f.arm("p", times=10, when=lambda ctx: ctx.get("target") == "b")
+    f.check("p", target="a")          # filtered: no fire
+    with pytest.raises(TransientFault):
+        f.check("p", target="b")
+    assert f.fired("p") == 1
+
+
+def test_unarmed_point_counts_hits_only():
+    f = FaultRegistry().enable(seed=0)
+    f.check("never.armed", extra="ctx")
+    assert f.hits("never.armed") == 1
+    assert f.fired("never.armed") == 0
+
+
+def test_injected_crash_is_base_exception():
+    f = FaultRegistry().enable(seed=0)
+    f.arm("kill", at={1}, error=InjectedCrash)
+    try:
+        f.check("kill")
+        raise AssertionError("crash point did not fire")
+    except Exception:  # noqa: BLE001 - the point of the test
+        raise AssertionError(
+            "InjectedCrash was caught by `except Exception` — recovery "
+            "code could swallow a simulated kill"
+        )
+    except InjectedCrash:
+        pass
+
+
+def test_arm_validation_and_disarm():
+    f = FaultRegistry().enable(seed=0)
+    with pytest.raises(ValueError):
+        f.arm("p")                    # no schedule
+    with pytest.raises(ValueError):
+        f.arm("p", prob=1.5)
+    f.arm("p", times=5)
+    assert f.armed() == ["p"]
+    f.disarm("p")
+    f.check("p")                      # disarmed: passes
+    f.reset()
+    assert f.hits("p") == 0 and f.journal == []
+
+
+def test_fire_increments_fault_injected_counter():
+    from hypergraphdb_tpu.utils.metrics import global_metrics
+
+    c = global_metrics.registry.counter("fault.injected")
+    before = c.value
+    f = FaultRegistry().enable(seed=0)
+    f.arm("p", times=1)
+    with pytest.raises(TransientFault):
+        f.check("p")
+    assert c.value == before + 1
+
+
+# ------------------------------------------------------------- classification
+
+
+def test_is_transient_classification():
+    assert is_transient(TransientFault("x"))
+    assert is_transient(TimeoutError("x"))
+    assert is_transient(ConnectionError("x"))
+    assert not is_transient(PermanentFault("x"))
+    assert not is_transient(RuntimeError("x"))
+    assert is_transient(RuntimeError("x"), extra=(RuntimeError,))
+
+    class MarkedTransient(Exception):
+        transient = True
+
+    class MarkedPermanent(TimeoutError):
+        transient = False          # explicit attribute beats isinstance
+
+    assert is_transient(MarkedTransient())
+    assert not is_transient(MarkedPermanent())
+    assert isinstance(TransientFault("x"), FaultError)
+
+
+# ------------------------------------------------------------- breaker
+
+
+def make_breaker(threshold=3, cooldown=1.0):
+    clock = FakeClock()
+    states, trips = [], []
+    b = CircuitBreaker(threshold=threshold, cooldown_s=cooldown,
+                       clock=clock, on_state=states.append,
+                       on_trip=lambda: trips.append(1))
+    return b, clock, states, trips
+
+
+def test_breaker_trips_after_threshold_consecutive_failures():
+    b, clock, states, trips = make_breaker(threshold=3)
+    key = ("bfs", 2)
+    assert b.allow(key)
+    b.record_failure(key)
+    b.record_failure(key)
+    assert b.state_of(key) == CLOSED and b.allow(key)
+    b.record_failure(key)
+    assert b.state_of(key) == OPEN
+    assert not b.allow(key)           # open: host fallback
+    assert trips == [1] and states[-1] == 2
+
+
+def test_breaker_success_resets_failure_streak():
+    b, clock, states, trips = make_breaker(threshold=2)
+    key = "k"
+    b.record_failure(key)
+    b.record_success(key)             # streak broken
+    b.record_failure(key)
+    assert b.state_of(key) == CLOSED  # 1 < threshold again
+    assert trips == []
+
+
+def test_breaker_half_open_probe_success_closes():
+    b, clock, states, trips = make_breaker(threshold=1, cooldown=1.0)
+    key = "k"
+    b.record_failure(key)
+    assert not b.allow(key)
+    clock.advance(1.5)
+    assert b.allow(key)               # the probe
+    assert b.state_of(key) == HALF_OPEN
+    assert not b.allow(key)           # one probe per cooldown window
+    b.record_success(key)
+    assert b.state_of(key) == CLOSED
+    assert b.allow(key)
+    assert states[-1] == 0
+
+
+def test_breaker_half_open_probe_failure_reopens():
+    b, clock, states, trips = make_breaker(threshold=1, cooldown=1.0)
+    key = "k"
+    b.record_failure(key)
+    clock.advance(1.5)
+    assert b.allow(key)
+    b.record_failure(key)             # the probe failed
+    assert b.state_of(key) == OPEN
+    assert not b.allow(key)
+    assert b.trips == 2               # initial trip + probe re-trip
+
+
+def test_breaker_lost_probe_does_not_wedge_the_gate():
+    b, clock, *_ = make_breaker(threshold=1, cooldown=1.0)
+    key = "k"
+    b.record_failure(key)
+    clock.advance(1.5)
+    assert b.allow(key)               # probe released... and lost
+    clock.advance(1.5)
+    assert b.allow(key)               # a fresh probe after another cooldown
+
+
+def test_breaker_gates_are_per_key():
+    b, clock, *_ = make_breaker(threshold=1)
+    b.record_failure("bad")
+    assert not b.allow("bad")
+    assert b.allow("good")            # other keys unaffected
+    assert b.worst_code() == 2

@@ -1,0 +1,417 @@
+"""Serve-plane failure paths: retries, deadline-aware backoff, breaker
+degradation/recovery, collect recovery, and the fault-off overhead gate.
+
+Deterministic throughout: manual-mode runtimes, one FakeClock shared by
+the runtime and an injected fake sleeper (sleeping ADVANCES the clock),
+and a FlakyExecutor whose failures are scripted — no device, no threads.
+The real-device fault story runs under the chaos soak
+(``tests/test_chaos.py``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from hypergraphdb_tpu.fault import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    FaultRegistry,
+    PermanentFault,
+    TransientFault,
+)
+from hypergraphdb_tpu.serve import (
+    DeadlineExceeded,
+    ServeConfig,
+    ServeResult,
+    ServeRuntime,
+)
+from tests.test_serve_runtime import FakeClock, FakeExecutor
+
+
+class FlakyExecutor:
+    """Scripted failures: the first ``fail_launches`` device launches and
+    the first ``fail_collects`` device collects raise ``error``. Honors
+    ``batch.force_host`` (serves "host" results without device work) and
+    implements the ``collect_host`` recovery hook."""
+
+    def __init__(self, fail_launches=0, fail_collects=0,
+                 error=TransientFault):
+        self.fail_launches = fail_launches
+        self.fail_collects = fail_collects
+        self.error = error
+        self.events: list[tuple] = []
+        self.batches: list = []
+
+    def _results(self, batch, served_by):
+        return [
+            (t, ServeResult(t.request.kind, 0,
+                            np.empty(0, dtype=np.int64), False, 0,
+                            served_by))
+            for t in batch.tickets
+        ]
+
+    def launch(self, batch):
+        if batch.force_host:
+            self.events.append(("host", len(self.batches)))
+            self.batches.append(batch)
+            return ("host", batch)
+        if self.fail_launches > 0:
+            self.fail_launches -= 1
+            self.events.append(("launch_fail",))
+            raise self.error("device fell over at launch")
+        self.events.append(("launch", len(self.batches)))
+        self.batches.append(batch)
+        return ("device", batch)
+
+    def collect(self, token):
+        kind, batch = token
+        if kind == "device" and self.fail_collects > 0:
+            self.fail_collects -= 1
+            self.events.append(("collect_fail",))
+            raise self.error("device fell over at collect")
+        self.events.append(("collect", kind))
+        return self._results(batch, "fake" if kind == "device" else "host")
+
+    def collect_host(self, token):
+        _, batch = token
+        self.events.append(("collect_host",))
+        return self._results(batch, "host")
+
+
+def make_runtime(ex=None, clock=None, linger=0.0, **kw):
+    clock = clock or FakeClock()
+    sleeps: list[float] = []
+
+    def sleep(dt):
+        sleeps.append(dt)
+        clock.advance(dt)
+
+    kw.setdefault("retry_base_s", 0.01)
+    kw.setdefault("retry_max_s", 0.08)
+    cfg = ServeConfig(buckets=(4, 16), max_linger_s=linger, clock=clock,
+                      manual=True, sleep=sleep, **kw)
+    ex = ex if ex is not None else FlakyExecutor()
+    rt = ServeRuntime(graph=None, config=cfg, executor=ex)
+    return rt, ex, clock, sleeps
+
+
+def assert_identity(rt):
+    """The accounting identity the chaos soak enforces, with the queue
+    drained: submitted == completed + shed + cancelled + errors."""
+    s = rt.stats
+    assert s.submitted == (
+        s.completed + s.shed_deadline + s.cancelled + s.errors
+    )
+    assert rt.queue.depth() == 0
+
+
+# --------------------------------------------------------- transient retry
+
+
+def test_transient_launch_failure_retries_to_success():
+    ex = FlakyExecutor(fail_launches=1)
+    rt, ex, clock, sleeps = make_runtime(ex)
+    fut = rt.submit_bfs(1)
+    assert rt.step(drain=True)
+    assert fut.result(timeout=0).served_by == "fake"
+    assert rt.stats.retries == 1
+    assert len(sleeps) == 1
+    # first backoff: base * (1 + U[0, jitter]) with jitter 0.5
+    assert 0.01 <= sleeps[0] <= 0.015
+    assert ex.events[0] == ("launch_fail",)
+    assert ("launch", 0) in ex.events
+    assert_identity(rt)
+
+
+def test_backoff_is_exponential_and_capped():
+    ex = FlakyExecutor(fail_launches=3)
+    rt, ex, clock, sleeps = make_runtime(ex, max_retries=5,
+                                         breaker_threshold=99)
+    fut = rt.submit_bfs(1)
+    rt.step(drain=True)
+    assert fut.result(timeout=0).served_by == "fake"
+    assert len(sleeps) == 3
+    base = [0.01, 0.02, 0.04]
+    for dt, b in zip(sleeps, base):
+        assert b <= dt <= b * 1.5
+
+
+def test_retry_jitter_is_seeded_deterministic():
+    def sleeps_for(seed):
+        ex = FlakyExecutor(fail_launches=2)
+        rt, ex, clock, sleeps = make_runtime(
+            ex, retry_seed=seed, max_retries=5, breaker_threshold=99)
+        rt.submit_bfs(1)
+        rt.step(drain=True)
+        return sleeps
+
+    assert sleeps_for(4) == sleeps_for(4)
+    assert sleeps_for(4) != sleeps_for(5)
+
+
+def test_permanent_failure_surfaces_typed_without_retry():
+    ex = FlakyExecutor(fail_launches=5, error=PermanentFault)
+    rt, ex, clock, sleeps = make_runtime(ex)
+    fut = rt.submit_bfs(1)
+    rt.step(drain=True)
+    with pytest.raises(PermanentFault):
+        fut.result(timeout=0)
+    assert sleeps == []               # permanent: no backoff was paid
+    assert rt.stats.retries == 0
+    assert rt.stats.errors == 1
+    assert_identity(rt)
+
+
+def test_retry_budget_exhausted_surfaces_transient_error():
+    ex = FlakyExecutor(fail_launches=10)
+    rt, ex, clock, sleeps = make_runtime(ex, max_retries=2,
+                                         breaker_threshold=99)
+    fut = rt.submit_bfs(1)
+    rt.step(drain=True)
+    with pytest.raises(TransientFault):
+        fut.result(timeout=0)
+    assert rt.stats.retries == 2      # 2 re-attempts, 3 launches total
+    assert rt.stats.errors == 1
+    assert_identity(rt)
+
+
+# --------------------------------------------------------- deadline respect
+
+
+def test_backoff_never_sleeps_past_the_deadline_sheds_instead():
+    """Retry budget exhausted BY DEADLINE → shed, not hang: a ticket
+    whose deadline falls inside the next backoff is shed immediately."""
+    ex = FlakyExecutor(fail_launches=10)
+    rt, ex, clock, sleeps = make_runtime(
+        ex, retry_base_s=1.0, retry_max_s=2.0, max_retries=5,
+        breaker_threshold=99)
+    fut = rt.submit_bfs(1, deadline_s=0.5)
+    rt.step(drain=True)
+    with pytest.raises(DeadlineExceeded):
+        fut.result(timeout=0)
+    assert sleeps == []               # the 1 s backoff was never paid
+    assert rt.stats.shed_deadline == 1
+    assert_identity(rt)
+
+
+def test_backoff_sheds_doomed_tickets_keeps_live_ones():
+    ex = FlakyExecutor(fail_launches=1)
+    rt, ex, clock, sleeps = make_runtime(
+        ex, retry_base_s=1.0, retry_max_s=2.0, retry_jitter=0.0,
+        max_retries=5, breaker_threshold=99)
+    doomed = rt.submit_bfs(1, deadline_s=0.5)
+    live = rt.submit_bfs(2, deadline_s=10.0)
+    rt.step(drain=True)
+    with pytest.raises(DeadlineExceeded):
+        doomed.result(timeout=0)
+    assert live.result(timeout=0).served_by == "fake"
+    assert sleeps == [1.0]            # the survivor paid the backoff
+    (batch,) = ex.batches
+    assert [t.request.seed for t in batch.tickets] == [2]
+    assert rt.stats.snapshot()["batch_occupancy"] == pytest.approx(0.25)
+    assert_identity(rt)
+
+
+# --------------------------------------------------------- circuit breaker
+
+
+def test_breaker_trips_to_host_and_recovers_via_probe():
+    """The acceptance demo: a failing device schedule degrades EVERY
+    in-deadline request to exact host fallback; once the schedule clears
+    and the cooldown elapses, a half-open probe restores device serving."""
+    ex = FlakyExecutor(fail_launches=2)
+    rt, ex, clock, sleeps = make_runtime(
+        ex, breaker_threshold=2, breaker_cooldown_s=1.0, max_retries=5)
+    key = ("bfs", 2)
+
+    # batch 1: two transient failures trip the breaker; the SAME batch
+    # re-routes to host — the caller sees an answer, not an error
+    f1 = rt.submit_bfs(1)
+    rt.step(drain=True)
+    assert f1.result(timeout=0).served_by == "host"
+    assert rt.breaker.state_of(key) == OPEN
+    assert rt.stats.breaker_trips == 1
+    assert rt.stats.snapshot()["breaker_state"] == 2
+
+    # while OPEN: straight to host, no device attempt at all
+    f2 = rt.submit_bfs(2)
+    rt.step(drain=True)
+    assert f2.result(timeout=0).served_by == "host"
+    assert ("launch_fail",) not in ex.events[-2:]
+
+    # cooldown elapses → half-open probe; the schedule has cleared, so
+    # the probe succeeds and the gate closes: device serving resumes
+    clock.advance(1.5)
+    f3 = rt.submit_bfs(3)
+    rt.step(drain=True)
+    assert f3.result(timeout=0).served_by == "fake"
+    assert rt.breaker.state_of(key) == CLOSED
+    assert rt.stats.snapshot()["breaker_state"] == 0
+
+    f4 = rt.submit_bfs(4)
+    rt.step(drain=True)
+    assert f4.result(timeout=0).served_by == "fake"
+    # every request was answered: 100% completion through the outage
+    assert rt.stats.completed == 4 and rt.stats.errors == 0
+    assert_identity(rt)
+
+
+def test_breaker_probe_failure_reopens_and_host_serves():
+    ex = FlakyExecutor(fail_launches=10)
+    rt, ex, clock, sleeps = make_runtime(
+        ex, breaker_threshold=1, breaker_cooldown_s=1.0, max_retries=0)
+    f1 = rt.submit_bfs(1)
+    rt.step(drain=True)               # failure trips immediately → host
+    assert f1.result(timeout=0).served_by == "host"
+    clock.advance(1.5)
+    f2 = rt.submit_bfs(2)             # probe fails → re-open → host
+    rt.step(drain=True)
+    assert f2.result(timeout=0).served_by == "host"
+    assert rt.breaker.state_of(("bfs", 2)) == OPEN
+    assert rt.stats.breaker_trips == 2
+    assert rt.stats.completed == 2 and rt.stats.errors == 0
+    assert_identity(rt)
+
+
+def test_breaker_gates_are_per_batch_key():
+    ex = FlakyExecutor(fail_launches=1)
+    rt, ex, clock, sleeps = make_runtime(
+        ex, breaker_threshold=1, max_retries=0)
+    fb = rt.submit_bfs(1)
+    rt.step(drain=True)               # trips ("bfs", 2) → host
+    assert fb.result(timeout=0).served_by == "host"
+    fp = rt.submit_pattern([1, 2])    # different key: still device
+    rt.step(drain=True)
+    assert fp.result(timeout=0).served_by == "fake"
+    assert rt.breaker.state_of(("pattern", 2)) == CLOSED
+
+
+# --------------------------------------------------------- collect recovery
+
+
+def test_collect_failure_recovers_on_host_same_epoch():
+    ex = FlakyExecutor(fail_collects=1)
+    rt, ex, clock, sleeps = make_runtime(ex)
+    fut = rt.submit_bfs(1)
+    rt.step(drain=True)
+    assert fut.result(timeout=0).served_by == "host"
+    assert ("collect_host",) in ex.events
+    assert rt.stats.retries == 1
+    assert rt.breaker.state_of(("bfs", 2)) != OPEN  # 1 < threshold
+    assert_identity(rt)
+
+
+def test_collect_failure_without_hook_fails_typed_runtime_survives():
+    class NoHookExecutor(FakeExecutor):
+        def __init__(self):
+            super().__init__()
+            self.boom = True
+
+        def collect(self, token):
+            if self.boom:
+                self.boom = False
+                raise TransientFault("collect fell over")
+            return super().collect(token)
+
+    ex = NoHookExecutor()
+    rt, ex, clock, sleeps = make_runtime(ex)
+    f1 = rt.submit_bfs(1)
+    rt.step(drain=True)
+    with pytest.raises(TransientFault):
+        f1.result(timeout=0)
+    f2 = rt.submit_bfs(2)             # the runtime keeps serving
+    rt.step(drain=True)
+    assert f2.result(timeout=0).kind == "bfs"
+    assert rt.stats.errors == 1
+    assert_identity(rt)
+
+
+def test_permanent_collect_failure_skips_host_recovery():
+    ex = FlakyExecutor(fail_collects=1, error=PermanentFault)
+    rt, ex, clock, sleeps = make_runtime(ex)
+    fut = rt.submit_bfs(1)
+    rt.step(drain=True)
+    with pytest.raises(PermanentFault):
+        fut.result(timeout=0)
+    assert ("collect_host",) not in ex.events
+    assert_identity(rt)
+
+
+# --------------------------------------------------------- off-gate contract
+
+
+def run_workload(rt, clock):
+    rt.submit_bfs(1)
+    rt.submit_bfs(2)
+    rt.pump(drain=True)
+    rt.submit_pattern([1, 2])
+    rt.submit_bfs(3, max_hops=5)
+    clock.advance(0.02)
+    while rt.pump(drain=True):
+        pass
+    rt.close(drain=True)
+
+
+def test_faults_off_identical_dispatch_sequence_and_no_entry(monkeypatch):
+    """The overhead contract: with the fault layer DISABLED (default)
+    the dispatch event order is byte-identical to the committed pipeline
+    contract, and the fault registry is never entered — ``check`` is
+    poisoned, so one reached call would fail the test. The only cost left
+    is the ``enabled`` attribute read per site."""
+    def boom(self, name, **ctx):  # pragma: no cover - must not run
+        raise AssertionError(f"fault check {name!r} reached while disabled")
+
+    monkeypatch.setattr(FaultRegistry, "check", boom)
+    clock = FakeClock()
+    cfg = ServeConfig(buckets=(4, 16), max_linger_s=0.010, clock=clock,
+                      manual=True, faults=FaultRegistry())
+    ex = FakeExecutor()
+    rt = ServeRuntime(graph=None, config=cfg, executor=ex)
+    assert rt.faults.enabled is False
+    run_workload(rt, clock)
+    assert ex.events == [
+        ("launch", 0), ("launch", 1), ("collect", 0),
+        ("launch", 2), ("collect", 1), ("collect", 2),
+    ]
+    assert rt.stats.retries == 0 and rt.stats.errors == 0
+    assert_identity(rt)
+
+
+def test_injected_registry_drives_the_executor_sites():
+    """A private armed registry injected via ServeConfig(faults=) reaches
+    the runtime's ladder: one armed transient launch fault → one retry."""
+    faults = FaultRegistry().enable(seed=0)
+    faults.arm("serve.launch", times=1)
+
+    class SiteExecutor(FakeExecutor):
+        """Fake executor that honors the executor-site idiom."""
+
+        def __init__(self, faults):
+            super().__init__()
+            self.faults = faults
+
+        def launch(self, batch):
+            if self.faults.enabled:
+                self.faults.check("serve.launch", kind=batch.key[0])
+            return super().launch(batch)
+
+    clock = FakeClock()
+    sleeps = []
+
+    def sleep(dt):
+        sleeps.append(dt)
+        clock.advance(dt)
+
+    cfg = ServeConfig(buckets=(4,), max_linger_s=0.0, clock=clock,
+                      manual=True, faults=faults, sleep=sleep,
+                      retry_base_s=0.001)
+    rt = ServeRuntime(graph=None, config=cfg,
+                      executor=SiteExecutor(faults))
+    fut = rt.submit_bfs(1)
+    rt.step(drain=True)
+    assert fut.result(timeout=0).kind == "bfs"
+    assert rt.stats.retries == 1
+    assert faults.fired("serve.launch") == 1
+    assert faults.journal == [("serve.launch", 1)]

@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# hgfault chaos gate: the short deterministic multi-seed fault-injection
+# suite — registry/breaker units, serve failure paths, peer self-healing,
+# crash-atomic checkpoints, the kill→reopen→replay recovery drill, and
+# the 5-seed chaos soak (serve + concurrent ingest + replication under a
+# pre-drawn fault schedule; same seed → same fault sequence).
+#
+# The long combined soak is marked `slow` (excluded here, mirroring the
+# PR-4 tier-1 convention); run it with: tools/chaos.sh -m slow
+#
+# Usage: tools/chaos.sh [extra pytest args]
+#   tools/chaos.sh -k breaker          # one area, fast local run
+#   tools/chaos.sh -m slow             # the long soak only
+set -uo pipefail
+cd "$(dirname "$0")/.."
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
+    tests/test_fault.py \
+    tests/test_serve_fault.py \
+    tests/test_peer_fault.py \
+    tests/test_recovery_drill.py \
+    tests/test_chaos.py \
+    -q -m 'not slow' -p no:cacheprovider "$@"
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "tools/chaos.sh: chaos gate failed (exit $rc)" >&2
+fi
+exit "$rc"
