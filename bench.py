@@ -56,7 +56,11 @@ def _telemetry_begin() -> None:
     process registry and trace buffer are RESET here so each config's
     dump reports only its own run — on the default isolated path the
     reset is a no-op (fresh subprocess); on BENCH_ISOLATE=0 it is what
-    keeps telemetry_c4.prom from accumulating c3's counters."""
+    keeps telemetry_c4.prom from accumulating c3's counters.
+
+    ``BENCH_TRACE_SAMPLE`` (a rate in [0, 1], default 1.0) sets the
+    head sample rate for the run — how BENCH_C6 exercises the 1%-
+    sampling production posture; errors/sheds stay always-sampled."""
     if _telemetry_dir():
         from hypergraphdb_tpu import obs
         from hypergraphdb_tpu.utils.metrics import global_metrics
@@ -65,12 +69,18 @@ def _telemetry_begin() -> None:
         # memoized instruments, but anything registered directly on the
         # default registry must be cleared too
         global_metrics.registry.reset()
-        obs.enable().drain()
+        tracer = obs.enable()
+        tracer.drain()
+        rate = os.environ.get("BENCH_TRACE_SAMPLE")
+        if rate is not None:
+            tracer.default_sample_rate = min(1.0, max(0.0, float(rate)))
 
 
 def _telemetry_dump(name: str, registries=()) -> dict:
     """Write the registry + trace dumps for one config; no-op without
-    --telemetry. Returns {"prometheus": path, "traces": path} or {}."""
+    --telemetry. Returns the paths plus the tracer's sampling/buffer
+    counters (``sampling``) — the record of whether the finished-trace
+    buffer ever saturated under this config's load."""
     out_dir = _telemetry_dir()
     if not out_dir:
         return {}
@@ -78,11 +88,13 @@ def _telemetry_dump(name: str, registries=()) -> dict:
     from hypergraphdb_tpu.utils.metrics import global_metrics
 
     regs = list(registries) + [global_metrics.registry]
+    sampling = obs.tracer().sampling_snapshot()  # BEFORE drain empties it
     paths = obs.write_telemetry(
         os.path.join(out_dir, f"telemetry_{name}"),
         registries=regs, tracer=obs.tracer(),
     )
-    return {"prometheus": paths["prometheus"], "traces": paths["traces"]}
+    return {"prometheus": paths["prometheus"], "traces": paths["traces"],
+            "sampling": sampling}
 
 
 def _enable_compile_cache() -> None:
@@ -824,24 +836,31 @@ def bench_c6():
     wt.start()
     gaps = r.exponential(1.0 / offered_qps, size=n_requests)
     futs = []
-    t0 = time.perf_counter()
-    next_t = t0
-    for i in range(n_requests):
-        next_t += gaps[i]
-        pause = next_t - time.perf_counter()
-        if pause > 0:
-            time.sleep(pause)
-        futs.append(rt.submit_bfs(int(seeds[i]), max_hops=hops,
-                                  deadline_s=deadline_s))
-    served = shed = 0
-    for f in futs:
-        try:
-            res = f.result(timeout=300)
-            assert res.count >= 0
-            served += 1
-        except DeadlineExceeded:
-            shed += 1
-    wall = time.perf_counter() - t0
+    # opt-in profiler session (BENCH_C6_PROFILE=<logdir>): every kernel
+    # dispatch inside carries a TraceAnnotation naming its batch kind,
+    # bucket, and double-buffer slot, so the captured device timeline is
+    # attributable per batch (obs.device docs)
+    from hypergraphdb_tpu import obs
+
+    with obs.profile(os.environ.get("BENCH_C6_PROFILE")):
+        t0 = time.perf_counter()
+        next_t = t0
+        for i in range(n_requests):
+            next_t += gaps[i]
+            pause = next_t - time.perf_counter()
+            if pause > 0:
+                time.sleep(pause)
+            futs.append(rt.submit_bfs(int(seeds[i]), max_hops=hops,
+                                      deadline_s=deadline_s))
+        served = shed = 0
+        for f in futs:
+            try:
+                res = f.result(timeout=300)
+                assert res.count >= 0
+                served += 1
+            except DeadlineExceeded:
+                shed += 1
+        wall = time.perf_counter() - t0
     wt.join()
     rt.close(drain=True, timeout=120)
     s = rt.stats_snapshot()
@@ -886,6 +905,10 @@ def bench_c6():
         ) if ingested["s"] else None,
     }
     if telemetry:
+        # the SAME sampling snapshot the telemetry sidecar carries also
+        # rides the recorded result (telemetry itself is excluded from
+        # BENCH_C6_<tag>.json) — one capture, so the two can't disagree
+        out["tracing"] = telemetry["sampling"]
         out["telemetry"] = telemetry
     out["recorded_to"] = _record_c6(out)
     return out

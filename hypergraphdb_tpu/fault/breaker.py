@@ -18,13 +18,19 @@ States and the numeric codes the ``serve.breaker_state`` gauge exports::
     half_open   --probe failure---------->  open (2)
 
 Lock discipline: one lock guards all gates; the ``on_state`` /
-``on_trip`` callbacks run UNDER it, so state-change notifications are
-serialized in transition order — two racing transitions can never apply
-their gauge writes reversed and leave ``serve.breaker_state`` stale.
-Callbacks must therefore be cheap instrument writes (the wired ones are:
-a gauge set / counter inc, each behind its own leaf lock; nothing takes
-the breaker lock while holding an instrument lock, so the one-way
-nesting is HG401-clean) and must never call back into the breaker.
+``on_trip`` / ``on_key_state`` / ``on_key_trip`` callbacks run UNDER it,
+so state-change notifications are serialized in transition order — two
+racing transitions can never apply their gauge writes reversed and leave
+``serve.breaker_state`` stale. Callbacks must therefore be cheap
+instrument writes (the wired ones are: a gauge set / counter inc, each
+behind its own leaf lock; nothing takes the breaker lock while holding
+an instrument lock, so the one-way nesting is HG401-clean) and must
+never call back into the breaker.
+
+Observability: every transition lands one event in the process flight
+recorder; a trip is an **incident** (the recorder dumps its window —
+rate-limited file IO on an already-degraded path, the one deliberate
+exception to "callbacks are leaf instrument writes").
 """
 
 from __future__ import annotations
@@ -32,6 +38,10 @@ from __future__ import annotations
 import threading
 import time
 from typing import Callable, Optional
+
+from hypergraphdb_tpu.obs.flight import global_flight
+
+_FLIGHT = global_flight()
 
 CLOSED = "closed"
 HALF_OPEN = "half_open"
@@ -58,7 +68,9 @@ class CircuitBreaker:
     def __init__(self, threshold: int = 3, cooldown_s: float = 0.25,
                  clock: Optional[Callable[[], float]] = None,
                  on_state: Optional[Callable[[int], None]] = None,
-                 on_trip: Optional[Callable[[], None]] = None):
+                 on_trip: Optional[Callable[[], None]] = None,
+                 on_key_state: Optional[Callable] = None,
+                 on_key_trip: Optional[Callable] = None):
         if threshold < 1:
             raise ValueError("breaker threshold must be >= 1")
         self.threshold = int(threshold)
@@ -66,6 +78,10 @@ class CircuitBreaker:
         self.clock = clock or time.monotonic
         self.on_state = on_state      # worst STATE_CODES value, post-change
         self.on_trip = on_trip        # called on every -> OPEN transition
+        #: per-key views (the labelled metrics): (key, STATE_CODES value)
+        #: after every transition of THAT key / (key,) on its trips
+        self.on_key_state = on_key_state
+        self.on_key_trip = on_key_trip
         self._lock = threading.Lock()
         self._gates: dict = {}
         self._trips = 0
@@ -85,7 +101,7 @@ class CircuitBreaker:
                     return False
                 g.state = HALF_OPEN
                 g.probe_t = now
-                self._notify_locked()
+                self._notify_locked(key, g)
                 return True
             # HALF_OPEN: one probe per cooldown window
             if g.probe_t is not None and now - g.probe_t < self.cooldown_s:
@@ -98,10 +114,12 @@ class CircuitBreaker:
         with self._lock:
             g = self._gates.get(key)
             if g is not None and (g.state != CLOSED or g.failures):
+                notify = g.state != CLOSED
                 g.state = CLOSED
                 g.failures = 0
                 g.probe_t = None
-                self._notify_locked()
+                if notify:
+                    self._notify_locked(key, g)
 
     def record_failure(self, key) -> None:
         """A device batch for ``key`` failed (launch or collect)."""
@@ -115,29 +133,49 @@ class CircuitBreaker:
                 g.opened_t = self.clock()
                 g.probe_t = None
                 self._trips += 1
-                self._notify_locked(tripped=True)
+                self._notify_locked(key, g, tripped=True)
             elif g.state == CLOSED:
                 g.failures += 1
                 if g.failures >= self.threshold:
                     g.state = OPEN
                     g.opened_t = self.clock()
                     self._trips += 1
-                    self._notify_locked(tripped=True)
+                    self._notify_locked(key, g, tripped=True)
             # OPEN: late failures from in-flight batches change nothing
 
-    def _notify_locked(self, tripped: bool = False) -> None:
+    def _notify_locked(self, key, gate: _Gate,
+                       tripped: bool = False) -> None:
         """State-change callbacks, serialized by the caller-held lock
-        (see module docstring for why and what callbacks may do)."""
+        (see module docstring for why and what callbacks may do).
+        Also the flight-recorder tap: one ring append per transition,
+        incident (rate-limited dump) on every trip."""
+        if _FLIGHT.enabled:
+            _FLIGHT.record("breaker.transition", key=str(key),
+                           state=gate.state)
         if self.on_state is not None:
             self.on_state(self._worst_locked())
-        if tripped and self.on_trip is not None:
-            self.on_trip()
+        if self.on_key_state is not None:
+            self.on_key_state(key, STATE_CODES[gate.state])
+        if tripped:
+            if self.on_trip is not None:
+                self.on_trip()
+            if self.on_key_trip is not None:
+                self.on_key_trip(key)
+            if _FLIGHT.enabled:
+                _FLIGHT.incident("breaker_trip", key=str(key))
 
     # -- reading -------------------------------------------------------------
     def state_of(self, key) -> str:
         with self._lock:
             g = self._gates.get(key)
             return CLOSED if g is None else g.state
+
+    def states(self) -> dict:
+        """Every key's current gate state — the per-key ``/healthz``
+        view (keys with no gate yet have implicitly closed gates and do
+        not appear)."""
+        with self._lock:
+            return {k: g.state for k, g in self._gates.items()}
 
     def worst_code(self) -> int:
         with self._lock:

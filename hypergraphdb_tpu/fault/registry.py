@@ -29,8 +29,11 @@ Schedule kinds (first match wins: ``at`` > ``times`` > ``prob``):
   ``times``/``prob`` count only MATCHED hits, so a filter like "transfer
   chunks only" keeps unrelated traffic out of the schedule arithmetic.
 
-Every fire appends ``(name, hit_index)`` to :attr:`FaultRegistry.journal`
-and bumps the ``fault.injected`` counter in the process obs registry.
+Every fire appends ``(name, hit_index)`` to :attr:`FaultRegistry.journal`,
+bumps the ``fault.injected`` counter in the process obs registry, and
+lands a ``fault.fired`` event in the process flight recorder — so every
+injected-fault test doubles as a flight-recorder fixture and an incident
+dump always shows the faults that led up to it.
 """
 
 from __future__ import annotations
@@ -40,6 +43,9 @@ import threading
 from typing import Callable, Optional
 
 from hypergraphdb_tpu.fault.errors import FaultError, TransientFault
+from hypergraphdb_tpu.obs.flight import global_flight
+
+_FLIGHT = global_flight()
 
 
 class _Point:
@@ -162,13 +168,16 @@ class FaultRegistry:
             pt.fired += 1
             self.journal.append((name, idx))
             err = pt.error
-        # construct + count outside the lock: error factories and the
-        # metrics instrument take their own locks
+        # construct + count + record outside the lock: error factories,
+        # the metrics instrument, and the flight ring take their own paths
         exc = (err(name, idx) if not isinstance(err, type)
                else err(f"injected fault at {name!r} (hit {idx})"))
         from hypergraphdb_tpu.utils.metrics import global_metrics
 
         global_metrics.incr("fault.injected")
+        if _FLIGHT.enabled:
+            _FLIGHT.record("fault.fired", point=name, hit=idx,
+                           error=type(exc).__name__)
         raise exc
 
     # -- reading -------------------------------------------------------------

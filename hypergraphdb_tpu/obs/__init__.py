@@ -15,27 +15,44 @@ this repro had faithfully reproduced as ``utils.metrics.Metrics`` vs
   counters/gauges/log-bucketed histograms under dotted namespaces
   (``serve.*``, ``graph.*``, ``compact.*``, ``query.*``, ``tx.*``);
 - **device timing** (:mod:`~hypergraphdb_tpu.obs.device`): opt-in
-  launch→ready wall deltas + a gated ``jax.profiler`` session;
+  launch→ready wall deltas, per-dispatch profiler annotations, and a
+  gated ``jax.profiler`` session;
 - **export** (:mod:`~hypergraphdb_tpu.obs.export`): Prometheus text and
-  schema-versioned JSONL traces.
+  schema-versioned JSONL traces;
+- **flight recorder** (:mod:`~hypergraphdb_tpu.obs.flight`): an
+  always-on bounded ring of recent structured events (span terminals,
+  fault firings, breaker transitions, retries, compaction swaps) that
+  dumps its window to JSONL on incident;
+- **HTTP endpoint** (:mod:`~hypergraphdb_tpu.obs.http`): ``/metrics``
+  (Prometheus scrape), ``/healthz`` (per-key breaker states + queue
+  depth + staleness), ``/debug/traces``, ``/debug/flight``.
+
+Cross-process tracing: trace contexts propagate over peer messages
+(``peer/messages.attach_trace``), so a replication push or snapshot
+transfer is ONE span tree spanning sender and receiver — see
+``obs.trace`` and README "Distributed tracing & operations".
 
 Overhead contract: with tracing DISABLED (the default), every
 instrumentation site costs one attribute read and allocates nothing —
-regression-tested by ``tests/test_obs_serving.py``.
+regression-tested by ``tests/test_obs_serving.py``. With tracing ON,
+head-based per-root-kind sampling (``tracer().set_sample_rate``) plus
+the always-sample overrides (errors, sheds, breaker trips) keep the
+finished-trace buffer bounded at production qps.
 
 Usage::
 
     from hypergraphdb_tpu import obs
 
     obs.enable()                      # tracing on, process-wide
+    obs.tracer().set_sample_rate("serve.request", 0.01)
     ... serve / query / compact ...
     print(obs.export.prometheus_text(rt.stats.registry))
     for t in obs.tracer().drain():
         ...
 """
 
-from hypergraphdb_tpu.obs import device, export
-from hypergraphdb_tpu.obs.device import block_timed, profile
+from hypergraphdb_tpu.obs import device, export, flight, http
+from hypergraphdb_tpu.obs.device import annotate, block_timed, profile
 from hypergraphdb_tpu.obs.export import (
     TRACE_SCHEMA_VERSION,
     parse_traces_jsonl,
@@ -43,6 +60,16 @@ from hypergraphdb_tpu.obs.export import (
     trace_to_dict,
     traces_to_jsonl,
     write_telemetry,
+)
+from hypergraphdb_tpu.obs.flight import (
+    FlightRecorder,
+    global_flight,
+    parse_flight_jsonl,
+)
+from hypergraphdb_tpu.obs.http import (
+    TelemetryServer,
+    breaker_key_label,
+    runtime_health,
 )
 from hypergraphdb_tpu.obs.registry import (
     Counter,
@@ -72,23 +99,32 @@ def disable() -> Tracer:
 __all__ = [
     "Clock",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "Registry",
     "Span",
     "TRACE_SCHEMA_VERSION",
+    "TelemetryServer",
     "Trace",
     "Tracer",
+    "annotate",
     "block_timed",
+    "breaker_key_label",
     "default_registry",
     "device",
     "disable",
     "enable",
     "export",
+    "flight",
+    "global_flight",
     "global_tracer",
+    "http",
+    "parse_flight_jsonl",
     "parse_traces_jsonl",
     "profile",
     "prometheus_text",
+    "runtime_health",
     "trace_to_dict",
     "tracer",
     "traces_to_jsonl",

@@ -11,9 +11,21 @@ caller. It is OPT-IN (``ServeConfig.device_timing``) because the block
 itself serializes the pipeline's collect side a little earlier than a
 plain download would.
 
-:func:`profile` wraps ``jax.profiler.trace`` as a context manager that is
-a clean no-op when given no directory (or when jax/profiling is
-unavailable) — so call sites can carry a profile knob unconditionally.
+**Per-hop attribution via the profiler**: within one double-buffered
+dispatch pipeline, ``block_timed`` can only see whole-batch wall deltas —
+what ran INSIDE the kernel (and which pipeline slot a batch occupied) is
+the profiler's to answer. :func:`profile` opens a ``jax.profiler`` trace
+session; while one is active (``profiling()``), the serving executor
+wraps every kernel dispatch in :func:`annotate` — a named
+``jax.profiler.TraceAnnotation`` carrying the batch kind, bucket, and
+double-buffer slot — so the profile's device timeline is attributable
+per batch: which slot launched it, what overlapped it, how long the
+device actually ran. Each ``device`` span likewise carries its ``slot``
+(dispatch sequence mod 2), closing the host-side half of the PR-5
+"per-hop device spans need profiler integration" follow-up.
+
+Both hooks are clean no-ops when jax/profiling is unavailable — call
+sites carry the knob unconditionally.
 
 No module-level jax import: the deterministic tier-1 tests import obs
 with zero device work.
@@ -23,6 +35,32 @@ from __future__ import annotations
 
 from contextlib import contextmanager
 from typing import Callable, Optional
+
+#: True while a profile() session is open — the serving executor gates
+#: its per-dispatch TraceAnnotations on (device_timing or this), so a
+#: plain run pays nothing for annotation support
+_PROFILING = False
+
+
+def profiling() -> bool:
+    """Whether an ``obs.profile`` session is currently active."""
+    return _PROFILING
+
+
+@contextmanager
+def annotate(name: str):
+    """A named ``jax.profiler.TraceAnnotation`` around a host-side
+    dispatch (NOT inside jit — purity of the traced graph is hgverify
+    HV1xx territory); a no-op when the profiler is unavailable."""
+    try:
+        import jax
+
+        ann = jax.profiler.TraceAnnotation(name)
+    except Exception:
+        yield False
+        return
+    with ann:
+        yield True
 
 
 def block_timed(handles, clock: Callable[[], float]) -> tuple:
@@ -40,7 +78,11 @@ def block_timed(handles, clock: Callable[[], float]) -> tuple:
 def profile(logdir: Optional[str]):
     """A ``jax.profiler`` trace session writing to ``logdir``; a no-op
     context when ``logdir`` is falsy or the profiler is unavailable (CPU
-    CI images without profiling support must not error)."""
+    CI images without profiling support must not error). Sets the
+    :func:`profiling` flag so dispatch sites turn their per-batch
+    :func:`annotate` markers on for the session's duration."""
+    global _PROFILING
+
     if not logdir:
         yield False
         return
@@ -51,9 +93,11 @@ def profile(logdir: Optional[str]):
     except Exception:
         yield False
         return
+    _PROFILING = True
     try:
         yield True
     finally:
+        _PROFILING = False
         try:
             jax.profiler.stop_trace()
         except Exception:  # a torn session must not mask the workload error

@@ -23,7 +23,28 @@ Safety properties that make tracing reasonable to leave on:
   counter, not an OOM);
 - **bounded retention**: finished traces land in a ``maxlen`` deque on
   the tracer (``drain()`` hands them to the exporter); a server nobody
-  scrapes stays O(max_finished), not O(requests).
+  scrapes stays O(max_finished), not O(requests);
+- **head-based sampling** (production qps): per-root-kind sample rates
+  (``set_sample_rate``) decide AT START whether a trace will be
+  retained. Unsampled traces still record spans (bounded as above) but
+  are discarded at finish — unless something upgrades them: the
+  ``error``/``shed`` terminals and explicit :meth:`Trace.force_sample`
+  calls (breaker trips) always retain, so incidents are captured at
+  100% no matter how low the rate. An optional adaptive controller
+  (:meth:`Tracer.enable_adaptive`) scales every rate down when the
+  finished-trace buffer fills faster than it is drained, and back up
+  when pressure clears — always-on tracing degrades to a lower rate,
+  never to buffer overflow.
+
+**Cross-process propagation**: :meth:`Trace.context` emits a compact
+wire context ``{"tid", "sid", "s"}`` (trace id, parent span id, sampling
+decision); :meth:`Tracer.start_remote_trace` opens the receiving side's
+trace UNDER that context — same trace id, root spans parented on the
+propagated remote span id, the sender's sampling decision honored — so a
+replication push or snapshot transfer renders as ONE span tree spanning
+sender and receiver (join the two tracers' drains on ``trace_id``).
+Trace ids carry a per-process random high-bits base, so trees from two
+real processes cannot collide.
 
 No jax imports — the deterministic tier-1 tests drive everything with a
 fake clock and zero device work.
@@ -32,11 +53,14 @@ fake clock and zero device work.
 from __future__ import annotations
 
 import itertools
+import random
 import threading
 import time
 from collections import deque
 from contextlib import contextmanager
 from typing import Callable, Optional
+
+from hypergraphdb_tpu.obs.flight import global_flight as _global_flight
 
 #: injectable time source (seconds, monotonic) — tests pass a fake
 Clock = Callable[[], float]
@@ -44,7 +68,20 @@ Clock = Callable[[], float]
 #: attribute value types the JSONL exporter commits to (schema v1)
 ATTR_TYPES = (bool, int, float, str, type(None))
 
+#: terminal span names that force-sample their trace (the always-capture
+#: set: a failed or shed request is exactly the trace worth keeping)
+ALWAYS_SAMPLE_TERMINALS = frozenset({"error", "shed"})
+
 _ids = itertools.count(1)
+
+#: per-process random high bits for trace AND span ids: a joined
+#: cross-process tree is reconstructed by (trace id, parent span id), so
+#: BOTH key spaces must be collision-free across processes — a server
+#: span whose local id equals the client's propagated parent id would
+#: misattach the remote subtree
+_TRACE_ID_BASE = random.SystemRandom().getrandbits(20) << 42
+
+_FLIGHT = _global_flight()
 
 
 class Span:
@@ -55,7 +92,7 @@ class Span:
 
     def __init__(self, trace: "Trace", name: str,
                  parent_id: Optional[int], t0: float, attrs: dict):
-        self.span_id = next(_ids)
+        self.span_id = _TRACE_ID_BASE + next(_ids)
         self.parent_id = parent_id
         self.name = name
         self.t0 = t0
@@ -103,13 +140,24 @@ class Trace:
 
     def __init__(self, name: str, clock: Clock, max_spans: int,
                  attrs: Optional[dict] = None,
-                 owner: Optional["Tracer"] = None):
+                 owner: Optional["Tracer"] = None,
+                 trace_id: Optional[int] = None,
+                 remote_parent: Optional[int] = None,
+                 sampled: bool = True):
         self.name = name
         self.clock = clock
         self.max_spans = max_spans
         self._owner = owner
         self.attrs = dict(attrs or {})
-        self.trace_id = next(_ids)
+        self.trace_id = (_TRACE_ID_BASE + next(_ids)
+                         if trace_id is None else int(trace_id))
+        #: propagated remote span id: parentless spans of this trace
+        #: attach under it, so the receiver's subtree hangs off the
+        #: sender's span in the joined tree (None for local roots)
+        self.remote_parent = remote_parent
+        #: head-based sampling decision — set at start, upgradable by
+        #: force_sample(); unsampled traces are discarded at retain time
+        self.sampled = sampled
         self.t0 = clock()
         self.t1: Optional[float] = None
         self.dropped = 0
@@ -127,7 +175,7 @@ class Trace:
         resolved) are silently detached — the returned span is real but
         unrecorded in both cases, so call sites never branch."""
         span = Span(self, name,
-                    None if parent is None else parent.span_id,
+                    self.remote_parent if parent is None else parent.span_id,
                     self.clock() if t0 is None else t0, {})
         if attrs:
             span.set(**attrs)
@@ -159,10 +207,18 @@ class Trace:
         """Record a terminal span (``resolve`` / ``shed`` / ``error`` …)
         under ``parent`` (default: the ``root`` mark) and finish the
         trace — the ONE place the terminal-span schema lives, shared by
-        the serve, query, and compaction producers. No-op on an
-        already-finished trace."""
+        the serve, query, compaction, and peer producers. The
+        always-sample terminals (``error``/``shed``) upgrade an
+        unsampled trace so incidents survive any sampling rate, and
+        every terminal lands one event in the flight recorder. No-op on
+        an already-finished trace."""
         if self.finished:
             return
+        if name in ALWAYS_SAMPLE_TERMINALS:
+            self.force_sample()
+        if _FLIGHT.enabled:
+            _FLIGHT.record("trace.terminal", trace=self.name,
+                           terminal=name)
         self.start_span(
             name,
             parent=parent if parent is not None else self.marks.get("root"),
@@ -198,6 +254,26 @@ class Trace:
         with self._lock:
             return self._finished
 
+    def force_sample(self) -> None:
+        """Upgrade the head-based sampling decision: retain this trace
+        regardless of the rate it was started under (errors, sheds,
+        breaker trips — the traces an operator is actually hunting)."""
+        with self._lock:
+            self.sampled = True
+
+    # -- cross-process propagation -------------------------------------------
+    def context(self, span: Optional[Span] = None) -> dict:
+        """The compact wire context carried on peer messages:
+        ``{"tid": trace id, "sid": parent span id, "s": sampled}``.
+        ``span`` names the local span remote children should hang under
+        (default: the ``root`` mark, else the propagated parent)."""
+        if span is None:
+            span = self.marks.get("root")
+        sid = span.span_id if span is not None else (self.remote_parent or 0)
+        with self._lock:
+            s = 1 if self.sampled else 0
+        return {"tid": self.trace_id, "sid": sid, "s": s}
+
     # -- reading -------------------------------------------------------------
     def spans(self) -> list[Span]:
         with self._lock:
@@ -222,14 +298,36 @@ class Tracer:
 
     ``enabled`` is the zero-cost gate: every ``start_trace`` caller checks
     it first (one attribute read); while False nothing is allocated and
-    ``start_trace`` returns None."""
+    ``start_trace`` returns None.
+
+    Sampling: ``default_sample_rate`` (1.0 = everything) with per-root-kind
+    overrides (``set_sample_rate("serve.request", 0.01)``). The decision is
+    made at ``start_trace`` (head-based) from a seeded RNG; unsampled
+    traces still run (bounded) but are counted into ``traces_dropped``
+    instead of retained — unless an always-sample terminal or
+    ``force_sample()`` upgrades them. ``enable_adaptive()`` adds the rate
+    controller: when the finished buffer fills past ``target_fill`` the
+    effective rate scales down (never below ``floor``); a drain that finds
+    the pressure gone scales it back up toward 1.0."""
 
     def __init__(self, clock: Optional[Clock] = None, max_spans: int = 64,
-                 max_finished: int = 1024):
+                 max_finished: int = 1024, seed: Optional[int] = None):
         self.clock: Clock = clock or time.perf_counter
         self.max_spans = max_spans
         self.enabled = False
         self.traces_started = 0
+        #: unsampled traces discarded at finish (never buffered)
+        self.traces_dropped = 0
+        #: sampled traces that pushed the FULL buffer (oldest evicted) —
+        #: nonzero means the scraper/drain cadence lost data
+        self.traces_evicted = 0
+        self.default_sample_rate = 1.0
+        self._rates: dict[str, float] = {}
+        self._rng = random.Random(seed)
+        # adaptive controller state (None target = controller off)
+        self._adapt_target: Optional[float] = None
+        self._adapt_floor = 0.01
+        self._adapt_scale = 1.0
         self._lock = threading.Lock()
         self._finished: deque[Trace] = deque(maxlen=max_finished)
         self._tls = threading.local()
@@ -247,17 +345,85 @@ class Tracer:
             self.enabled = False
         return self
 
+    # -- sampling knobs ------------------------------------------------------
+    def set_sample_rate(self, name: str, rate: float) -> "Tracer":
+        """Per-root-kind head sample rate (exact trace-name match, e.g.
+        ``"serve.request"``); rates outside [0, 1] are clamped."""
+        with self._lock:
+            self._rates[name] = min(1.0, max(0.0, float(rate)))
+        return self
+
+    def sample_rate_of(self, name: str) -> float:
+        """The EFFECTIVE rate for ``name`` (configured × adaptive scale)."""
+        with self._lock:
+            return self._rates.get(name,
+                                   self.default_sample_rate) * self._adapt_scale
+
+    def enable_adaptive(self, target_fill: float = 0.5,
+                        floor: float = 0.01) -> "Tracer":
+        """Turn the rate controller on: when a retain finds the finished
+        buffer past ``target_fill`` of its capacity, halve the global
+        rate scale (never below ``floor``); a drain that finds the buffer
+        under half the target doubles it back toward 1.0. Bounded-buffer
+        fill is the controlled variable, so always-on tracing sheds RATE
+        under pressure instead of overflowing."""
+        with self._lock:
+            self._adapt_target = min(1.0, max(0.0, float(target_fill)))
+            self._adapt_floor = float(floor)
+        return self
+
+    def sampling_snapshot(self) -> dict:
+        """The sampling/buffer counters one dict deep — what
+        ``bench.py --telemetry`` records per config."""
+        with self._lock:
+            return {
+                "default_rate": self.default_sample_rate,
+                "rates": dict(self._rates),
+                "adaptive_scale": self._adapt_scale,
+                "traces_started": self.traces_started,
+                "traces_dropped_unsampled": self.traces_dropped,
+                "traces_evicted": self.traces_evicted,
+                "finished_fill": len(self._finished),
+                "finished_capacity": self._finished.maxlen,
+            }
+
     # -- explicit API (cross-thread chains) ----------------------------------
     def start_trace(self, name: str, **attrs) -> Optional[Trace]:
         """A new trace, or None when tracing is off — callers thread the
         returned handle (e.g. on a serve Ticket) and call ``finish_trace``
-        when the request resolves."""
+        when the request resolves. The head-based sampling decision is
+        drawn HERE; an unsampled trace still records (bounded) so a later
+        error/shed terminal can upgrade it."""
         if not self.enabled:
             return None
-        tr = Trace(name, self.clock, self.max_spans, attrs, owner=self)
         with self._lock:
             self.traces_started += 1
-        return tr
+            rate = self._rates.get(name,
+                                   self.default_sample_rate) * self._adapt_scale
+            sampled = rate >= 1.0 or self._rng.random() < rate
+        return Trace(name, self.clock, self.max_spans, attrs, owner=self,
+                     sampled=sampled)
+
+    def start_remote_trace(self, name: str, ctx: Optional[dict],
+                           **attrs) -> Optional[Trace]:
+        """The receiving half of cross-process propagation: a trace that
+        JOINS the context's tree — same trace id, parentless spans hang
+        under the propagated span id, and the SENDER's head sampling
+        decision is honored (no local draw, so both halves of a tree are
+        kept or dropped together). None when tracing is off or no context
+        arrived (then callers fall back to ``start_trace`` or nothing)."""
+        if not self.enabled or not ctx:
+            return None
+        try:
+            tid = int(ctx["tid"])
+            sid = int(ctx["sid"]) or None
+            sampled = bool(ctx.get("s", 1))
+        except (KeyError, TypeError, ValueError):
+            return None  # malformed context from a foreign/older peer
+        with self._lock:
+            self.traces_started += 1
+        return Trace(name, self.clock, self.max_spans, attrs, owner=self,
+                     trace_id=tid, remote_parent=sid, sampled=sampled)
 
     def finish_trace(self, trace: Optional[Trace]) -> None:
         """Close + retain a trace (idempotent, None-tolerant)."""
@@ -266,7 +432,18 @@ class Tracer:
 
     def _retain(self, trace: Trace) -> None:
         with self._lock:
+            if not trace.sampled:
+                self.traces_dropped += 1
+                return
+            if len(self._finished) == self._finished.maxlen:
+                self.traces_evicted += 1  # deque evicts the oldest
             self._finished.append(trace)
+            if (self._adapt_target is not None
+                    and self._finished.maxlen
+                    and len(self._finished)
+                    >= self._adapt_target * self._finished.maxlen):
+                self._adapt_scale = max(self._adapt_floor,
+                                        self._adapt_scale * 0.5)
 
     # -- implicit API (single-thread chains) ---------------------------------
     @contextmanager
@@ -317,11 +494,24 @@ class Tracer:
 
     # -- reading -------------------------------------------------------------
     def drain(self) -> list[Trace]:
-        """Pop every finished trace (export consumes the buffer)."""
+        """Pop every finished trace (export consumes the buffer). With
+        the adaptive controller on, a drain that finds the pressure gone
+        grows the rate scale back toward 1.0."""
         with self._lock:
             out = list(self._finished)
             self._finished.clear()
+            if (self._adapt_target is not None and self._finished.maxlen
+                    and len(out)
+                    < 0.5 * self._adapt_target * self._finished.maxlen):
+                self._adapt_scale = min(1.0, self._adapt_scale * 2.0)
             return out
+
+    def peek(self, n: Optional[int] = None) -> list[Trace]:
+        """The most recent finished traces WITHOUT consuming them — the
+        ``/debug/traces`` read (drain() stays the exporter's)."""
+        with self._lock:
+            out = list(self._finished)
+        return out if n is None else out[-int(n):]
 
     def finished_count(self) -> int:
         with self._lock:

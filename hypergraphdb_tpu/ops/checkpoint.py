@@ -160,6 +160,7 @@ def load_snapshot(path: str) -> CSRSnapshot:
         except StalePlans:
             pass  # another snapshot's plans (by design) → plans_for rebuilds
         except Exception:
+            from hypergraphdb_tpu.obs.flight import global_flight
             from hypergraphdb_tpu.utils.metrics import global_metrics
 
             _log.warning(
@@ -167,6 +168,9 @@ def load_snapshot(path: str) -> CSRSnapshot:
                 "be rebuilt", pp, exc_info=True,
             )
             global_metrics.incr("fault.sidecar_corrupt")
+            # a corrupt sidecar on reopen is the durable trace of a
+            # crash/bit-rot — incident: dump what this process saw
+            global_flight().incident("sidecar_corrupt", path=str(pp))
     return snap
 
 

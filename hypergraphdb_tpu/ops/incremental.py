@@ -391,6 +391,14 @@ class SnapshotManager:
         m.incr("compact.passes")
         m.observe("compact.extract_seconds", t1 - t0)
         m.observe("compact.assemble_swap_seconds", t2 - t1)
+        from hypergraphdb_tpu.obs.flight import global_flight
+
+        fl = global_flight()
+        if fl.enabled:
+            # the swap is the event serving consistency pivots on — one
+            # ring append so an incident dump shows every recent epoch
+            fl.record("compact.swap", highwater=int(ext["highwater"]),
+                      total_s=t2 - t0)
 
     def _request_compact(self) -> None:
         if not self.background:

@@ -111,14 +111,23 @@ class Activity:
                 )
 
     # -- conveniences --------------------------------------------------------
-    def send(self, target: str, performative: str, content: Any = None) -> None:
+    def send(self, target: str, performative: str, content: Any = None,
+             trace_ctx: Optional[dict] = None) -> None:
+        """Send an activity message; ``trace_ctx`` (a ``Trace.context()``
+        dict) stamps it for cross-process span-tree propagation."""
         self.peer.interface.send(
-            target, M.make_message(performative, self.TYPE, content, self.id)
+            target, M.attach_trace(
+                M.make_message(performative, self.TYPE, content, self.id),
+                trace_ctx,
+            )
         )
 
     def reply(self, target: str, msg: dict, performative: str,
-              content: Any = None) -> None:
-        self.peer.interface.send(target, M.reply_to(msg, performative, content))
+              content: Any = None,
+              trace_ctx: Optional[dict] = None) -> None:
+        self.peer.interface.send(target, M.attach_trace(
+            M.reply_to(msg, performative, content), trace_ctx,
+        ))
 
 
 class ActivityManager:

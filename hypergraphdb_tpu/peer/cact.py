@@ -26,7 +26,11 @@ from hypergraphdb_tpu.query import serialize as qser
 
 
 class RemoteOpClient(Activity):
-    """Generic request/response client activity."""
+    """Generic request/response client activity. Traced: each op roots a
+    ``peer.op`` trace whose context rides the REQUEST, so the server's
+    ``op_serve`` span joins the same tree (remote-child parenting) — the
+    ``RemoteGraphView`` window and every ``HyperGraphPeer.*_remote`` call
+    get cross-process attribution for free."""
 
     TYPE = "cact"
 
@@ -35,16 +39,31 @@ class RemoteOpClient(Activity):
         super().__init__(peer, activity_id)
         self.target = target
         self.op = op or {}
+        self._trace = None
 
     def initiate(self) -> None:
-        self.send(self.target, M.REQUEST, self.op)
+        tracer = self.peer.tracer
+        ctx = None
+        if tracer.enabled:
+            self._trace = tr = tracer.start_trace(
+                "peer.op", op=str(self.op.get("op")), target=self.target,
+            )
+            if tr is not None:
+                tr.marks["root"] = tr.start_span(
+                    "op", op=str(self.op.get("op")))
+                ctx = tr.context()
+        self.send(self.target, M.REQUEST, self.op, trace_ctx=ctx)
 
     @from_state(STARTED, M.INFORM)
     def on_result(self, sender: str, msg: dict) -> None:
+        if self._trace is not None:
+            self._trace.finish_terminal("resolve")
         self.complete(msg["content"])
 
     @from_state(STARTED, M.FAILURE)
     def on_failure(self, sender: str, msg: dict) -> None:
+        if self._trace is not None:
+            self._trace.finish_terminal("error", error="RemoteFailure")
         self.fail(RuntimeError(str(msg["content"])))
 
 
@@ -58,18 +77,31 @@ class RemoteOpServer(Activity):
     @from_state(STARTED, M.REQUEST)
     def on_request(self, sender: str, msg: dict) -> None:
         op = msg["content"] or {}
+        tracer = self.peer.tracer
+        tr = (tracer.start_remote_trace("peer.op.serve",
+                                        M.trace_context(msg), peer=sender)
+              if tracer.enabled else None)
+        if tr is not None:
+            tr.marks["root"] = tr.start_span("op_serve",
+                                             op=str(op.get("op")))
         handler = self.OPS.get(op.get("op"))
         if handler is None:
             self.reply(sender, msg, M.FAILURE, f"unknown op {op.get('op')}")
+            if tr is not None:
+                tr.finish_terminal("error", error="UnknownOp")
             self.fail(f"unknown op {op.get('op')}")
             return
         try:
             result = handler(self, op)
         except Exception as e:
             self.reply(sender, msg, M.FAILURE, f"{type(e).__name__}: {e}")
+            if tr is not None:
+                tr.finish_error(e)
             self.fail(e)
             return
         self.reply(sender, msg, M.INFORM, result)
+        if tr is not None:
+            tr.finish_terminal("served")
         self.complete(result)
 
     # -- op handlers (the cact/ class-per-op set) -------------------------
@@ -323,13 +355,27 @@ class TransferGraphClient(Activity):
         self.max_resumes = int(max_resumes)
         self._resumes = 0
         self._last_rx = 0.0
+        self._trace = None
+        self._tctx: Optional[dict] = None
 
     def initiate(self) -> None:
         import time as _time
 
         self._last_rx = _time.monotonic()
+        # the whole transfer is ONE cross-process trace: every client
+        # send carries the context (resumes may reach a FRESH server
+        # activity — it must still join the same tree)
+        tracer = self.peer.tracer
+        if tracer.enabled:
+            self._trace = tr = tracer.start_trace(
+                "peer.transfer", target=self.target, page=self.page,
+            )
+            if tr is not None:
+                tr.marks["root"] = tr.start_span("transfer",
+                                                 target=self.target)
+                self._tctx = tr.context()
         self.send(self.target, M.QUERY_REF,
-                  {"page": self.page, "pos": 0})
+                  {"page": self.page, "pos": 0}, trace_ctx=self._tctx)
 
     @from_state(STARTED, M.INFORM)
     def on_chunk(self, sender: str, msg: dict) -> None:
@@ -349,7 +395,8 @@ class TransferGraphClient(Activity):
             self.log_head = int(c.get("log_head", 0))
             self.expected = 0
             if int(c.get("pos", -1)) != 0:
-                self.reply(sender, msg, M.CONFIRM, {"pos": 0})
+                self.reply(sender, msg, M.CONFIRM, {"pos": 0},
+                           trace_ctx=self._tctx)
                 return
         elif self._snap is None:
             self._snap = tok
@@ -360,9 +407,15 @@ class TransferGraphClient(Activity):
             # duplicated/stale chunk (a redelivered page we already
             # applied, or one past a gap): applying would double-store or
             # skip — idempotently re-request OUR position instead
-            self.reply(sender, msg, M.CONFIRM, {"pos": self.expected})
+            self.reply(sender, msg, M.CONFIRM, {"pos": self.expected},
+                       trace_ctx=self._tctx)
             return
-        self.stored += len(transfer.store_closure(self.peer.graph, c["atoms"]))
+        n_applied = len(transfer.store_closure(self.peer.graph, c["atoms"]))
+        self.stored += n_applied
+        tr = self._trace
+        if tr is not None:
+            tr.start_span("apply_chunk", parent=tr.marks.get("root"),
+                          pos=pos, atoms=n_applied).end()
         self.expected = int(c.get("next", self.expected))
         self._resumes = 0  # progress: the resume budget is PER STALL —
         # a long transfer over a mildly lossy link must not exhaust a
@@ -375,12 +428,17 @@ class TransferGraphClient(Activity):
                 if self.log_head > rep.last_seen.get(sender, 0):
                     rep.last_seen.set(sender, self.log_head)
                 rep.needs_full_sync.discard(sender)
+            if tr is not None:
+                tr.finish_terminal("resolve", stored=self.stored)
             self.complete(self.stored)
         else:
-            self.reply(sender, msg, M.CONFIRM, {"pos": self.expected})
+            self.reply(sender, msg, M.CONFIRM, {"pos": self.expected},
+                       trace_ctx=self._tctx)
 
     @from_state(STARTED, M.FAILURE)
     def on_failure(self, sender: str, msg: dict) -> None:
+        if self._trace is not None:
+            self._trace.finish_terminal("error", error="RemoteFailure")
         self.fail(RuntimeError(str(msg["content"])))
 
     def tick(self, now: Optional[float] = None) -> bool:
@@ -404,20 +462,30 @@ class TransferGraphClient(Activity):
                 return False
             self._resumes += 1
             if self._resumes > self.max_resumes:
-                self.fail(TransientFault(
+                exc = TransientFault(
                     f"graph transfer from {self.target} stalled after "
                     f"{self.max_resumes} resume attempts"
-                ))
+                )
+                if self._trace is not None:
+                    self._trace.finish_error(exc)
+                self.fail(exc)
                 return False
             self._last_rx = now
             self.peer.graph.metrics.incr("peer.transfer_resumes")
+            tr = self._trace
+            if tr is not None:
+                tr.start_span("resume", parent=tr.marks.get("root"),
+                              pos=self.expected, attempt=self._resumes
+                              ).end()
             if self.log_head is None and self.expected == 0:
                 # nothing ever arrived: the opening exchange itself was
                 # eaten — re-open (the server side re-opens idempotently)
                 self.send(self.target, M.QUERY_REF,
-                          {"page": self.page, "pos": 0})
+                          {"page": self.page, "pos": 0},
+                          trace_ctx=self._tctx)
             else:
-                self.send(self.target, M.CONFIRM, {"pos": self.expected})
+                self.send(self.target, M.CONFIRM, {"pos": self.expected},
+                          trace_ctx=self._tctx)
             return True
 
 
@@ -437,6 +505,23 @@ class TransferGraphServer(Activity):
         self.page = 256
         self.log_head = 0
         self.snap_token: Optional[str] = None
+        self._trace = None
+
+    def _adopt_trace(self, msg: dict) -> None:
+        """Join the client's transfer trace (remote-child): the serve
+        subtree hangs under the client's ``transfer`` span. A fresh
+        server reached by a resume adopts the same context — one tree
+        per logical transfer, however many server activities it took."""
+        if self._trace is not None:
+            return
+        tracer = self.peer.tracer
+        if not tracer.enabled:
+            return
+        tr = tracer.start_remote_trace("peer.transfer.serve",
+                                       M.trace_context(msg))
+        if tr is not None:
+            tr.marks["root"] = tr.start_span("transfer_serve")
+            self._trace = tr
 
     def _snapshot(self) -> None:
         import uuid
@@ -454,11 +539,14 @@ class TransferGraphServer(Activity):
     @from_state(STARTED, M.QUERY_REF)
     def on_open(self, sender: str, msg: dict) -> None:
         c = msg["content"] or {}
+        self._adopt_trace(msg)
         try:
             self.page = max(1, int(c.get("page", 256)))
             self._snapshot()
         except Exception as e:
             self.reply(sender, msg, M.FAILURE, f"{type(e).__name__}: {e}")
+            if self._trace is not None:
+                self._trace.finish_error(e)
             self.fail(e)
             return
         self.state = "Streaming"
@@ -481,10 +569,13 @@ class TransferGraphServer(Activity):
         (idempotent) rather than trusting indices a removal may have
         shifted."""
         c = msg["content"] or {}
+        self._adopt_trace(msg)
         try:
             self._snapshot()
         except Exception as e:
             self.reply(sender, msg, M.FAILURE, f"{type(e).__name__}: {e}")
+            if self._trace is not None:
+                self._trace.finish_error(e)
             self.fail(e)
             return
         self.state = "Streaming"
@@ -497,6 +588,8 @@ class TransferGraphServer(Activity):
 
     @from_state("Streaming", M.CANCEL)
     def on_cancel(self, sender: str, msg: dict) -> None:
+        if self._trace is not None:
+            self._trace.finish_terminal("cancelled")
         self.complete(None)
 
     def _send_page(self, sender: str, msg: dict, pos=None) -> None:
@@ -516,9 +609,15 @@ class TransferGraphServer(Activity):
                 continue
         eof = self.pos >= len(self.handles)
         g.metrics.incr("peer.transfer_chunks")
+        tr = self._trace
+        if tr is not None:
+            tr.start_span("chunk", parent=tr.marks.get("root"),
+                          pos=start, atoms=len(atoms), eof=eof).end()
         self.reply(sender, msg, M.INFORM, {
             "atoms": atoms, "eof": eof, "log_head": self.log_head,
             "pos": start, "next": self.pos, "snap": self.snap_token,
         })
         if eof:
+            if tr is not None:
+                tr.finish_terminal("served", atoms=self.pos)
             self.complete(self.pos)

@@ -5,12 +5,24 @@ Re-expression of the reference's performative vocabulary and Json envelopes
 carries a performative, an activity type + id (conversation correlation),
 and content. ``reply_to`` builds the response envelope with the same
 conversation id (the ``Messages.getReply`` analogue).
+
+**Distributed tracing rides the envelope**: :func:`attach_trace` stamps a
+message with the compact hgobs trace context
+(``{"tid": trace id, "sid": parent span id, "s": sampled}`` under the
+``"trace"`` key — three JSON scalars, transport-agnostic) and
+:func:`trace_context` reads it back on the receiving side, tolerant of
+messages from peers that predate tracing (absent key → None). The
+context's semantics live in ``obs.trace`` (``Trace.context`` /
+``Tracer.start_remote_trace``); this module only owns the wire placement.
 """
 
 from __future__ import annotations
 
 import uuid
 from typing import Any, Optional
+
+#: envelope key carrying the propagated hgobs trace context
+TRACE_KEY = "trace"
 
 # the performative constant pool (Performative.java)
 REQUEST = "request"
@@ -51,3 +63,17 @@ def reply_to(msg: dict, performative: str, content: Any = None) -> dict:
         "activity_id": msg["activity_id"],
         "content": content,
     }
+
+
+def attach_trace(msg: dict, ctx: Optional[dict]) -> dict:
+    """Stamp ``msg`` with a propagated trace context (no-op when ctx is
+    falsy — untraced sends carry no extra bytes). Returns ``msg``."""
+    if ctx:
+        msg[TRACE_KEY] = ctx
+    return msg
+
+
+def trace_context(msg: dict) -> Optional[dict]:
+    """The propagated trace context of a received message, or None."""
+    ctx = msg.get(TRACE_KEY)
+    return ctx if isinstance(ctx, dict) else None

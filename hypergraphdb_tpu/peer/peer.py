@@ -18,6 +18,7 @@ from __future__ import annotations
 import uuid
 from typing import Any, Optional
 
+from hypergraphdb_tpu.obs import global_tracer
 from hypergraphdb_tpu.peer import cact
 from hypergraphdb_tpu.peer.activity import ActivityManager
 from hypergraphdb_tpu.peer.replication import Replication
@@ -37,6 +38,12 @@ class HyperGraphPeer:
     ):
         self.graph = graph
         self.interface = interface
+        #: the hgobs tracer the peer plane reports into — the process
+        #: tracer by default, injectable per peer (two-peer tests give
+        #: each side its own so the joined span tree can be asserted
+        #: from both halves); every peer-plane site gates on ONE
+        #: ``tracer.enabled`` read
+        self.tracer = global_tracer()
         #: persisted peer identity (HGPeerIdentity analogue)
         self.identity = identity or self._load_identity()
         self.activities = ActivityManager(self)

@@ -11,6 +11,13 @@ round-trips as a field dict locally.
 The view deliberately does NOT write through the local graph (unlike
 ``HyperGraphPeer.get_remote``, which stores fetched closures): it is a
 window onto the remote database, not a replica.
+
+Observability: every call runs over ``cact.RemoteOpClient``, so with
+tracing on (``obs.enable()``, or an injected ``peer.tracer``) each view
+operation roots a ``peer.op`` trace whose context propagates to the
+serving peer — the remote ``op_serve`` span joins the same tree
+(remote-child parenting, joined on trace id). Nothing extra to wire
+here; the window is traced because the transport it rides is.
 """
 
 from __future__ import annotations

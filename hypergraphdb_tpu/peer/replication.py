@@ -674,6 +674,17 @@ class Replication:
             {"what": "push", "kind": kind, "entry": entry,
              "seq": self.log.head},
         )
+        # distributed tracing (worker thread, one enabled read): the push
+        # roots a cross-process tree — the receiver's apply subtree joins
+        # on the propagated trace id, even when delivery happens later
+        # through the redelivery queue (the context rides the message)
+        tracer = self.peer.tracer
+        tr = None
+        if tracer.enabled:
+            tr = tracer.start_trace("peer.push", kind=kind, target=pid)
+        if tr is not None:
+            tr.marks["root"] = tr.start_span("push", target=pid, kind=kind)
+            M.attach_trace(msg, tr.context())
         if (self._redelivery.get(pid)
                 or time.monotonic() < self._down_until.get(pid, 0.0)):
             # ORDER: the peer already has queued redeliveries (or just
@@ -681,9 +692,16 @@ class Replication:
             # never overtake (and we skip paying 3 backoff sleeps per
             # message to a down peer)
             self._queue_redelivery(pid, msg, 1)
+            if tr is not None:
+                tr.finish_terminal("redelivery_queued")
             return
-        if not self._send_reliable(pid, msg):
+        if self._send_reliable(pid, msg):
+            if tr is not None:
+                tr.finish_terminal("sent")
+        else:
             self._queue_redelivery(pid, msg, 1)
+            if tr is not None:
+                tr.finish_terminal("redelivery_queued")
 
     def _send_reliable(self, pid: str, message: dict) -> bool:
         """Send with bounded retry + capped backoff. Worker-thread only —
@@ -773,12 +791,26 @@ class Replication:
     def catch_up(self, pid: str) -> None:
         """Ask ``pid`` for its log entries after my recorded position
         (reliable-send: a dropped request retries with backoff — losing
-        it would silently stall convergence until the next manual call)."""
+        it would silently stall convergence until the next manual call).
+        Traced: each page roots one cross-process tree — request here,
+        ``catchup_serve`` on the server, ``apply`` back here — joined on
+        the propagated trace id."""
         self.peer.graph.metrics.incr("peer.catchups")
-        self._send_reliable(pid, M.make_message(
+        msg = M.make_message(
             M.REQUEST, self.ACTIVITY_TYPE,
             {"what": "catchup", "since": self.last_seen.get(pid, 0)},
-        ))
+        )
+        tracer = self.peer.tracer
+        tr = None
+        if tracer.enabled:
+            tr = tracer.start_trace("peer.catchup", target=pid)
+        if tr is not None:
+            tr.marks["root"] = tr.start_span("catchup_request", target=pid)
+            M.attach_trace(msg, tr.context())
+        ok = self._send_reliable(pid, msg)
+        if tr is not None:
+            tr.finish_terminal("sent" if ok else "error",
+                               **({} if ok else {"error": "SendFailed"}))
 
     # -- message handling (runs on the peer's dispatch path) --------------------
     def handle(self, sender: str, msg: dict) -> bool:
@@ -795,12 +827,27 @@ class Replication:
             )
         elif what == "push":
             # apply OFF the dispatch thread — a slow closure store must not
-            # stall unrelated peer traffic
+            # stall unrelated peer traffic; the propagated trace context
+            # rides along so the apply joins the sender's tree
             self._enqueue_apply(
                 sender, [(content["kind"], content["entry"],
-                          int(content.get("seq", 0)))]
+                          int(content.get("seq", 0)),
+                          M.trace_context(msg))]
             )
         elif what == "catchup":
+            # remote-child span: this serve hangs under the requester's
+            # catchup_request span in the joined tree
+            tracer = self.peer.tracer
+            tr = None
+            if tracer.enabled:
+                tr = tracer.start_remote_trace(
+                    "peer.catchup.serve", M.trace_context(msg),
+                    peer=sender,
+                )
+            serve_span = (None if tr is None
+                          else tr.start_span("catchup_serve"))
+            if tr is not None:
+                tr.marks["root"] = serve_span
             since = int(content.get("since", 0))
             floor = self.log.floor
             entries = []
@@ -822,11 +869,18 @@ class Replication:
                         for seq, kind, entry in raw
                     ]
             self.peer.graph.metrics.incr("peer.catchup_pages")
-            self.peer.interface.send(sender, M.make_message(
+            result = M.make_message(
                 M.INFORM, self.ACTIVITY_TYPE,
                 {"what": "catchup-result", "entries": entries,
                  "head": self.log.head, "floor": floor},
-            ))
+            )
+            if tr is not None:
+                # chain the SAME trace onward: the client's apply spans
+                # parent under this serve span
+                M.attach_trace(result, tr.context(serve_span))
+            self.peer.interface.send(sender, result)
+            if tr is not None:
+                tr.finish_terminal("served", entries=len(entries))
         elif what == "catchup-result":
             floor = int(content.get("floor", 0))
             entries = content.get("entries") or []
@@ -840,9 +894,11 @@ class Replication:
             # continue the catch-up after this page has been applied
             head = int(content.get("head", 0))
             top = max((int(e["seq"]) for e in entries), default=0)
+            tctx = M.trace_context(msg)
             self._enqueue_apply(
                 sender,
-                [(e["kind"], e["entry"], int(e["seq"])) for e in entries],
+                [(e["kind"], e["entry"], int(e["seq"]), tctx)
+                 for e in entries],
                 continue_catchup=bool(entries) and top < head,
             )
         elif what == "ack":
@@ -885,19 +941,30 @@ class Replication:
                 his: dict[str, int] = {}
                 failed: set[str] = set()
                 conts: set[str] = set()
+                tracer = self.peer.tracer
                 for sender, items, cont in batch:
                     if cont:
                         conts.add(sender)
-                    for kind, entry, seq in items:
+                    for kind, entry, seq, tctx in items:
                         if sender in failed:
                             # a failed apply must not be acked past — stop
                             # advancing this sender; catch-up refetches
                             # from the last acknowledged position
                             continue
+                        # remote-child trace: the apply subtree joins the
+                        # sender's push/serve span tree on trace id (one
+                        # enabled read; untraced messages carry no ctx)
+                        tr = (tracer.start_remote_trace(
+                                  "peer.apply", tctx, kind=kind,
+                                  sender=sender)
+                              if tracer.enabled else None)
+                        if tr is not None:
+                            tr.marks["root"] = tr.start_span(
+                                "apply", kind=kind, seq=seq)
                         try:
                             self._apply(sender, kind, entry)
                             self.peer.graph.metrics.incr("peer.applies")
-                        except Exception:
+                        except Exception as apply_exc:
                             import logging
 
                             logging.getLogger(
@@ -906,8 +973,12 @@ class Replication:
                                 "replication apply failed (%s from %s)",
                                 kind, sender, exc_info=True,
                             )
+                            if tr is not None:
+                                tr.finish_error(apply_exc)
                             failed.add(sender)
                             continue
+                        if tr is not None:
+                            tr.finish_terminal("applied")
                         if seq:
                             his[sender] = max(his.get(sender, 0), seq)
                 for sender, hi in his.items():

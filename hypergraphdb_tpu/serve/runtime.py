@@ -38,6 +38,7 @@ import random
 import threading
 import time
 from concurrent.futures import Future
+from contextlib import nullcontext
 from dataclasses import dataclass, field
 from typing import Callable, Optional, Sequence
 
@@ -50,6 +51,16 @@ from hypergraphdb_tpu.fault import (
     is_transient,
 )
 from hypergraphdb_tpu.obs import global_tracer
+from hypergraphdb_tpu.obs.device import annotate, profiling
+from hypergraphdb_tpu.obs.flight import global_flight
+
+#: process flight recorder, bound once (the fault-registry singleton
+#: discipline: one attribute read per site when quiet)
+_FLIGHT = global_flight()
+
+#: the no-annotation dispatch context — stateless, safe to re-enter, so
+#: the common (un-profiled) path allocates nothing per dispatch
+_NULL_CM = nullcontext()
 from hypergraphdb_tpu.serve.admission import AdmissionQueue
 from hypergraphdb_tpu.serve.batcher import BUCKETS, Batcher, MicroBatch
 from hypergraphdb_tpu.serve.stats import ServeStats
@@ -112,6 +123,10 @@ class LaunchedBatch:
     #: the batch's device-execution attribution (ServeConfig.device_timing)
     t_device: object = None
     _t_launch: object = None
+    #: double-buffer slot of this dispatch (dispatch sequence mod 2) —
+    #: rides the ``device`` span and the profiler annotation so device
+    #: time is attributable per pipeline slot
+    slot: int = -1
 
 
 class DeviceExecutor:
@@ -135,6 +150,21 @@ class DeviceExecutor:
         # serving implies ingest-concurrent reads: the incremental
         # (base, delta) pair IS the consistency mechanism
         self.mgr = graph.incremental or graph.enable_incremental()
+        #: real device dispatches so far — slot = seq mod 2 names which
+        #: half of the double buffer a batch rode (span + profiler attr)
+        self._dispatch_seq = 0
+
+    def _dispatch_cm(self, kind: str, bucket: int, statics: int):
+        """The per-dispatch profiler annotation, active only when device
+        timing is on or an ``obs.profile`` session is running — the
+        common un-profiled path pays two attribute reads and re-enters
+        the shared null context (no allocation)."""
+        if self.config.device_timing or profiling():
+            slot = self._dispatch_seq % 2
+            return annotate(
+                f"hg.serve.{kind}[K={bucket},s={statics},slot={slot}]"
+            )
+        return _NULL_CM
 
     # -- launch (async: never blocks on the device) --------------------------
     def launch(self, batch: MicroBatch) -> LaunchedBatch:
@@ -177,10 +207,11 @@ class DeviceExecutor:
                 # drops its seed from the window, and the spare slot keeps
                 # the remaining prefix full-width (see _bfs_result)
                 top_r = min(self.config.top_r + 1, n + 1)
-                out.dev_out = bfs_serve_batch(
-                    view.device, view.delta, jnp.asarray(seeds),
-                    max_hops, top_r,
-                )
+                with self._dispatch_cm("bfs", batch.bucket, max_hops):
+                    out.dev_out = bfs_serve_batch(
+                        view.device, view.delta, jnp.asarray(seeds),
+                        max_hops, top_r,
+                    )
         elif kind == "pattern":
             from hypergraphdb_tpu.ops.serving import NO_TYPE, \
                 pattern_serve_batch
@@ -211,14 +242,17 @@ class DeviceExecutor:
                 lane += 1
             if out.lane_tickets:
                 out.cand_records = self._capture_candidates(view)
-                out.dev_out = pattern_serve_batch(
-                    view.device, ell, jnp.asarray(anchors),
-                    jnp.asarray(type_vec),
-                    self.config.pattern_pad, self.config.top_r,
-                )
+                with self._dispatch_cm("pattern", batch.bucket, P):
+                    out.dev_out = pattern_serve_batch(
+                        view.device, ell, jnp.asarray(anchors),
+                        jnp.asarray(type_vec),
+                        self.config.pattern_pad, self.config.top_r,
+                    )
         else:  # pragma: no cover - batch keys come from our own requests
             raise Unservable(f"unknown batch kind {kind!r}")
         if out.dev_out is not None:
+            out.slot = self._dispatch_seq % 2
+            self._dispatch_seq += 1
             self.stats.record_device_dispatch()
             if self.config.device_timing and self.tracer.enabled:
                 out._t_launch = self.tracer.clock()
@@ -411,13 +445,17 @@ class ServeRuntime:
         self.stats = ServeStats(self.config.latency_window)
         self.faults = self.config.faults or global_faults()
         # per-batch-key breaker: a flaky device bucket trips to the exact
-        # host-fallback path and recovers via half-open probes
+        # host-fallback path and recovers via half-open probes; the
+        # per-key callbacks feed the labelled serve.breaker.* family
+        # (the worst-state gauge alone cannot say WHICH bucket degraded)
         self.breaker = CircuitBreaker(
             threshold=self.config.breaker_threshold,
             cooldown_s=self.config.breaker_cooldown_s,
             clock=self.clock,
             on_state=self.stats.set_breaker_state,
             on_trip=self.stats.record_breaker_trip,
+            on_key_state=self.stats.set_breaker_key_state,
+            on_key_trip=self.stats.record_breaker_key_trip,
         )
         self._sleep: Callable = self.config.sleep or time.sleep
         # seeded jitter: retries are reproducible under a fixed seed
@@ -635,12 +673,21 @@ class ServeRuntime:
                 if self.breaker.state_of(key) == OPEN:
                     # this failure tripped the breaker: serve THIS batch
                     # on host immediately — degraded throughput, not a
-                    # batch of errors (and no backoff: host is local)
+                    # batch of errors (and no backoff: host is local).
+                    # The tripping batch's traces are always-sample: a
+                    # trip is exactly the window an operator replays
+                    for t in batch.tickets:
+                        if t.trace is not None:
+                            t.trace.force_sample()
                     continue
                 if attempt > cfg.max_retries:
                     self._fail_batch(batch.tickets, e)
                     return None
                 self.stats.record_retry()
+                if _FLIGHT.enabled:
+                    _FLIGHT.record("serve.retry", key=str(key),
+                                   attempt=attempt,
+                                   error=type(e).__name__)
                 if not self._backoff(batch, attempt):
                     return None  # every ticket's deadline < next attempt
                 continue
@@ -679,6 +726,11 @@ class ServeRuntime:
         return True
 
     def _fail_batch(self, tickets, exc: BaseException) -> None:
+        if tickets and _FLIGHT.enabled:
+            # a typed serve error is an incident: the recorder dumps the
+            # window that led here (rate-limited; counting is always on)
+            _FLIGHT.incident("serve_error", error=type(exc).__name__,
+                             tickets=len(tickets))
         for t in tickets:
             if t.fail(exc):
                 self.stats.record_error()
@@ -711,6 +763,11 @@ class ServeRuntime:
         if traced:
             t_c1 = tracer.clock()
             t_dev = getattr(token, "t_device", None)
+            slot = getattr(token, "slot", -1)
+            if t_dev is not None:
+                # one histogram observation per measured batch — the
+                # device-time distribution BENCH_C6 summarizes
+                self.stats.record_device_time(t_dev[1] - t_dev[0])
             for ticket, res in results:
                 tr = ticket.trace
                 if tr is None or tr.finished:
@@ -718,7 +775,8 @@ class ServeRuntime:
                 root = tr.marks.get("root")
                 served_by = getattr(res, "served_by", None)
                 if t_dev is not None and served_by == "device":
-                    tr.add_span("device", t_dev[0], t_dev[1], parent=root)
+                    tr.add_span("device", t_dev[0], t_dev[1], parent=root,
+                                slot=slot)
                 tr.add_span("collect", t_c0, t_c1, parent=root)
                 if served_by == "host":
                     tr.add_span("host_fallback", t_c0, t_c1, parent=root)

@@ -43,8 +43,11 @@ LEGACY_TO_DOTTED = {
     "queue_depth": "serve.queue_depth",
 }
 
-#: every ``serve.*`` name this façade registers (drift-tested: the
-#: registry holds exactly these — no orphans, no duplicates)
+#: every FIXED ``serve.*`` name this façade registers (drift-tested: the
+#: registry holds exactly these — no orphans, no duplicates). Per-key
+#: breaker instruments are the one DYNAMIC family on top:
+#: ``serve.breaker.state.<key>`` / ``serve.breaker.trips.<key>``
+#: (:data:`BREAKER_KEY_PREFIX`), created on a key's first transition.
 DOTTED_NAMES = (
     "serve.submitted",
     "serve.completed",
@@ -55,6 +58,7 @@ DOTTED_NAMES = (
     "serve.host_fallbacks",
     "serve.batches",
     "serve.device_dispatches",
+    "serve.device_seconds",
     "serve.retries",
     "serve.breaker_trips",
     "serve.breaker_state",
@@ -63,6 +67,12 @@ DOTTED_NAMES = (
     "serve.latency_seconds",
     "serve.queue_depth",
 )
+
+#: name prefix of the per-batch-key breaker family (the labelled view
+#: the one-gauge worst-state ``serve.breaker_state`` was too coarse
+#: for — ``/healthz`` shows WHICH bucket is degraded, these let a
+#: Prometheus scrape do the same)
+BREAKER_KEY_PREFIX = "serve.breaker."
 
 
 class ServeStats:
@@ -101,23 +111,35 @@ class ServeStats:
         self._lanes_padded = r.counter("serve.lanes_padded")
         self._latency = r.histogram("serve.latency_seconds",
                                     window=latency_window)
+        self._device_seconds = r.histogram("serve.device_seconds")
         self._queue_depth = r.gauge("serve.queue_depth")
+        # per-batch-key breaker family, lazily registered on a key's
+        # first transition (label -> instrument; _key_instruments makes
+        # reset() cover them too)
+        self._key_states: dict = {}
+        self._key_trips: dict = {}
         self._own = (
             self._submitted, self._completed, self._shed, self._rejected,
             self._cancelled, self._errors, self._host_fallbacks,
-            self._batches, self._device_dispatches, self._retries,
-            self._breaker_trips, self._breaker_state, self._lanes_real,
-            self._lanes_padded, self._latency, self._queue_depth,
+            self._batches, self._device_dispatches, self._device_seconds,
+            self._retries, self._breaker_trips, self._breaker_state,
+            self._lanes_real, self._lanes_padded, self._latency,
+            self._queue_depth,
         )
 
     def reset(self) -> None:
         """Zero every counter and the latency/occupancy windows — the
         bench's post-warmup cut so compile-time latencies never pollute
-        steady-state percentiles. Resets only THIS façade's instruments:
-        on a shared registry, foreign subsystems' counters (graph/tx/
-        compact) must survive a serving-stats cut."""
+        steady-state percentiles. Resets only THIS façade's instruments
+        (including the per-key breaker family): on a shared registry,
+        foreign subsystems' counters (graph/tx/compact) must survive a
+        serving-stats cut."""
         with self._lock:
             for m in self._own:
+                m.reset()
+            for m in list(self._key_states.values()):
+                m.reset()
+            for m in list(self._key_trips.values()):
                 m.reset()
 
     # -- recording (serialized on the coherence lock) ------------------------
@@ -166,6 +188,48 @@ class ServeStats:
         (the breaker calls this from its own callback path)."""
         self._breaker_state.set(code)
 
+    @staticmethod
+    def _key_label(key) -> str:
+        """Stable metric label for a batch key: ``("bfs", 2)`` → ``bfs_2``.
+        Delegates to the ONE canonical labeller (``obs.http``'s, which
+        ``/healthz`` also uses) so the documented join-by-name between
+        the healthz view and the ``serve.breaker.*`` family cannot
+        drift. Late import: rare path (breaker transitions only), and it
+        keeps the serve→obs.http edge out of module import time."""
+        from hypergraphdb_tpu.obs.http import breaker_key_label
+
+        return breaker_key_label(key)
+
+    def set_breaker_key_state(self, key, code: int) -> None:
+        """Per-batch-key breaker gauge (``serve.breaker.state.<key>``),
+        pushed on every transition of THAT key — the labelled view the
+        worst-state gauge summarizes. Same callback discipline as
+        :meth:`set_breaker_state`: a leaf instrument write, no coherence
+        lock (dict get/set is GIL-atomic; a racing first transition just
+        resolves the same instrument twice)."""
+        label = self._key_label(key)
+        g = self._key_states.get(label)
+        if g is None:
+            g = self._key_states[label] = self.registry.gauge(
+                BREAKER_KEY_PREFIX + "state." + label
+            )
+        g.set(code)
+
+    def record_breaker_key_trip(self, key) -> None:
+        """Per-batch-key trip counter (``serve.breaker.trips.<key>``)."""
+        label = self._key_label(key)
+        c = self._key_trips.get(label)
+        if c is None:
+            c = self._key_trips[label] = self.registry.counter(
+                BREAKER_KEY_PREFIX + "trips." + label
+            )
+        c.inc()
+
+    def breaker_key_states(self) -> dict:
+        """{label: current gauge code} for every key that ever
+        transitioned — the scrape-side mirror of ``breaker.states()``."""
+        return {label: g.value for label, g in self._key_states.items()}
+
     def record_batch(self, n_real: int, bucket: int) -> None:
         """One successfully launched micro-batch; occupancy measures the
         ADMISSION layer's coalescing (real requests / padded lanes)."""
@@ -179,6 +243,12 @@ class ServeStats:
         back to host, or whose launch raised, dispatches none)."""
         with self._lock:
             self._device_dispatches.inc()
+
+    def record_device_time(self, seconds: float) -> None:
+        """One batch's launch→ready device wall delta (only measured
+        under ``ServeConfig(device_timing=True)`` — the histogram stays
+        empty otherwise)."""
+        self._device_seconds.observe(seconds)
 
     def record_complete(self, latency_s: float) -> None:
         with self._lock:

@@ -284,3 +284,94 @@ def test_chaos_full_stack_soak_long():
                                          n_nodes=120, n_links=200)
         assert outcomes.count("ok") >= len(outcomes) * 0.5
     test_chaos_replication_converges()
+
+
+def test_chaos_tcp_replication_soak():
+    """The TCP-backed replication soak (ROADMAP follow-up: the soaks
+    drove loopback, TCP had unit coverage only): seeded deterministic
+    drops on the REAL socket transport plus forced mid-soak socket
+    deaths (reconnect path), then heal + catch-up → the replica
+    converges exactly, no duplicates."""
+    faults = global_faults()
+    faults.reset()
+    seed = 29
+    rng = random.Random(seed)
+    drops = set(rng.sample(range(1, 40), 8))
+
+    from hypergraphdb_tpu.peer.transport import TCPPeerInterface
+
+    ga, gb = hg.HyperGraph(), hg.HyperGraph()
+    pa = HyperGraphPeer(ga, TCPPeerInterface("tcp-chaos-a",
+                                             connect_timeout=2.0),
+                        identity="tcp-chaos-a")
+    pb = HyperGraphPeer(gb, TCPPeerInterface("tcp-chaos-b",
+                                             connect_timeout=2.0),
+                        identity="tcp-chaos-b")
+    for p in (pa, pb):
+        p.interface.peer_id = p.identity
+        p.replication.send_backoff_s = 0.001
+        p.replication.send_backoff_max_s = 0.005
+        p.replication.debounce_s = 0.005
+        p.replication.redelivery_interval_s = 0.02
+    pa.start()
+    pb.start()
+    try:
+        pa.interface.connect("tcp-chaos-b", pb.interface.addr)
+        pb.interface.connect("tcp-chaos-a", pa.interface.addr)
+        pb.replication.publish_interest(None)
+        deadline = time.monotonic() + 10
+        while "tcp-chaos-b" not in pa.replication.peer_interests:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        faults.enable(seed=seed)
+        faults.arm(
+            "peer.transport.send", at=drops,
+            when=lambda ctx: ctx.get("activity") == "replication",
+        )
+        markers = []
+        hs = []
+        for i in range(24):
+            h = ga.add(f"tcp-c{i}")
+            hs.append(h)
+            markers.append(f"tcp-c{i}")
+            if i % 6 == 5:
+                lm = f"tcp-cl{i}"
+                ga.add_link((hs[i - 1], h), value=lm)
+                markers.append(lm)
+            if i == 12:
+                # mid-soak socket death: close A's cached outbound
+                # sockets WITHOUT forgetting them — the next send hits a
+                # dead socket and must reconnect (counted)
+                with pa.interface._lock:
+                    conns = list(pa.interface._conns.values())
+                for s in conns:
+                    s.close()
+        assert pa.replication.flush(timeout=30)
+        n_dropped = faults.fired("peer.transport.send")
+        # heal the tail: disarm, catch up, drain both pipelines
+        faults.disarm("peer.transport.send")
+        pb.replication.catch_up("tcp-chaos-a")
+        assert pb.replication.flush(timeout=30)
+        deadline = time.monotonic() + 20
+        missing = list(markers)
+        while missing and time.monotonic() < deadline:
+            missing = [m for m in missing if not q.find_all(gb, q.value(m))]
+            time.sleep(0.02)
+        assert not missing, f"TCP replica missing {missing[:5]}..."
+        for m in markers:
+            assert len(q.find_all(gb, q.value(m))) == 1   # no duplicates
+        c = ga.metrics.counters
+        assert n_dropped > 0                      # the wire really lost
+        assert c.get("peer.transport_sends", 0) > 0
+        # the socket deaths forced real reconnects on the TCP transport
+        assert c.get("peer.transport_reconnects", 0) >= 1
+        # deterministic: the journal is the ascending reached subset of
+        # the pre-drawn drop indices
+        fired = [idx for (name, idx) in faults.journal
+                 if name == "peer.transport.send"]
+        assert fired == sorted(fired) and set(fired) <= drops
+    finally:
+        pa.stop()
+        pb.stop()
+        faults.reset()
+        faults.disable()

@@ -1,0 +1,195 @@
+"""Flight recorder: bounded healthy-path ring, incident dumps, and the
+acceptance sequence — an injected serve fault tripping the breaker dumps
+a window containing the fault firings, the retry ladder, and the breaker
+transition, in order."""
+
+from __future__ import annotations
+
+import pytest
+
+from hypergraphdb_tpu.fault import global_faults
+from hypergraphdb_tpu.obs.flight import (
+    FlightRecorder,
+    global_flight,
+    parse_flight_jsonl,
+)
+from hypergraphdb_tpu.serve import ServeConfig, ServeRuntime
+from tests.test_serve_runtime import FakeClock, FakeExecutor
+
+
+@pytest.fixture
+def flight(tmp_path):
+    """The process recorder, pointed at a tmp incident dir and restored
+    clean (the global instance is what the wired sites bind)."""
+    fl = global_flight()
+    fl.reset()
+    prev_dir, prev_interval = fl.incident_dir, fl.min_dump_interval_s
+    fl.configure(incident_dir=str(tmp_path), min_dump_interval_s=0.0)
+    try:
+        yield fl
+    finally:
+        fl.reset()
+        fl.configure(incident_dir=prev_dir,
+                     min_dump_interval_s=prev_interval)
+        fl.incident_dir = prev_dir  # configure(None) means "keep"
+
+
+@pytest.fixture
+def faults():
+    f = global_faults()
+    f.reset()
+    yield f
+    f.reset()
+    f.disable()
+
+
+# ------------------------------------------------------------- the ring
+
+
+def test_ring_is_bounded_and_ordered():
+    fl = FlightRecorder(capacity=16, clock=iter(range(10_000)).__next__)
+    for i in range(100):
+        fl.record("tick", i=i)
+    recs = fl.records()
+    assert len(recs) == 16 == fl.capacity
+    # oldest evicted, order preserved
+    assert [f["i"] for _, _, f in recs] == list(range(84, 100))
+    # a soak does not grow the ring (bounded allocation: the window is
+    # the only retained state)
+    for i in range(1000):
+        fl.record("tick", i=i)
+    assert len(fl.records()) == 16
+
+
+def test_disabled_recorder_records_nothing():
+    fl = FlightRecorder(capacity=8)
+    fl.enabled = False
+    fl.record("x")
+    assert fl.records() == []
+    fl.enabled = True
+    fl.record("y")
+    assert len(fl.records()) == 1
+
+
+def test_dump_and_parse_roundtrip(tmp_path):
+    fl = FlightRecorder(capacity=8, clock=iter(range(100)).__next__)
+    fl.record("a", n=1, ok=True, label="x")
+    fl.record("b", obj=object())     # non-scalar → stringified, not fatal
+    path = fl.dump(str(tmp_path / "w.jsonl"))
+    recs = parse_flight_jsonl(open(path).read())
+    assert [r["kind"] for r in recs] == ["a", "b"]
+    assert recs[0]["n"] == 1 and recs[0]["ok"] is True
+    assert isinstance(recs[1]["obj"], str)
+    with pytest.raises(ValueError):
+        parse_flight_jsonl('{"kind": "missing-t"}')
+
+
+def test_incident_counts_and_rate_limits(tmp_path):
+    clk = [0.0]
+    fl = FlightRecorder(capacity=8, clock=lambda: clk[0],
+                        incident_dir=str(tmp_path),
+                        min_dump_interval_s=10.0)
+    p1 = fl.incident("boom")
+    assert p1 and fl.dumps == 1 and fl.incidents == 1
+    assert fl.incident("boom") is None          # rate-limited
+    assert fl.incidents == 2                     # still counted
+    clk[0] = 11.0
+    p2 = fl.incident("boom")
+    assert p2 and p2 != p1 and fl.dumps == 2
+    assert fl.last_dump_path == p2
+
+
+def test_incident_without_dir_counts_only():
+    fl = FlightRecorder(capacity=8)
+    assert fl.incident("quiet") is None
+    assert fl.incidents == 1
+    assert fl.records()[-1][1] == "incident"
+
+
+# --------------------------------------- acceptance: serve fault → dump
+
+
+class _FaultSiteExecutor(FakeExecutor):
+    """A fake executor carrying the REAL ``serve.launch`` fault site (the
+    one-gate-read discipline of ``DeviceExecutor.launch``)."""
+
+    def launch(self, batch):
+        f = global_faults()
+        if f.enabled:
+            f.check("serve.launch", kind=batch.key[0])
+        return super().launch(batch)
+
+
+def test_breaker_trip_dumps_fault_retries_and_transition(flight, faults,
+                                                         tmp_path):
+    """Injected serve fault → retry ladder → breaker trip: the incident
+    dump contains the fault firings, the retries, and the OPEN
+    transition, in that order — and the request still completes via the
+    host-degraded path."""
+    faults.enable(seed=0)
+    faults.arm("serve.launch", times=3)
+    clock = FakeClock()
+    cfg = ServeConfig(buckets=(4,), manual=True, max_linger_s=0.0,
+                      clock=clock, breaker_threshold=3, max_retries=3,
+                      retry_base_s=0.0, sleep=lambda s: None)
+    rt = ServeRuntime(graph=None, config=cfg,
+                      executor=_FaultSiteExecutor())
+    fut = rt.submit_bfs(1)
+    assert rt.step(drain=True)
+    assert fut.result(timeout=0).kind == "bfs"   # degraded, not an error
+    rt.close(drain=True)
+
+    assert flight.incidents == 1
+    path = flight.last_dump_path
+    assert path is not None and path.startswith(str(tmp_path))
+    recs = parse_flight_jsonl(open(path).read())
+    kinds = [r["kind"] for r in recs]
+
+    fires = [i for i, r in enumerate(recs)
+             if r["kind"] == "fault.fired" and r["point"] == "serve.launch"]
+    retries = [i for i, r in enumerate(recs) if r["kind"] == "serve.retry"]
+    trips = [i for i, r in enumerate(recs)
+             if r["kind"] == "breaker.transition" and r["state"] == "open"]
+    assert len(fires) == 3, kinds
+    assert len(retries) == 2, kinds             # the 3rd failure trips
+    assert len(trips) == 1, kinds
+    # in order: fire → retry → fire → retry → fire → OPEN → incident
+    assert fires[0] < retries[0] < fires[1] < retries[1] < fires[2] \
+        < trips[0] < kinds.index("incident")
+    assert recs[kinds.index("incident")]["reason"] == "breaker_trip"
+
+
+def test_serve_error_incident_on_permanent_failure(flight):
+    """A typed (permanent) batch failure is an incident too."""
+    from tests.test_serve_runtime import ExplodingExecutor
+
+    clock = FakeClock()
+    cfg = ServeConfig(buckets=(4,), manual=True, max_linger_s=0.0,
+                      clock=clock)
+    rt = ServeRuntime(graph=None, config=cfg, executor=ExplodingExecutor())
+    fut = rt.submit_bfs(1)
+    rt.step(drain=True)
+    with pytest.raises(RuntimeError):
+        fut.result(timeout=0)
+    rt.close(drain=True)
+    assert flight.incidents >= 1
+    recs = parse_flight_jsonl(open(flight.last_dump_path).read())
+    inc = [r for r in recs if r["kind"] == "incident"][-1]
+    assert inc["reason"] == "serve_error"
+    assert inc["error"] == "RuntimeError"
+
+
+def test_healthy_path_is_silent(flight):
+    """A clean serving run leaves no incidents and no dump files —
+    the recorder's healthy-path footprint is the bounded ring alone."""
+    clock = FakeClock()
+    cfg = ServeConfig(buckets=(4,), manual=True, max_linger_s=0.0,
+                      clock=clock)
+    rt = ServeRuntime(graph=None, config=cfg, executor=FakeExecutor())
+    for i in range(8):
+        rt.submit_bfs(i)
+        rt.step(drain=True)
+    rt.close(drain=True)
+    assert flight.incidents == 0
+    assert flight.last_dump_path is None
+    assert len(flight.records()) <= flight.capacity

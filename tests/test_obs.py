@@ -445,3 +445,128 @@ def test_serve_stats_shared_namespace_with_graph_metrics():
     assert reg.get("graph.mutations").value == 0
     s.record_submit()
     assert reg.get("serve.submitted").value == 1  # untouched by m.reset
+
+
+# ------------------------------------------------------------- sampling
+
+
+def test_sample_rate_zero_drops_and_one_keeps():
+    tracer, clock = make_tracer()
+    tracer.set_sample_rate("noisy", 0.0)
+    tracer.start_trace("noisy").finish()
+    tracer.start_trace("other").finish()     # default rate 1.0
+    kept = tracer.drain()
+    assert [t.name for t in kept] == ["other"]
+    assert tracer.traces_dropped == 1
+    assert tracer.sample_rate_of("noisy") == 0.0
+    assert tracer.sample_rate_of("other") == 1.0
+
+
+def test_error_and_shed_terminals_always_sampled():
+    """Head-based sampling with the always-capture override: an
+    unsampled trace that ends in error/shed is upgraded and retained."""
+    tracer, clock = make_tracer()
+    tracer.set_sample_rate("serve.request", 0.0)
+    ok = tracer.start_trace("serve.request")
+    ok.finish_terminal("resolve")            # healthy → dropped
+    bad = tracer.start_trace("serve.request")
+    bad.finish_error(RuntimeError("x"))      # error → kept
+    shed = tracer.start_trace("serve.request")
+    shed.finish_terminal("shed", waited_s=1.0)
+    kept = tracer.drain()
+    assert len(kept) == 2
+    assert {t.spans()[-1].name for t in kept} == {"error", "shed"}
+    assert tracer.traces_dropped == 1
+
+
+def test_force_sample_retains_breaker_trip_trace():
+    tracer, clock = make_tracer()
+    tracer.set_sample_rate("serve.request", 0.0)
+    tr = tracer.start_trace("serve.request")
+    tr.force_sample()                        # the breaker-trip path
+    tr.finish_terminal("resolve")
+    assert [t.trace_id for t in tracer.drain()] == [tr.trace_id]
+
+
+def test_sampling_deterministic_under_seed():
+    a = Tracer(clock=FakeClock(), seed=42).enable()
+    b = Tracer(clock=FakeClock(), seed=42).enable()
+    for t in (a, b):
+        t.set_sample_rate("x", 0.5)
+    pattern_a = [a.start_trace("x").sampled for _ in range(64)]
+    pattern_b = [b.start_trace("x").sampled for _ in range(64)]
+    assert pattern_a == pattern_b
+    assert 0 < sum(pattern_a) < 64           # a real 50% stream
+
+
+def test_finished_buffer_eviction_counted():
+    tracer, clock = make_tracer(max_finished=4)
+    for _ in range(6):
+        tracer.start_trace("t").finish()
+    assert tracer.finished_count() == 4
+    assert tracer.traces_evicted == 2
+    snap = tracer.sampling_snapshot()
+    assert snap["traces_evicted"] == 2
+    assert snap["finished_fill"] == 4 and snap["finished_capacity"] == 4
+
+
+def test_adaptive_controller_scales_down_and_recovers():
+    tracer, clock = make_tracer(max_finished=10)
+    tracer.enable_adaptive(target_fill=0.5, floor=0.05)
+    assert tracer.sample_rate_of("t") == 1.0
+    for _ in range(5):                       # fill to the target
+        tracer.start_trace("t").finish()
+    assert tracer.sample_rate_of("t") == 0.5  # halved at the watermark
+    for _ in range(4):                       # keep pressing
+        tr = tracer.start_trace("t")
+        tr.force_sample()
+        tr.finish()
+    assert tracer.sample_rate_of("t") == 0.05   # floor under pressure
+    tracer.drain()       # pressure cleared (this drain still saw fill)
+    for _ in range(3):   # idle drains: controller doubles back up
+        tracer.drain()
+    assert tracer.sample_rate_of("t") == pytest.approx(0.4)
+    # floor respected under sustained overload
+    for _ in range(100):
+        tr = tracer.start_trace("t")
+        tr.force_sample()
+        tr.finish()
+    assert tracer.sample_rate_of("t") >= 0.05
+
+
+def test_peek_does_not_consume():
+    tracer, clock = make_tracer()
+    tracer.start_trace("a").finish()
+    tracer.start_trace("b").finish()
+    assert [t.name for t in tracer.peek()] == ["a", "b"]
+    assert [t.name for t in tracer.peek(1)] == ["b"]
+    assert len(tracer.drain()) == 2          # peek left them in place
+
+
+def test_breaker_key_family_rides_the_registry():
+    """The dynamic serve.breaker.* family: labelled per-key gauges and
+    trip counters beside the committed fixed names."""
+    from hypergraphdb_tpu.serve.stats import (
+        BREAKER_KEY_PREFIX,
+        DOTTED_NAMES,
+        ServeStats,
+    )
+
+    s = ServeStats(latency_window=8)
+    s.set_breaker_key_state(("bfs", 2), 2)
+    s.record_breaker_key_trip(("bfs", 2))
+    s.set_breaker_key_state(("pattern", 3), 0)
+    extras = sorted(set(s.registry.names()) - set(DOTTED_NAMES))
+    assert extras == [
+        "serve.breaker.state.bfs_2",
+        "serve.breaker.state.pattern_3",
+        "serve.breaker.trips.bfs_2",
+    ]
+    assert all(n.startswith(BREAKER_KEY_PREFIX) for n in extras)
+    assert s.breaker_key_states() == {"bfs_2": 2.0, "pattern_3": 0.0}
+    text = obs.prometheus_text(s.registry)
+    assert "serve_breaker_state_bfs_2 2.0" in text
+    assert "serve_breaker_trips_bfs_2_total 1" in text
+    s.reset()                                # the family resets too
+    assert s.registry.get("serve.breaker.state.bfs_2").value == 0.0
+    assert s.registry.get("serve.breaker.trips.bfs_2").value == 0

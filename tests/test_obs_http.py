@@ -1,0 +1,165 @@
+"""HTTP telemetry endpoint: /metrics, /healthz (per-key breaker states
+within one scrape), /debug/traces, /debug/flight — end to end over real
+HTTP against a live (manual-mode) runtime."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from hypergraphdb_tpu import obs
+from hypergraphdb_tpu.obs.http import (
+    TelemetryServer,
+    breaker_key_label,
+    runtime_health,
+)
+from hypergraphdb_tpu.obs.trace import Tracer
+from hypergraphdb_tpu.serve import ServeConfig, ServeRuntime
+from tests.test_serve_runtime import FakeClock, FakeExecutor
+
+
+def make_runtime(tracer=None):
+    clock = FakeClock()
+    cfg = ServeConfig(buckets=(4,), max_linger_s=0.0, clock=clock,
+                      manual=True, tracer=tracer, breaker_threshold=3)
+    rt = ServeRuntime(graph=None, config=cfg, executor=FakeExecutor())
+    return rt, clock
+
+
+def get(url):
+    """(status, body) — urllib raises on >=400, we want both."""
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+@pytest.fixture
+def served():
+    tracer = Tracer(clock=FakeClock())
+    tracer.enable()
+    rt, clock = make_runtime(tracer=tracer)
+    srv = TelemetryServer(registries=[rt.stats.registry], tracer=tracer,
+                          health=runtime_health(rt)).start()
+    try:
+        yield rt, clock, srv, tracer
+    finally:
+        srv.stop()
+        rt.close(drain=True)
+
+
+def test_metrics_endpoint_serves_prometheus_text(served):
+    rt, clock, srv, tracer = served
+    fut = rt.submit_bfs(1)
+    rt.step(drain=True)
+    fut.result(timeout=0)
+    status, body = get(srv.url + "/metrics")
+    assert status == 200
+    assert "serve_submitted_total 1" in body
+    assert "serve_completed_total 1" in body
+    assert "serve_latency_seconds_count 1" in body
+
+
+def test_healthz_reflects_per_key_breaker_within_one_scrape(served):
+    rt, clock, srv, tracer = served
+    status, body = get(srv.url + "/healthz")
+    assert status == 200
+    h = json.loads(body)
+    assert h["breakers"] == {} and h["queue_depth"] == 0
+    assert h["accepting"] is True
+
+    key = ("bfs", 2)
+    for _ in range(3):                      # threshold=3 → OPEN
+        rt.breaker.record_failure(key)
+    status, body = get(srv.url + "/healthz")   # the very next scrape
+    h = json.loads(body)
+    assert status == 503
+    assert h["breakers"] == {"bfs_2": "open"}
+    assert h["breaker_worst"] == 2
+    # the labelled instrument family agrees with the healthz view
+    _, metrics = get(srv.url + "/metrics")
+    assert "serve_breaker_state_bfs_2 2.0" in metrics
+    assert "serve_breaker_trips_bfs_2_total 1" in metrics
+
+    rt.breaker.record_success(key)             # recovery
+    status, body = get(srv.url + "/healthz")
+    assert status == 200
+    assert json.loads(body)["breakers"] == {"bfs_2": "closed"}
+    _, metrics = get(srv.url + "/metrics")
+    assert "serve_breaker_state_bfs_2 0.0" in metrics
+
+
+def test_debug_traces_peeks_without_draining(served):
+    rt, clock, srv, tracer = served
+    fut = rt.submit_bfs(7)
+    rt.step(drain=True)
+    fut.result(timeout=0)
+    status, body = get(srv.url + "/debug/traces")
+    assert status == 200
+    recs = obs.parse_traces_jsonl(body)
+    assert [r["name"] for r in recs] == ["serve.request"]
+    # a peek, not a drain: the exporter still gets the trace
+    assert tracer.finished_count() == 1
+
+
+def test_debug_flight_and_404(served):
+    rt, clock, srv, tracer = served
+    obs.global_flight().record("http.test", marker=1)
+    status, body = get(srv.url + "/debug/flight")
+    assert status == 200
+    assert any(json.loads(line)["kind"] == "http.test"
+               for line in body.splitlines() if line.strip())
+    status, _ = get(srv.url + "/nope")
+    assert status == 404
+
+
+def test_broken_health_probe_returns_500_not_crash():
+    def bad_probe():
+        raise RuntimeError("probe fell over")
+
+    srv = TelemetryServer(health=bad_probe).start()
+    try:
+        status, body = get(srv.url + "/healthz")
+        assert status == 500
+        # the server survives: the next route still answers
+        status, _ = get(srv.url + "/metrics")
+        assert status == 200
+    finally:
+        srv.stop()
+
+
+def test_key_label_shapes():
+    assert breaker_key_label(("bfs", 2)) == "bfs_2"
+    assert breaker_key_label(("pattern", 3)) == "pattern_3"
+    assert breaker_key_label("k") == "k"
+
+
+def test_server_start_stop_idempotent():
+    srv = TelemetryServer()
+    srv.start()
+    srv.start()                 # second start is a no-op, not a 2nd loop
+    assert get(srv.url + "/metrics")[0] == 200
+    srv.stop()
+    srv.stop()                  # double stop tolerated
+    # a stopped server's port is gone: restarting must fail LOUDLY, not
+    # hand back a dead endpoint
+    with pytest.raises(RuntimeError, match="stopped"):
+        srv.start()
+
+
+def test_stop_without_start_releases_the_port():
+    """The listener binds in __init__ — stop() must release it even when
+    serve_forever never ran (and must not hang in shutdown())."""
+    import socket
+
+    srv = TelemetryServer()
+    host, port = srv.host, srv.port
+    srv.stop()
+    s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind((host, port))        # rebinding proves the port was released
+    s.close()

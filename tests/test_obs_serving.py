@@ -500,3 +500,96 @@ def test_stats_snapshot_namespaced_through_runtime():
     assert ns["serve.latency_seconds"]["p50"] == pytest.approx(
         legacy["latency_ms"]["p50"] / 1e3
     )
+
+
+# ----------------------------------------- sampling × serving (PR 7)
+
+
+def test_sampled_on_dispatch_sequence_identical():
+    """The sampled-on extension of the off-gate differential: the event
+    order with tracing ON is identical to tracing off — at 100% AND at
+    1% sampling. Sampling decides retention, never dispatch."""
+    rt_off, ex_off, clock_off = make_runtime()
+    run_workload(rt_off, clock_off)
+
+    for rate in (1.0, 0.01):
+        clock = FakeClock()
+        tracer = Tracer(clock=clock, seed=7)
+        tracer.enable()
+        tracer.set_sample_rate("serve.request", rate)
+        rt, ex, _ = make_runtime(tracer=tracer, clock=clock)
+        run_workload(rt, clock)
+        assert ex.events == ex_off.events, rate
+
+
+def test_tracing_overhead_under_committed_bound():
+    """The committed overhead bound (README "Distributed tracing &
+    operations"): with tracing on, the full submit→dispatch→resolve path
+    averages < 5 ms/request on the fake-executor differential — a ~50×
+    cushion over the measured cost, tight enough to catch a pathological
+    regression (unbounded retention, per-span lock convoys), loose
+    enough to never flake on a busy CI box."""
+    import time as _time
+
+    N = 300
+
+    def run(tracer, rate):
+        clock = FakeClock()
+        if tracer is not None:
+            tracer.clock = clock
+            tracer.set_sample_rate("serve.request", rate)
+        rt, ex, _ = make_runtime(tracer=tracer, clock=clock,
+                                 buckets=(64,), linger=0.0)
+        t0 = _time.perf_counter()
+        for i in range(N):
+            rt.submit_bfs(i)
+            if i % 64 == 63:
+                rt.step(drain=True)
+        while rt.step(drain=True):
+            pass
+        rt.close(drain=True)
+        return (_time.perf_counter() - t0) / N
+
+    for rate in (1.0, 0.01):
+        tracer = Tracer(seed=3)
+        tracer.enable()
+        per_request = run(tracer, rate)
+        assert per_request < 0.005, (rate, per_request)
+
+
+def test_one_percent_sampling_bounded_buffer_full_incident_capture():
+    """The production posture: 1% head sampling against a SMALL finished
+    buffer under a c6-style request storm — the buffer never overflows
+    (zero evictions) while shed/error traces are still captured at 100%
+    (always-sample overrides)."""
+    clock = FakeClock()
+    tracer = Tracer(clock=clock, max_finished=64, seed=11)
+    tracer.enable()
+    tracer.set_sample_rate("serve.request", 0.01)
+    rt, ex, _ = make_runtime(tracer=tracer, clock=clock, buckets=(64,),
+                             linger=0.0)
+    retained = []
+    # 960 healthy requests in waves, scraping (drain) like an exporter
+    for wave in range(15):
+        for i in range(64):
+            rt.submit_bfs(i)
+        rt.step(drain=True)
+        retained.extend(tracer.drain())
+    # 20 doomed requests: deadline expires before dispatch → shed
+    doomed = [rt.submit_bfs(i, deadline_s=0.5) for i in range(20)]
+    clock.advance(1.0)
+    rt.step(drain=True)
+    for f in doomed:
+        with pytest.raises(DeadlineExceeded):
+            f.result(timeout=0)
+    rt.close(drain=True)
+    retained.extend(tracer.drain())
+
+    assert tracer.traces_evicted == 0          # never overflowed
+    shed = [t for t in retained
+            if any(s.name == "shed" for s in t.spans())]
+    assert len(shed) == 20                     # incidents at 100%
+    healthy = len(retained) - len(shed)
+    # ~1% of 960 — bounded well below the buffer, but the stream is real
+    assert 0 < healthy < 64
+    assert tracer.traces_dropped == 960 - healthy

@@ -1,0 +1,84 @@
+#!/usr/bin/env bash
+# hgobs telemetry gate: the observability suite — tracing/sampling units,
+# the serving span-chain + overhead differential, cross-process peer
+# tracing (replication push / catch-up / snapshot transfer span trees),
+# the flight recorder, and the HTTP endpoint tests — followed by a live
+# end-to-end smoke: start a real ServeRuntime + TelemetryServer and
+# scrape /metrics and /healthz over actual HTTP (curl when present,
+# stdlib urllib otherwise — CI images without curl still smoke).
+#
+# Sits beside lint.sh (AST hazards), verify.sh (jaxpr ground truth), and
+# chaos.sh (fault injection): this one gates the telemetry plane.
+#
+# Usage: tools/obs.sh [extra pytest args]
+#   tools/obs.sh -k sampling           # one area, fast local run
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
+    tests/test_obs.py \
+    tests/test_obs_serving.py \
+    tests/test_peer_tracing.py \
+    tests/test_flight.py \
+    tests/test_obs_http.py \
+    -q -m 'not slow' -p no:cacheprovider "$@"
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "tools/obs.sh: observability tests failed (exit $rc)" >&2
+    exit "$rc"
+fi
+
+# -- live smoke: a real runtime behind the real endpoint ---------------------
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - <<'PY'
+import shutil
+import subprocess
+import sys
+import urllib.request
+
+import hypergraphdb_tpu as hg
+from hypergraphdb_tpu import obs
+from hypergraphdb_tpu.serve import ServeConfig, ServeRuntime
+
+g = hg.HyperGraph()
+a, b = g.add("smoke-a"), g.add("smoke-b")
+g.add_link([a, b], value="smoke-e")
+obs.enable()
+rt = ServeRuntime(g, ServeConfig(max_linger_s=0.001, top_r=8))
+rt.submit_bfs(int(a), max_hops=1).result(timeout=120)
+srv = obs.TelemetryServer(
+    registries=[rt.stats.registry, g.metrics.registry],
+    health=obs.runtime_health(rt),
+).start()
+try:
+    curl = shutil.which("curl")
+
+    def scrape(route: str) -> str:
+        url = srv.url + route
+        if curl:
+            out = subprocess.run(
+                [curl, "-fsS", "--max-time", "10", url],
+                check=True, capture_output=True, text=True,
+            )
+            return out.stdout
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.read().decode()
+
+    metrics = scrape("/metrics")
+    assert "serve_submitted_total" in metrics, metrics[:200]
+    assert "graph_mutations_total" in metrics, metrics[:200]
+    health = scrape("/healthz")
+    assert '"queue_depth"' in health and '"breakers"' in health, health
+    print(f"tools/obs.sh smoke: scraped {srv.url} "
+          f"({'curl' if curl else 'urllib'}) — metrics + healthz OK")
+finally:
+    srv.stop()
+    rt.close()
+    g.close()
+PY
+smoke_rc=$?
+if [ "$smoke_rc" -ne 0 ]; then
+    echo "tools/obs.sh: live endpoint smoke failed (exit $smoke_rc)" >&2
+    exit "$smoke_rc"
+fi
+echo "tools/obs.sh: observability gate green"
+exit 0
