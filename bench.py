@@ -97,6 +97,17 @@ def _telemetry_dump(name: str, registries=()) -> dict:
             "sampling": sampling}
 
 
+def _compile_cache_dir() -> str:
+    """THE resolution of the persistent XLA compile-cache path — used by
+    both the jax config below and the cache-hit detector, so the two can
+    never drift onto different directories."""
+    return os.environ.get(
+        "JAX_COMPILE_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"),
+    )
+
+
 def _enable_compile_cache() -> None:
     """Persistent XLA compilation cache: first-ever compile of the 10M-scale
     kernels costs minutes over the axon tunnel; every later bench run reuses
@@ -104,10 +115,7 @@ def _enable_compile_cache() -> None:
     try:
         import jax
 
-        cache = os.environ.get(
-            "JAX_COMPILE_CACHE", os.path.join(os.path.dirname(__file__), ".jax_cache")
-        )
-        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_compilation_cache_dir", _compile_cache_dir())
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass
@@ -120,6 +128,37 @@ os.environ.setdefault(
     "HG_PLAN_CACHE",
     os.path.join(os.path.dirname(os.path.abspath(__file__)), ".plan_cache"),
 )
+# serving AOT executables persist too (ops/aot_cache): ServeRuntime
+# prewarm + the c6 cold-start probe read this root
+os.environ.setdefault(
+    "HG_AOT_CACHE",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".aot_cache"),
+)
+
+
+def _xla_cache_files() -> int:
+    """Entries in the persistent XLA compile cache — the honest (if
+    coarse) cache-hit signal: a config whose warmup persisted NO new
+    executable into a non-empty cache compiled nothing substantial."""
+    try:
+        return len(os.listdir(_compile_cache_dir()))
+    except OSError:
+        return 0
+
+
+def _timed_warmup(fn) -> dict:
+    """Run one config's compile/warmup phase, recording ``compile_s``
+    (wall — includes trace+compile or cache load) and ``cache_hit``
+    (no new persistent-cache entries were written and the cache was
+    already populated). The ISSUE-8 trajectory fields."""
+    files0 = _xla_cache_files()
+    t0 = time.perf_counter()
+    fn()
+    dt = time.perf_counter() - t0
+    return {
+        "compile_s": round(dt, 3),
+        "cache_hit": bool(_xla_cache_files() == files0 and files0 > 0),
+    }
 
 
 # ---------------------------------------------------------------- host engines
@@ -271,8 +310,9 @@ def bench_c2():
     import jax
 
     chunk = int(os.environ.get("BENCH_EDGE_CHUNK", 1 << 17))
-    res = bfs_packed_block(dev, seeds_dev, HOPS, edge_chunk=chunk)  # compile
-    jax.block_until_ready(res)
+    compile_info = _timed_warmup(lambda: jax.block_until_ready(
+        bfs_packed_block(dev, seeds_dev, HOPS, edge_chunk=chunk)
+    ))
     rep_times = []
     for _ in range(3):
         t0 = time.perf_counter()
@@ -295,6 +335,7 @@ def bench_c2():
         "vs_python_engine": round(device_eps / py_eps, 2) if py_eps else None,
         "edges_per_run": edges,
         "device_ms": round(dt * 1e3, 3),
+        **compile_info,
     }
     if telemetry:
         out["telemetry"] = telemetry
@@ -345,9 +386,9 @@ def bench_c3(snap, info):
     # and host baselines.
     plan = plan_pattern(snap, pairs, th)
     reps = int(os.environ.get("BENCH_C3_REPS", 64))
-    jax.block_until_ready([
+    compile_info = _timed_warmup(lambda: jax.block_until_ready([
         x for _, c_, f in execute_pattern(plan, top_r=4) for x in (c_, f)
-    ])  # warmup, no download
+    ]))  # warmup, no download
 
     # execution mode: results stay in HBM (what the chip sustains when the
     # host link is not the bottleneck)
@@ -455,6 +496,7 @@ def bench_c3(snap, info):
             round(value_exec_qps / host_value_qps, 2)
             if host_value_qps else None
         ),
+        **compile_info,
     }
 
 
@@ -511,7 +553,7 @@ def bench_c4(snap, info, budget_s=240.0):
         jax.block_until_ready(res.visited_t)
         return int(np.asarray(res.edges_touched).sum())
 
-    run_once()  # warmup/compile
+    compile_info = _timed_warmup(run_once)  # warmup/compile
     # adaptive reps: stay inside the time budget (r3's fixed 3-rep loop on a
     # 324 s/run kernel is what timed the whole bench out); best single rep
     # is reported (see best_of())
@@ -525,13 +567,25 @@ def bench_c4(snap, info, budget_s=240.0):
     dt = min(rep_times)
     device_eps = edges / dt
 
-    # charge each block its REAL width (the kernel's own layout rule)
+    # charge each block its REAL width (the kernel's own layout rule) and
+    # its REAL path: a block the fused megakernel served moves only the
+    # gathered rows + one visited read/write per hop (ops/pallas_bfs
+    # traffic model — no stage buffers, no out_map re-gather), so fused
+    # and staged runs stay comparable on the same honest basis
+    from hypergraphdb_tpu.ops import pallas_bfs as _pbfs
     from hypergraphdb_tpu.ops.ellbfs import block_layout
 
-    gbps = sum(
-        pull_bytes_per_run(plans, w, HOPS)
-        for w in block_layout(K, k_block)
-    ) / dt / 1e9
+    widths = block_layout(K, k_block)
+    fused_w = {w: _pbfs.fused_ready(snap, w) for w in set(widths)}
+
+    def bytes_for(w: int) -> int:
+        if fused_w[w]:
+            return _pbfs.fused_bytes_per_hop(
+                _pbfs.fused_plans_for(snap).geom, w
+            ) * HOPS
+        return pull_bytes_per_run(plans, w, HOPS)
+
+    gbps = sum(bytes_for(w) for w in widths) / dt / 1e9
 
     host_n = min(8, K)
     host_eps, _ = best_of(
@@ -546,8 +600,10 @@ def bench_c4(snap, info, budget_s=240.0):
         "edges_per_run": edges,
         "device_s": round(dt, 3),
         "plan_build_s": round(plan_s, 1),
+        "fused_path": bool(any(fused_w.values())),
         "reps": reps,
         "n_devices": n_dev,
+        **compile_info,
     }
 
 
@@ -734,7 +790,13 @@ def bench_c5():
     return out
 
 
-def bench_c6():
+#: sentinel: bench_c6() runs the cold-start probe itself unless main()'s
+#: legacy in-process path already ran it before any config touched the
+#: device
+_PROBE = object()
+
+
+def bench_c6(cold=_PROBE):
     """Serving runtime under open-loop load: Poisson arrivals against
     ``serve.ServeRuntime`` (micro-batched BFS dispatches over the
     incremental pair) while ingest runs concurrently — the c5 workload
@@ -751,6 +813,14 @@ def bench_c6():
     from hypergraphdb_tpu.serve import DeadlineExceeded, ServeConfig, \
         ServeRuntime
 
+    # cold-start probe FIRST, before this process touches the device: the
+    # probe's fresh subprocesses must each own the (single-client) TPU
+    # for their lifetime — after the parent initializes jax they could
+    # not, and the acceptance field would silently vanish exactly on the
+    # hardware it exists to measure. main()'s legacy BENCH_ISOLATE=0 path
+    # passes a pre-run result instead (there, c2-c5 run in-process first)
+    if cold is _PROBE:
+        cold = _cold_start_probe()
     _telemetry_begin()
     n_entities = int(os.environ.get("BENCH_C6_ENTITIES", 200_000))
     n_links = int(os.environ.get("BENCH_C6_LINKS", 400_000))
@@ -900,10 +970,17 @@ def bench_c6():
             if s["latency_ms"]["p99"] is not None else None
         ),
         "host_fallbacks": s["host_fallbacks"],
+        # the main runtime's AOT cache counters (env HG_AOT_CACHE is set
+        # by this bench): cache_hit for the serving config is exact
+        "aot": s.get("aot"),
+        "cache_hit": bool(s.get("aot", {}) and
+                          s["aot"].get("misses", 1) == 0),
         "concurrent_ingest_atoms_per_sec": round(
             ingested["atoms"] / ingested["s"], 1
         ) if ingested["s"] else None,
     }
+    if cold is not None:
+        out["cold_start_s"] = cold
     if telemetry:
         # the SAME sampling snapshot the telemetry sidecar carries also
         # rides the recorded result (telemetry itself is excluded from
@@ -912,6 +989,80 @@ def bench_c6():
         out["telemetry"] = telemetry
     out["recorded_to"] = _record_c6(out)
     return out
+
+
+def _cold_start_probe() -> Optional[dict]:
+    """ISSUE-8 acceptance instrumentation: wall time from ServeRuntime
+    construction (prewarm included) to the first served result in a
+    FRESH python process, with the AOT cache absent vs present on the
+    same graph content — the number that shows the compile-storm
+    collapsing. Small fixed scale so the probe costs seconds; disable
+    with BENCH_C6_COLD=0."""
+    if os.environ.get("BENCH_C6_COLD", "1") == "0":
+        return None
+    import subprocess
+    import sys
+    import tempfile
+
+    n = int(os.environ.get("BENCH_C6_COLD_ENTITIES", 20_000))
+    cache_dir = tempfile.mkdtemp(prefix="hg_aot_coldstart_")
+    code = f"""
+import json, time
+import numpy as np
+from hypergraphdb_tpu import HyperGraph
+from hypergraphdb_tpu.serve import ServeConfig, ServeRuntime
+
+g = HyperGraph()
+r = np.random.default_rng(3)
+ents = g.bulk_import(values=np.arange({n}).tolist())
+e0 = int(ents[0])
+subj = r.integers(0, {n}, size={n})
+obj = r.integers(0, {n}, size={n})
+g.bulk_import(values=[int(x) for x in range({n})],
+              target_lists=[[e0 + int(a), e0 + int(b)]
+                            for a, b in zip(subj, obj)])
+t0 = time.perf_counter()
+rt = ServeRuntime(g, ServeConfig(buckets=(64, 256, 1024),
+                                 max_linger_s=0.002, top_r=16,
+                                 aot_cache_dir={cache_dir!r}))
+rt.submit_bfs(e0, max_hops=2).result(timeout=600)
+dt = time.perf_counter() - t0
+s = rt.stats_snapshot()
+print("COLD_RESULT " + json.dumps(
+    {{"first_result_s": round(dt, 3), "aot": s.get("aot")}}), flush=True)
+rt.close()
+g.close()
+"""
+
+    def run_once() -> dict:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)), timeout=900,
+        )
+        for line in proc.stdout.splitlines():
+            if line.startswith("COLD_RESULT "):
+                return json.loads(line[len("COLD_RESULT "):])
+        raise RuntimeError(f"cold-start probe failed (rc="
+                           f"{proc.returncode}):\n{proc.stderr[-2000:]}")
+
+    import shutil
+
+    try:
+        absent = run_once()   # empty cache dir: pays the compiles
+        present = run_once()  # same dir, same content: loads executables
+    except Exception as e:  # noqa: BLE001 - a probe must not kill the run
+        import sys as _sys
+
+        print(f"bench: cold-start probe failed: {e}", file=_sys.stderr)
+        return None
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    return {
+        "cache_absent_s": absent["first_result_s"],
+        "cache_present_s": present["first_result_s"],
+        "warm_aot": present["aot"],
+        "entities": n,
+    }
 
 
 def _record_c6(result: dict) -> Optional[str]:
@@ -1055,6 +1206,11 @@ def main() -> None:
         c6 = _run_isolated("c6")
         graph = c4.pop("_graph")
     else:  # legacy in-process path (BENCH_ISOLATE=0): order still matters
+        # c6's cold-start probe BEFORE any config initializes the device
+        # in this process — its fresh subprocesses must own the
+        # single-client TPU (same rule as the isolated path, where each
+        # config's subprocess starts clean)
+        cold = _cold_start_probe()
         snap, info, build_s = _build_10m()
         c3 = _with_telemetry("c3", lambda: bench_c3(snap, info))
         snap.__dict__.pop("device", None)  # cached_property storage
@@ -1064,7 +1220,7 @@ def main() -> None:
         c4 = _with_telemetry("c4", lambda: bench_c4(snap, info))
         c2 = _with_telemetry("c2", bench_c2)
         c5 = _with_telemetry("c5", bench_c5)
-        c6 = bench_c6()
+        c6 = bench_c6(cold=cold)
         graph = {
             "n_atoms": info["n_atoms"],
             "total_arity": info["total_arity"],

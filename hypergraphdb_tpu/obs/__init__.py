@@ -64,6 +64,7 @@ from hypergraphdb_tpu.obs.export import (
 from hypergraphdb_tpu.obs.flight import (
     FlightRecorder,
     global_flight,
+    install_sigterm_dump,
     parse_flight_jsonl,
 )
 from hypergraphdb_tpu.obs.http import (
@@ -120,6 +121,7 @@ __all__ = [
     "global_flight",
     "global_tracer",
     "http",
+    "install_sigterm_dump",
     "parse_flight_jsonl",
     "parse_traces_jsonl",
     "profile",

@@ -66,8 +66,12 @@ class FlightRecorder:
         #: healthy path takes NO lock (same discipline as the replication
         #: worker's pending queue)
         self._ring: deque = deque(maxlen=int(capacity))
-        # incident bookkeeping only (rare path) lives behind the lock
-        self._lock = threading.Lock()
+        # incident bookkeeping only (rare path) lives behind the lock.
+        # REENTRANT: the SIGTERM dump hook runs incident() from a signal
+        # handler, which CPython executes on the main thread at the next
+        # bytecode — possibly while that same thread already holds this
+        # lock (a plain Lock would self-deadlock the orderly kill)
+        self._lock = threading.RLock()
         self._incidents = 0
         self._dumps = 0
         self._last_dump_t: Optional[float] = None
@@ -199,3 +203,67 @@ _GLOBAL = FlightRecorder()
 
 def global_flight() -> FlightRecorder:
     return _GLOBAL
+
+
+def install_sigterm_dump(recorder: Optional[FlightRecorder] = None,
+                         signum: Optional[int] = None) -> Callable[[], None]:
+    """OPT-IN: dump the flight window when the process is killed orderly.
+
+    Installs a SIGTERM handler (overridable via ``signum``) that records
+    ``FlightRecorder.incident("sigterm")`` — writing the window to the
+    recorder's ``incident_dir`` if one is configured — and then hands the
+    signal on, PRESERVING the prior disposition: a previously-installed
+    Python handler is invoked; a process that explicitly ignored the
+    signal (``SIG_IGN``) keeps ignoring it (dump only, no death); with
+    the default disposition the handler re-raises the signal against the
+    process with ``SIG_DFL`` restored, so the kill still kills (operators
+    get the window, supervisors still see a SIGTERM death).
+
+    Must be called from the main thread (CPython restricts
+    ``signal.signal``). Returns an uninstall callable restoring the prior
+    handler. NOT installed automatically anywhere — a library must never
+    repurpose a process's signals behind the operator's back; wire it
+    from your entrypoint (or from ``tools/``-style harnesses).
+    """
+    import signal as _signal
+
+    rec = recorder or _GLOBAL
+    signum = _signal.SIGTERM if signum is None else signum
+    prev = _signal.getsignal(signum)
+
+    def _handler(num, frame):
+        rec.incident("sigterm", signal=int(num))
+        if prev == _signal.SIG_IGN:
+            return  # the operator chose to survive this signal; honor it
+        if callable(prev) and prev != _signal.SIG_DFL:
+            prev(num, frame)
+            return
+        # default (or unknowable C-installed) disposition: restore
+        # SIG_DFL and re-deliver, so the process still dies with the
+        # conventional -SIGTERM status
+        _signal.signal(num, _signal.SIG_DFL)
+        os.kill(os.getpid(), num)
+
+    _signal.signal(signum, _handler)
+
+    def uninstall():
+        if _signal.getsignal(signum) is not _handler:
+            # someone installed their own handler AFTER ours (it chains
+            # to us via its own getsignal) — restoring `prev` here would
+            # silently remove THEIR handler; leave the chain alone
+            import logging
+
+            logging.getLogger("hypergraphdb_tpu.obs").warning(
+                "sigterm dump hook is no longer the active handler for "
+                "signal %s — leaving the current disposition in place",
+                signum,
+            )
+            return
+        # getsignal returns None for a handler installed from C — it
+        # cannot be re-installed from Python, so fall back to SIG_DFL
+        # (at least detaching the recorder) instead of raising
+        _signal.signal(
+            signum, prev if prev is not None else _signal.SIG_DFL
+        )
+
+    return uninstall

@@ -828,6 +828,17 @@ def bfs_pull(
     blocks = []
     for s in range(0, K_pad, k_block):
         block = seeds[s : s + k_block]
+        # fused megakernel first: ONE dispatch runs every hop with no
+        # stage buffers and no host sequencing (ops/pallas_bfs); declines
+        # (CPU backend, window budgets) fall through to the staged chain
+        from hypergraphdb_tpu.ops import pallas_bfs as _pbfs
+
+        if _pbfs.fused_ready(snap, len(block)):
+            blocks.append(
+                _pbfs.bfs_pull_fused(snap, block, max_hops,
+                                     count_edges=count_edges)
+            )
+            continue
         # wide blocks (k_block % 4096 == 0 → 128-lane rows) run the Pallas
         # gather when it preflights on this backend; everything else keeps
         # the XLA gather (same measured descriptor rate, no width limits)

@@ -103,6 +103,11 @@ class ServeConfig:
     transient_errors: tuple = ()            # extra types to retry
     sleep: Optional[Callable] = None        # injectable backoff sleeper
     faults: Optional[object] = None         # fault registry; None → global
+    # -- raw speed (pallas_bfs + aot_cache) ----------------------------------
+    use_pallas_bfs: bool = True             # fused kernel when it preflights
+    aot_cache_dir: Optional[str] = None     # AOT compile cache; None → env
+    prewarm_aot: bool = True                # compile K buckets at startup
+    prewarm_hops: Optional[tuple] = None    # hops to warm; None → (default,)
 
 
 @dataclass
@@ -153,6 +158,206 @@ class DeviceExecutor:
         #: real device dispatches so far — slot = seq mod 2 names which
         #: half of the double buffer a batch rode (span + profiler attr)
         self._dispatch_seq = 0
+        #: persistent AOT compile cache (ops/aot_cache): explicit dir from
+        #: config, else $HG_AOT_CACHE, else off. content_key pins entries
+        #: to this graph generation (quiet rebuild on mismatch).
+        self.aot = self._open_aot_cache()
+        self._aot_failed = False
+
+    def _open_aot_cache(self):
+        import os
+
+        from hypergraphdb_tpu.ops.aot_cache import (
+            CACHE_ENV,
+            AOTCache,
+            default_cache,
+        )
+
+        if not self.config.aot_cache_dir and not os.environ.get(CACHE_ENV):
+            # no cache configured — decide BEFORE the content fingerprint
+            # (an O(E) CRC over the full CSR at benchmark scale)
+            return None
+        try:
+            fp = self._content_key()
+            if self.config.aot_cache_dir:
+                return AOTCache(root=self.config.aot_cache_dir,
+                                content_key=fp)
+            return default_cache(content_key=fp)
+        except Exception:  # pragma: no cover - unwritable dir etc.
+            return None
+
+    def _content_key(self) -> str:
+        """Snapshot content fingerprint of the graph at executor birth —
+        the ``snapshot_fingerprint`` half of the AOT cache key. The
+        executables themselves depend only on shapes, so the fingerprint
+        is a conservative pin: restarting over the same data warm-hits,
+        restarting over different data rebuilds quietly."""
+        from hypergraphdb_tpu.ops.ellbfs import snapshot_fingerprint
+
+        try:
+            return snapshot_fingerprint(self.mgr.base)
+        except Exception:  # pragma: no cover - exotic base states
+            return ""
+
+    # -- AOT-compiled dispatch + prewarm -------------------------------------
+    def _aot_dispatch(self, entry: str, jit_fn, args: tuple,
+                      statics: dict):
+        """The cached executable for one dispatch, or None → the caller
+        falls back to plain jit. ONE failure policy for every entry: a
+        cache malfunction logs once and disables the cache for this
+        executor's lifetime (the cache accelerates, never gates), while
+        EXECUTION errors of the returned executable propagate to the
+        retry/breaker ladder like any device failure. Dispatch-time
+        compiles do not persist (``persist=False``): only the prewarm
+        writes disk entries, so shape churn (resized delta buckets)
+        cannot mint superseded multi-MB files on a serving thread."""
+        if self.aot is None or self._aot_failed:
+            return None
+        try:
+            return self.aot.get_or_compile(entry, jit_fn, args, statics,
+                                           persist=False)
+        except Exception:  # noqa: BLE001 - shapes the AOT path rejects
+            import logging
+
+            logging.getLogger("hypergraphdb_tpu.serve").warning(
+                "aot dispatch failed for %s; falling back to jit", entry,
+                exc_info=True,
+            )
+            self._aot_failed = True
+            return None
+
+    def _serve_bfs(self, view, seeds_dev, max_hops: int, top_r: int):
+        """One BFS batch dispatch through the AOT cache when configured
+        (first dispatch of a warmed bucket reuses the persisted
+        executable instead of recompiling); plain jit otherwise."""
+        from hypergraphdb_tpu.ops.serving import bfs_serve_batch
+
+        args = (view.device, view.delta, seeds_dev)
+        statics = {"max_hops": max_hops, "top_r": top_r}
+        compiled = self._aot_dispatch("ops.serving.bfs_serve_batch",
+                                      bfs_serve_batch, args, statics)
+        if compiled is not None:
+            return compiled(*args)
+        return bfs_serve_batch(*args, **statics)
+
+    def _serve_bfs_fused(self, kw: dict, seeds_dev, max_hops: int,
+                         top_r: int):
+        """The fused-kernel dispatch, through the AOT cache when the
+        batch carries no overlay (the steady read-heavy shape prewarm
+        covers); overlay batches take the plain jit — their array shapes
+        change per delta refresh, which would churn even the in-process
+        memo for executables jit retraces anyway."""
+        from hypergraphdb_tpu.ops.serving import bfs_serve_batch_fused
+
+        statics = {
+            "geom": kw["geom"], "kwp": kw["kwp"], "max_hops": max_hops,
+            "top_r": top_r, "widths1": kw["widths1"],
+            "widths2": kw["widths2"],
+        }
+        if kw["overlay"] is None:
+            args = (kw["fused"], seeds_dev, kw["n_atoms"])
+            compiled = self._aot_dispatch(
+                "ops.serving.bfs_serve_batch_fused",
+                bfs_serve_batch_fused, args, statics,
+            )
+            if compiled is not None:
+                return compiled(*args)
+        return bfs_serve_batch_fused(kw["fused"], seeds_dev,
+                                     kw["n_atoms"], kw["overlay"],
+                                     **statics)
+
+    def prewarm(self, buckets, max_hops: Optional[int] = None) -> int:
+        """Compile (or load from the AOT cache) the BFS serving
+        executables for every bucket width against the current pinned
+        view — the deploy-time half of the cold-start story. Warms the
+        unfused entry always (it serves tombstone/overlay windows and
+        every non-Pallas backend) and the fused entry wherever the fused
+        gates would route the first dispatch. Runs even with NO cache
+        configured: the fused host plan build (O(composed adjacency) —
+        seconds at benchmark scale) and the backend probe compile are
+        unrelated to AOT and must not land inside the first live
+        request's deadline window. Returns the number of executables
+        served from cache."""
+        import jax.numpy as jnp
+
+        from hypergraphdb_tpu.ops import pallas_bfs as _pbfs
+        from hypergraphdb_tpu.ops.serving import (
+            bfs_serve_batch,
+            bfs_serve_batch_fused,
+        )
+
+        if self.aot is None and not (self.config.use_pallas_bfs
+                                     and _pbfs.pallas_bfs_ok()):
+            # nothing to warm: no cache to load, and the fused path (the
+            # owner of the plan-build/probe cost) can never engage — skip
+            # the pinned_view so cache-less CPU construction stays free
+            return 0
+
+        # the hops SET to warm: a deployment serving more than the default
+        # (ServeConfig.prewarm_hops) would otherwise compile the missing
+        # statics synchronously on the dispatch thread in every fresh
+        # process — dispatch-time compiles never persist
+        hops_list = ((int(max_hops),) if max_hops is not None
+                     else tuple(self.config.prewarm_hops or ())
+                     or (self.config.default_max_hops,))
+        view = self.mgr.pinned_view(self.config.max_lag_edges,
+                                    sync_delta=True)
+        n = view.base.num_atoms
+        top_r = min(self.config.top_r + 1, n + 1)
+        warm = 0
+        for b in buckets:
+            seeds = jnp.full((int(b),), n, dtype=jnp.int32)
+            # plan build + backend probe happen HERE regardless of cache
+            fkw = self._fused_bfs_kwargs(view, int(b))
+            if self.aot is None:
+                continue
+            for hops in hops_list:
+                # independent try blocks: a bucket whose unfused lowering
+                # fails must not forfeit the fused warm (or vice versa) —
+                # whichever entry the first dispatch routes to should be
+                # hot
+                try:
+                    warm += self.aot.warm(
+                        "ops.serving.bfs_serve_batch", bfs_serve_batch,
+                        (view.device, view.delta, seeds),
+                        {"max_hops": hops, "top_r": top_r},
+                    )
+                except Exception:  # noqa: BLE001 - never block startup
+                    pass
+                if fkw is None or fkw["overlay"] is not None:
+                    continue
+                try:
+                    warm += self.aot.warm(
+                        "ops.serving.bfs_serve_batch_fused",
+                        bfs_serve_batch_fused,
+                        (fkw["fused"], seeds, fkw["n_atoms"]),
+                        {"geom": fkw["geom"], "kwp": fkw["kwp"],
+                         "max_hops": hops, "top_r": top_r,
+                         "widths1": fkw["widths1"],
+                         "widths2": fkw["widths2"]},
+                    )
+                except Exception:  # noqa: BLE001
+                    continue
+        return warm
+
+    def _fused_bfs_kwargs(self, view, bucket: int):
+        """Route this batch through the fused Pallas kernel? None keeps
+        the unfused chain. Gates, in order: config, backend preflight,
+        pending tombstones (the composed adjacency cannot neutralize a
+        dead link — bounded by the next compaction), plan budgets /
+        overlay planability."""
+        if not self.config.use_pallas_bfs:
+            return None
+        from hypergraphdb_tpu.ops import pallas_bfs as _pbfs
+
+        if not _pbfs.pallas_bfs_ok():
+            return None
+        if view.dead:
+            return None
+        try:
+            return _pbfs.serve_fused_kwargs(view.base, view.delta, bucket)
+        except Exception:  # noqa: BLE001 - any plan surprise → fallback
+            return None
 
     def _dispatch_cm(self, kind: str, bucket: int, statics: int):
         """The per-dispatch profiler annotation, active only when device
@@ -201,17 +406,20 @@ class DeviceExecutor:
                 out.lane_tickets.append((lane, t))
                 lane += 1
             if out.lane_tickets:
-                from hypergraphdb_tpu.ops.serving import bfs_serve_batch
-
                 # one slot beyond top_r: an include_seed=False request
                 # drops its seed from the window, and the spare slot keeps
                 # the remaining prefix full-width (see _bfs_result)
                 top_r = min(self.config.top_r + 1, n + 1)
+                fused_kw = self._fused_bfs_kwargs(view, batch.bucket)
                 with self._dispatch_cm("bfs", batch.bucket, max_hops):
-                    out.dev_out = bfs_serve_batch(
-                        view.device, view.delta, jnp.asarray(seeds),
-                        max_hops, top_r,
-                    )
+                    if fused_kw is not None:
+                        out.dev_out = self._serve_bfs_fused(
+                            fused_kw, jnp.asarray(seeds), max_hops, top_r,
+                        )
+                    else:
+                        out.dev_out = self._serve_bfs(
+                            view, jnp.asarray(seeds), max_hops, top_r,
+                        )
         elif kind == "pattern":
             from hypergraphdb_tpu.ops.serving import NO_TYPE, \
                 pattern_serve_batch
@@ -471,6 +679,22 @@ class ServeRuntime:
             else DeviceExecutor(graph, self.config, self.stats)
         )
         self.graph = graph
+        # deploy-time compile: load-or-build the serving executables for
+        # every bucket BEFORE the dispatch thread takes traffic, so a
+        # warm AOT cache reaches first dispatch without recompiling.
+        # Runs with no cache too — the fused plan build + backend probe
+        # must not wait for the first live request (injected executors
+        # without a prewarm hook are skipped)
+        if (self.config.prewarm_aot and graph is not None
+                and callable(getattr(self.executor, "prewarm", None))):
+            try:
+                self.executor.prewarm(self.config.buckets)
+            except Exception:  # pragma: no cover - never block startup
+                import logging
+
+                logging.getLogger("hypergraphdb_tpu.serve").warning(
+                    "aot prewarm failed", exc_info=True,
+                )
         #: in-flight batch: (tickets, executor token, batch key,
         #: device_attempted) — what _finalize needs, incl. the breaker's
         #: success/failure bookkeeping
@@ -892,4 +1116,8 @@ class ServeRuntime:
         self.close(drain=True)
 
     def stats_snapshot(self) -> dict:
-        return self.stats.snapshot(queue_depth=self.queue.depth())
+        out = self.stats.snapshot(queue_depth=self.queue.depth())
+        aot = getattr(self.executor, "aot", None)
+        if aot is not None:
+            out["aot"] = aot.stats.as_dict()
+        return out

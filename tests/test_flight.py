@@ -193,3 +193,65 @@ def test_healthy_path_is_silent(flight):
     assert flight.incidents == 0
     assert flight.last_dump_path is None
     assert len(flight.records()) <= flight.capacity
+
+
+# ------------------------------------------------------------- sigterm hook
+
+
+def test_sigterm_dump_via_subprocess(tmp_path):
+    """The opt-in SIGTERM hook (PR 7 follow-up): an orderly kill dumps
+    the flight window before the process dies with the conventional
+    -SIGTERM status — exercised in a REAL subprocess because signal
+    disposition is process-global state a test must not repurpose."""
+    import signal
+    import subprocess
+    import sys
+
+    code = f"""
+import os, signal
+from hypergraphdb_tpu.obs.flight import FlightRecorder, install_sigterm_dump
+
+rec = FlightRecorder(incident_dir={str(tmp_path)!r}, min_dump_interval_s=0.0)
+rec.record("serve.retry", attempt=1)
+rec.record("breaker.transition", state="open")
+install_sigterm_dump(rec)
+os.kill(os.getpid(), signal.SIGTERM)
+raise SystemExit("unreachable: the re-delivered SIGTERM must kill us")
+"""
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == -signal.SIGTERM, proc.stderr
+    dumps = sorted(tmp_path.glob("flight_*_sigterm.jsonl"))
+    assert len(dumps) == 1, list(tmp_path.iterdir())
+    recs = parse_flight_jsonl(dumps[0].read_text())
+    kinds = [r["kind"] for r in recs]
+    assert kinds == ["serve.retry", "breaker.transition", "incident"]
+    inc = recs[-1]
+    assert inc["reason"] == "sigterm" and inc["signal"] == int(
+        signal.SIGTERM
+    )
+
+
+def test_sigterm_hook_chains_and_uninstalls(tmp_path):
+    """In-process: a prior Python handler is invoked after the dump, and
+    uninstall restores it — the library never owns the signal outright."""
+    import os
+    import signal
+
+    from hypergraphdb_tpu.obs.flight import install_sigterm_dump
+
+    rec = FlightRecorder(incident_dir=str(tmp_path),
+                         min_dump_interval_s=0.0)
+    seen = []
+    prev = signal.signal(signal.SIGTERM, lambda n, f: seen.append(n))
+    try:
+        uninstall = install_sigterm_dump(rec)
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert seen == [signal.SIGTERM]       # chained, process alive
+        assert rec.incidents == 1 and rec.last_dump_path is not None
+        uninstall()
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert seen == [signal.SIGTERM, signal.SIGTERM]
+        assert rec.incidents == 1             # hook really removed
+    finally:
+        signal.signal(signal.SIGTERM, prev)

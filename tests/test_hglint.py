@@ -120,6 +120,20 @@ def test_smem_scalar_prefetch_budget():
     assert [f for f in ok if f.rule == "HG503"] == []
 
 
+def test_fused_bfs_kernel_window_fixtures():
+    """The fused pull-BFS hop kernel's window math (ops/pallas_bfs): the
+    scalar-prefetched chunk plan overflowing SMEM and the scratch+window
+    set overflowing VMEM are both caught; the committed real geometry
+    folds clean."""
+    findings = run_lint([str(FIXTURES / "bad_pkg" / "fusedbfs_bad.py")])
+    by_rule = {f.rule: f for f in findings}
+    assert set(by_rule) == {"HG501", "HG503"}
+    assert by_rule["HG503"].scope == "fused_hop_smem_overflow"
+    assert by_rule["HG501"].scope == "fused_hop_vmem_overflow"
+    ok = run_lint([str(FIXTURES / "clean_pkg" / "fusedbfs_ok.py")])
+    assert ok == [], "\n".join(f.render() for f in ok)
+
+
 def test_shapes_fold_through_scan_and_vmap():
     """ShapeDtype propagates through lax.scan carries and jax.vmap
     results: the wrapshape fixtures' None block dims fold, so overflows

@@ -1,0 +1,85 @@
+#!/usr/bin/env bash
+# Raw-speed gate: the fused-vs-unfused differential suite (fused Pallas
+# pull-BFS megakernel == the staged ellbfs chain == the dense serve
+# sweep, bit for bit, incl. the delta-overlay path) plus an AOT-cache
+# cold/warm smoke over a REAL ServeRuntime — the second process's
+# compile of every warmed bucket must be a cache hit.
+#
+# Sits beside lint.sh (AST hazards), verify.sh (jaxpr ground truth),
+# chaos.sh (fault injection), and obs.sh (telemetry): this one gates the
+# performance plane's correctness contracts.
+#
+# Usage: tools/perf.sh [extra pytest args]
+#   tools/perf.sh -k fused             # differential suite only
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
+    tests/test_pallas_bfs.py \
+    tests/test_pallas_gather.py \
+    -q -m 'not slow' -p no:cacheprovider "$@"
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "tools/perf.sh: differential suite failed (exit $rc)" >&2
+    exit "$rc"
+fi
+
+# -- AOT cold/warm smoke: a fresh process over a populated cache must
+#    reach first dispatch with zero compiles of the warmed buckets ------------
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - <<'PY'
+import subprocess
+import sys
+import tempfile
+
+cache = tempfile.mkdtemp(prefix="hg_perf_aot_")
+code = f"""
+import json
+import numpy as np
+from hypergraphdb_tpu import HyperGraph
+from hypergraphdb_tpu.serve import ServeConfig, ServeRuntime
+
+g = HyperGraph()
+nodes = list(g.add_nodes_bulk([f"n{{i}}" for i in range(60)]))
+r = np.random.default_rng(0)
+for i in range(120):
+    ts = r.choice(nodes, size=2, replace=False)
+    g.add_link([int(t) for t in ts], value=i)
+rt = ServeRuntime(g, ServeConfig(buckets=(4, 8), max_linger_s=0.001,
+                                 top_r=8, aot_cache_dir={cache!r}))
+res = rt.submit_bfs(int(nodes[0]), max_hops=2).result(timeout=120)
+print("AOT " + json.dumps(rt.stats_snapshot()["aot"]))
+rt.close()
+g.close()
+"""
+
+def run():
+    import json
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=600)
+    for line in proc.stdout.splitlines():
+        if line.startswith("AOT "):
+            return json.loads(line[4:])
+    raise SystemExit(f"aot smoke subprocess failed (rc={proc.returncode}):"
+                     f"\n{proc.stderr[-2000:]}")
+
+import shutil
+
+try:
+    cold = run()
+    warm = run()
+finally:
+    shutil.rmtree(cache, ignore_errors=True)  # multi-MB executables
+assert cold["misses"] >= 2 and cold["puts"] >= 2, f"cold never compiled: {cold}"
+assert warm["misses"] == 0, f"warm process recompiled: {warm}"
+assert warm["disk_hits"] >= 2, f"warm process missed the disk cache: {warm}"
+print(f"tools/perf.sh smoke: cold compiled {cold['misses']} buckets "
+      f"({cold['compile_s']}s), warm process hit {warm['disk_hits']} from "
+      f"disk with zero compiles — AOT cache OK")
+PY
+smoke_rc=$?
+if [ "$smoke_rc" -ne 0 ]; then
+    echo "tools/perf.sh: AOT cold/warm smoke failed (exit $smoke_rc)" >&2
+    exit "$smoke_rc"
+fi
+echo "tools/perf.sh: perf gate green"
+exit 0
