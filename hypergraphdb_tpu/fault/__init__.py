@@ -54,6 +54,8 @@ WIRED_POINTS = {
     "serve.collect": "DeviceExecutor.collect, before the result download",
     "peer.transport.send": "transport send (loopback + TCP): a fired "
                            "fault IS a dropped wire message",
+    "peer.journal.save": "redelivery-journal save, after the tmp is "
+                         "written, before os.replace publishes it",
     "ckpt.save_npz": "save_snapshot, after the tmp npz is written, "
                      "before os.replace publishes it",
     "ckpt.save_plans": "save_snapshot, after the tmp plans sidecar is "

@@ -121,6 +121,21 @@ class CircuitBreaker:
                 if notify:
                     self._notify_locked(key, g)
 
+    def reset(self, key) -> None:
+        """Administratively close ``key``'s gate NOW — the rejoin path:
+        a router whose health poll sees a previously-dead replica
+        answering again re-admits it immediately instead of waiting out
+        the cooldown + probe ladder. Notifies like any transition."""
+        with self._lock:
+            g = self._gates.get(key)
+            if g is not None and (g.state != CLOSED or g.failures):
+                notify = g.state != CLOSED
+                g.state = CLOSED
+                g.failures = 0
+                g.probe_t = None
+                if notify:
+                    self._notify_locked(key, g)
+
     def record_failure(self, key) -> None:
         """A device batch for ``key`` failed (launch or collect)."""
         with self._lock:
@@ -165,6 +180,24 @@ class CircuitBreaker:
                 _FLIGHT.incident("breaker_trip", key=str(key))
 
     # -- reading -------------------------------------------------------------
+    def peek(self, key) -> bool:
+        """Would :meth:`allow` admit ``key`` right now — WITHOUT
+        consuming the half-open probe token or transitioning the gate?
+        For placement-style callers that rank candidates they may never
+        dispatch to: burning the one-probe-per-cooldown token on a
+        backend the request doesn't reach would starve its actual
+        recovery probe. The dispatcher calls :meth:`allow` immediately
+        before committing."""
+        with self._lock:
+            g = self._gates.get(key)
+            if g is None or g.state == CLOSED:
+                return True
+            now = self.clock()
+            if g.state == OPEN:
+                return now - g.opened_t >= self.cooldown_s
+            # HALF_OPEN: a fresh probe window admits one
+            return g.probe_t is None or now - g.probe_t >= self.cooldown_s
+
     def state_of(self, key) -> str:
         with self._lock:
             g = self._gates.get(key)

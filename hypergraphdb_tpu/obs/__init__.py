@@ -70,6 +70,7 @@ from hypergraphdb_tpu.obs.flight import (
 from hypergraphdb_tpu.obs.http import (
     TelemetryServer,
     breaker_key_label,
+    composite_health,
     runtime_health,
 )
 from hypergraphdb_tpu.obs.registry import (
@@ -112,6 +113,7 @@ __all__ = [
     "annotate",
     "block_timed",
     "breaker_key_label",
+    "composite_health",
     "default_registry",
     "device",
     "disable",

@@ -71,6 +71,25 @@ def runtime_health(rt) -> HealthProbe:
     return probe
 
 
+def composite_health(*probes: HealthProbe) -> HealthProbe:
+    """AND-combine health probes into ONE ``/healthz`` surface: healthy
+    iff every probe is, payloads merged in order (later keys win). The
+    replica tier stacks its replication-lag probe on top of
+    :func:`runtime_health` this way — one endpoint, one JSON body, both
+    stories."""
+
+    def probe() -> Tuple[bool, dict]:
+        ok = True
+        payload: dict = {}
+        for p in probes:
+            healthy, part = p()
+            ok = ok and healthy
+            payload.update(part)
+        return ok, payload
+
+    return probe
+
+
 def breaker_key_label(key) -> str:
     """One stable label per batch key: ``("bfs", 2)`` → ``"bfs_2"`` —
     shared by ``/healthz`` and the per-key ``serve.breaker.*``

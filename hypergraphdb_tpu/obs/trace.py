@@ -324,10 +324,19 @@ class Tracer:
         self.default_sample_rate = 1.0
         self._rates: dict[str, float] = {}
         self._rng = random.Random(seed)
-        # adaptive controller state (None target = controller off)
+        # adaptive controller state (None target = controller off).
+        # PER-ROOT-KIND first: pressure halves the scale of the kind
+        # holding the largest share of the finished buffer (the hot
+        # kind), so replication-qps `peer.push` traces cannot starve
+        # `serve.request`'s budget; the GLOBAL scale is the outer clamp,
+        # halved only once the hot kind is already at its floor. The
+        # effective scale never drops below the floor.
         self._adapt_target: Optional[float] = None
         self._adapt_floor = 0.01
         self._adapt_scale = 1.0
+        self._adapt_kind_scales: dict[str, float] = {}
+        #: finished-buffer composition by root kind (who is filling it)
+        self._kind_fill: dict[str, int] = {}
         self._lock = threading.Lock()
         self._finished: deque[Trace] = deque(maxlen=max_finished)
         self._tls = threading.local()
@@ -354,10 +363,17 @@ class Tracer:
         return self
 
     def sample_rate_of(self, name: str) -> float:
-        """The EFFECTIVE rate for ``name`` (configured × adaptive scale)."""
+        """The EFFECTIVE rate for ``name``: configured × adaptive scale
+        (per-kind × global, floored)."""
         with self._lock:
-            return self._rates.get(name,
-                                   self.default_sample_rate) * self._adapt_scale
+            return (self._rates.get(name, self.default_sample_rate)
+                    * self._scale_locked(name))
+
+    def _scale_locked(self, name: str) -> float:
+        scale = self._adapt_scale * self._adapt_kind_scales.get(name, 1.0)
+        if self._adapt_target is not None:
+            scale = max(self._adapt_floor, scale)
+        return scale
 
     def enable_adaptive(self, target_fill: float = 0.5,
                         floor: float = 0.01) -> "Tracer":
@@ -380,6 +396,7 @@ class Tracer:
                 "default_rate": self.default_sample_rate,
                 "rates": dict(self._rates),
                 "adaptive_scale": self._adapt_scale,
+                "adaptive_kind_scales": dict(self._adapt_kind_scales),
                 "traces_started": self.traces_started,
                 "traces_dropped_unsampled": self.traces_dropped,
                 "traces_evicted": self.traces_evicted,
@@ -398,8 +415,8 @@ class Tracer:
             return None
         with self._lock:
             self.traces_started += 1
-            rate = self._rates.get(name,
-                                   self.default_sample_rate) * self._adapt_scale
+            rate = (self._rates.get(name, self.default_sample_rate)
+                    * self._scale_locked(name))
             sampled = rate >= 1.0 or self._rng.random() < rate
         return Trace(name, self.clock, self.max_spans, attrs, owner=self,
                      sampled=sampled)
@@ -437,13 +454,33 @@ class Tracer:
                 return
             if len(self._finished) == self._finished.maxlen:
                 self.traces_evicted += 1  # deque evicts the oldest
+                old = self._finished[0]
+                n = self._kind_fill.get(old.name, 0)
+                if n > 1:
+                    self._kind_fill[old.name] = n - 1
+                else:
+                    self._kind_fill.pop(old.name, None)
             self._finished.append(trace)
+            self._kind_fill[trace.name] = (
+                self._kind_fill.get(trace.name, 0) + 1
+            )
             if (self._adapt_target is not None
                     and self._finished.maxlen
                     and len(self._finished)
                     >= self._adapt_target * self._finished.maxlen):
-                self._adapt_scale = max(self._adapt_floor,
-                                        self._adapt_scale * 0.5)
+                # per-kind controller first: throttle whoever owns the
+                # largest share of the buffer, not every kind at once
+                hot = max(self._kind_fill, key=self._kind_fill.get)
+                cur = self._adapt_kind_scales.get(hot, 1.0)
+                if cur > self._adapt_floor:
+                    self._adapt_kind_scales[hot] = max(
+                        self._adapt_floor, cur * 0.5
+                    )
+                else:
+                    # the hot kind is floored and pressure persists:
+                    # the global scale is the outer clamp
+                    self._adapt_scale = max(self._adapt_floor,
+                                            self._adapt_scale * 0.5)
 
     # -- implicit API (single-thread chains) ---------------------------------
     @contextmanager
@@ -500,10 +537,17 @@ class Tracer:
         with self._lock:
             out = list(self._finished)
             self._finished.clear()
+            self._kind_fill.clear()
             if (self._adapt_target is not None and self._finished.maxlen
                     and len(out)
                     < 0.5 * self._adapt_target * self._finished.maxlen):
                 self._adapt_scale = min(1.0, self._adapt_scale * 2.0)
+                for k, v in list(self._adapt_kind_scales.items()):
+                    grown = min(1.0, v * 2.0)
+                    if grown >= 1.0:
+                        del self._adapt_kind_scales[k]
+                    else:
+                        self._adapt_kind_scales[k] = grown
             return out
 
     def peek(self, n: Optional[int] = None) -> list[Trace]:

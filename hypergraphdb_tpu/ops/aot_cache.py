@@ -77,6 +77,7 @@ class AOTStats:
     stale: int = 0
     corrupt: int = 0
     puts: int = 0
+    gc_removed: int = 0     # superseded entries deleted by the open sweep
     compile_s: float = 0.0  # wall seconds spent actually compiling
 
     def as_dict(self) -> dict:
@@ -84,7 +85,8 @@ class AOTStats:
             "hits": self.hits, "misses": self.misses,
             "disk_hits": self.disk_hits, "mem_hits": self.mem_hits,
             "stale": self.stale, "corrupt": self.corrupt,
-            "puts": self.puts, "compile_s": round(self.compile_s, 3),
+            "puts": self.puts, "gc_removed": self.gc_removed,
+            "compile_s": round(self.compile_s, 3),
         }
 
 
@@ -121,11 +123,95 @@ class AOTCache:
     content_key: str = ""
     backend: Optional[str] = None
     stats: AOTStats = field(default_factory=AOTStats)
+    #: open-time GC bounds (ROADMAP 4f): superseded content generations'
+    #: files older than ``gc_max_age_s`` are deleted, and oldest-first
+    #: beyond ``gc_max_bytes`` of directory total — a long-lived replica
+    #: otherwise accumulates multi-MB orphaned executables across every
+    #: compaction generation. ``gc_max_age_s=None`` disables the sweep.
+    gc_max_age_s: Optional[float] = 7 * 86400.0
+    gc_max_bytes: int = 256 * 1024 * 1024
 
     def __post_init__(self):
         self.dir = os.path.join(self.root, env_fingerprint(self.backend))
         os.makedirs(self.dir, exist_ok=True)
         self._mem: dict[str, Any] = {}
+        if self.gc_max_age_s is not None:
+            try:
+                self.gc()
+            except Exception:  # noqa: BLE001 - a broken sweep never gates
+                log.warning("aot cache gc failed in %s", self.dir,
+                            exc_info=True)
+
+    # -- open-time GC ---------------------------------------------------------
+    def _entry_content_key(self, path: str) -> Optional[str]:
+        """The entry's header content_key, reading ONLY magic + header
+        line (never the multi-MB payload); None for unreadable files —
+        those would be rebuilt on load anyway, so GC treats them as
+        superseded."""
+        try:
+            with open(path, "rb") as f:
+                if f.read(len(_MAGIC)) != _MAGIC:
+                    return None
+                header = json.loads(f.readline().decode("utf-8"))
+            return str(header.get("content_key", ""))
+        except Exception:  # noqa: BLE001 - damaged header
+            return None
+
+    def gc(self, now: Optional[float] = None) -> int:
+        """Sweep the cache directory (called at open): delete entries of
+        SUPERSEDED content generations — files whose header content_key
+        differs from this cache's — once older than ``gc_max_age_s``,
+        then oldest-superseded-first while the directory's total size
+        exceeds ``gc_max_bytes``. Current-generation entries are never
+        touched (the prewarm relies on them), and abandoned ``*.tmp.*``
+        writer leftovers past the age bound go too. Returns how many
+        files were removed (also counted in ``stats.gc_removed``)."""
+        if self.gc_max_age_s is None:
+            # the documented off switch — without this, a MANUAL gc()
+            # would read None as age 0 and delete every superseded entry
+            # plus any tmp a concurrent writer is mid-writing
+            return 0
+        if now is None:
+            now = time.time()
+        removed = 0
+        superseded: list[tuple[float, int, str]] = []  # (mtime, size, path)
+        total = 0
+        for name in os.listdir(self.dir):
+            path = os.path.join(self.dir, name)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            if ".tmp." in name:  # crashed writer's leftover
+                if now - st.st_mtime > self.gc_max_age_s:
+                    try:
+                        os.unlink(path)
+                        removed += 1
+                    except OSError:
+                        pass
+                continue
+            if not name.endswith(".aot"):
+                continue
+            total += st.st_size
+            ck = self._entry_content_key(path)
+            if ck != self.content_key:
+                superseded.append((st.st_mtime, st.st_size, path))
+        superseded.sort()  # oldest first
+        for mtime, size, path in superseded:
+            if (now - mtime <= self.gc_max_age_s
+                    and total <= self.gc_max_bytes):
+                continue  # young AND within budget: keep for now
+            try:
+                os.unlink(path)
+            except OSError:
+                continue
+            removed += 1
+            total -= size
+        self.stats.gc_removed += removed
+        if removed:
+            log.info("aot cache gc: removed %d superseded entries from %s",
+                     removed, self.dir)
+        return removed
 
     # -- keys -----------------------------------------------------------------
     def key_for(self, entry: str, args: tuple, statics: dict) -> str:

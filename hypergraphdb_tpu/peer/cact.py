@@ -410,7 +410,15 @@ class TransferGraphClient(Activity):
             self.reply(sender, msg, M.CONFIRM, {"pos": self.expected},
                        trace_ctx=self._tctx)
             return
-        n_applied = len(transfer.store_closure(self.peer.graph, c["atoms"]))
+        # the peer's apply mutex: replication pushes arriving WHILE the
+        # transfer streams (a bootstrapping replica with its interest
+        # already published) must not race a chunk's store of the same
+        # gid — store_closure's check-then-act is idempotent only when
+        # serialized
+        with self.peer.apply_lock:
+            n_applied = len(
+                transfer.store_closure(self.peer.graph, c["atoms"])
+            )
         self.stored += n_applied
         tr = self._trace
         if tr is not None:
@@ -427,6 +435,8 @@ class TransferGraphClient(Activity):
                 # server's head at open; catch-up resumes from there
                 if self.log_head > rep.last_seen.get(sender, 0):
                     rep.last_seen.set(sender, self.log_head)
+                if self.log_head > rep.peer_heads.get(sender, 0):
+                    rep.peer_heads[sender] = self.log_head
                 rep.needs_full_sync.discard(sender)
             if tr is not None:
                 tr.finish_terminal("resolve", stored=self.stored)

@@ -46,6 +46,17 @@ class HyperGraphPeer:
         self.tracer = global_tracer()
         #: persisted peer identity (HGPeerIdentity analogue)
         self.identity = identity or self._load_identity()
+        #: serializes REPLICATED writes into the local graph across the
+        #: peer's threads: the replication apply worker and a snapshot
+        #: transfer both ``store_closure`` — unserialized, two threads
+        #: racing the same gid's check-then-act would twin the atom
+        #: (a bootstrapping replica receives pushes WHILE its transfer
+        #: streams; both are idempotent only under this mutex)
+        import threading
+
+        self.apply_lock = threading.Lock()
+        #: serializes start()/stop() (check-and-set on ``_started``)
+        self._lifecycle_lock = threading.Lock()
         self.activities = ActivityManager(self)
         self.replication = Replication(self)
         #: peers whose identity handshake completed (AffirmIdentity
@@ -83,27 +94,34 @@ class HyperGraphPeer:
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
-        if self._started:
-            return
-        self.interface.peer_id = self.identity
-        if getattr(self.interface, "metrics", None) is None:
-            # peer.* observability rides the graph's metrics registry —
-            # one Prometheus scrape covers graph + tx + peer planes
-            self.interface.metrics = self.graph.metrics
-        self.interface.on_message(self._dispatch)
-        self.interface.start()
-        self.activities.start()
-        self.replication.attach()
-        self._started = True
+        with self._lifecycle_lock:
+            if self._started:
+                return
+            self.interface.peer_id = self.identity
+            if getattr(self.interface, "metrics", None) is None:
+                # peer.* observability rides the graph's metrics registry —
+                # one Prometheus scrape covers graph + tx + peer planes
+                self.interface.metrics = self.graph.metrics
+            self.interface.on_message(self._dispatch)
+            self.interface.start()
+            self.activities.start()
+            self.replication.attach()
+            self._started = True
         self.affirm_identity()
 
     def stop(self) -> None:
-        if not self._started:
-            return
-        self.replication.detach()  # flush pending pushes, stop the worker
-        self.activities.stop()
-        self.interface.stop()
-        self._started = False
+        # the WHOLE teardown runs under the lifecycle lock: flipping
+        # _started first and tearing down outside it would let a racing
+        # start() rebuild the components in the gap, only for this
+        # in-flight stop to tear the fresh ones down (none of the joined
+        # workers take this lock, so holding it across the joins is safe)
+        with self._lifecycle_lock:
+            if not self._started:
+                return
+            self.replication.detach()  # flush pushes, stop the worker
+            self.activities.stop()
+            self.interface.stop()
+            self._started = False
 
     # -- identity handshake (AffirmIdentityBootstrap) --------------------------
     def affirm_identity(self) -> None:

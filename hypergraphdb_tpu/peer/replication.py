@@ -230,15 +230,37 @@ class OpLog:
 
 
 class SeenMap:
-    """Durable vector clock: peer id → last seq of THEIR log applied here.
-    Persisted through the store so catch-up resumes correctly after BOTH
-    sides restart (ref ``CatchUpTaskClient.java:33``)."""
+    """Durable, GAP-AWARE vector clock: peer id → applied-seq intervals
+    of THEIR log. Two views per peer:
+
+    - the **contiguous ack** (:meth:`get`): the highest seq such that
+      every entry up to it has been applied here — what we acknowledge
+      to the sender and request catch-up ``since``. This is the value
+      persisted through the store (one entry per peer, same index/schema
+      as the pre-gap-aware map), so catch-up resumes correctly after
+      BOTH sides restart (ref ``CatchUpTaskClient.java:33``);
+    - the **applied intervals** (:meth:`intervals` / :meth:`gaps`): the
+      full set of applied seq ranges, RAM-only. A push that skips ahead
+      (its predecessors dropped past the redelivery budget) opens a
+      HOLE between intervals — the divergence the old max-applied ack
+      silently papered over. :class:`Replication` watches
+      :meth:`has_gap` and repairs by targeted catch-up from the
+      contiguous ack; the re-applied tail is idempotent, so losing the
+      RAM intervals in a crash costs a re-fetch, never correctness.
+
+    Seq 0 means "nothing" and is trivially applied, so interval 0 always
+    starts at 0 and the contiguous ack is its high end. Anchors
+    (:meth:`set` — a completed snapshot transfer, a legacy max-ack) cover
+    the whole prefix ``[0, seq]``."""
 
     IDX = "hg.sys.oplog.seen"
 
     def __init__(self, graph=None) -> None:
         self._graph = graph
-        self._map: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._map: dict[str, int] = {}  # contiguous ack (durable)
+        #: pid → sorted disjoint [lo, hi] intervals of applied seqs
+        self._ranges: dict[str, list[list[int]]] = {}
         if graph is not None:
             idx = graph.store.get_index(self.IDX, create=False)
             if idx is not None:
@@ -246,29 +268,120 @@ class SeenMap:
                     vals = hs.tolist()
                     if vals:
                         self._map[key.decode("utf-8")] = max(vals)
+        for pid, v in self._map.items():
+            self._ranges[pid] = [[0, v]]
+        #: pid → last value durably written (the remove key of the next
+        #: persist); loaded state IS persisted state
+        self._persisted: dict[str, int] = dict(self._map)
 
     def get(self, pid: str, default: int = 0) -> int:
-        return self._map.get(pid, default)
+        with self._lock:
+            return self._map.get(pid, default)
 
     def set(self, pid: str, seq: int) -> None:
-        prev = self._map.get(pid)
-        if prev is not None and seq <= prev:
-            return  # no durable rewrite for an unchanged/backward clock
-        self._map[pid] = seq
+        """Anchor: everything up to ``seq`` is covered (snapshot
+        bootstrap semantics — the transfer shipped the whole prefix)."""
+        self._cover(pid, 0, int(seq))
+
+    def record_applied(self, pid: str, seq: int,
+                       prev: Optional[int] = None,
+                       persist: bool = True) -> int:
+        """One entry of ``pid``'s log applied here; returns the (possibly
+        advanced) contiguous ack. ``prev`` — the seq the sender last
+        PUSHED to us before this one — additionally covers the range
+        ``(prev, seq)``: those positions hold entries the sender's
+        interest predicate deliberately skipped, not losses (a real loss
+        is a pushed-but-dropped seq, and ``prev`` points AT it, never
+        past it — so the hole it leaves stays visible).
+        ``persist=False`` defers the durable store write — a batch
+        applier covers each position in RAM and calls :meth:`persist`
+        ONCE per sender per cycle instead of paying one store
+        transaction per in-order push."""
+        if seq <= 0:
+            return self.get(pid)
+        seq = int(seq)
+        lo = seq
+        if prev is not None and 0 <= int(prev) < seq:
+            lo = int(prev) + 1
+        return self._cover(pid, lo, seq, persist=persist)
+
+    def _cover(self, pid: str, lo: int, hi: int,
+               persist: bool = True) -> int:
+        with self._lock:
+            ivs = self._ranges.setdefault(pid, [[0, 0]])
+            ivs.append([lo, hi])
+            ivs.sort()
+            merged = [ivs[0][:]]
+            for a, b in ivs[1:]:
+                if a <= merged[-1][1] + 1:  # overlapping or adjacent
+                    merged[-1][1] = max(merged[-1][1], b)
+                else:
+                    merged.append([a, b])
+            self._ranges[pid] = merged
+            contiguous = merged[0][1]  # merged[0][0] == 0 by seeding
+            prev = self._map.get(pid)
+            advanced = prev is None or contiguous > prev
+            if advanced:
+                self._map[pid] = contiguous
+        if persist and advanced:
+            self.persist(pid)
+        return contiguous
+
+    def persist(self, pid: str) -> None:
+        """Durably store ``pid``'s current contiguous ack if it advanced
+        past the last persisted value (no-op otherwise). The store tx
+        runs OUTSIDE the leaf lock; an exception propagates — callers
+        must not ack past an unpersisted position (they retry on the
+        next cycle; the sender re-serves from our last durable ack and
+        apply is idempotent)."""
         g = self._graph
-        if g is not None:
-            key = pid.encode("utf-8")
+        if g is None:
+            return
+        with self._lock:
+            cur = self._map.get(pid)
+            prev = self._persisted.get(pid)
+        if cur is None or (prev is not None and cur <= prev):
+            return
+        key = pid.encode("utf-8")
 
-            def persist() -> None:
-                idx = g.store.get_index(self.IDX)
-                if prev is not None:
-                    idx.remove_entry(key, prev)
-                idx.add_entry(key, seq)
+        def persist_tx() -> None:
+            idx = g.store.get_index(self.IDX)
+            if prev is not None:
+                idx.remove_entry(key, prev)
+            idx.add_entry(key, cur)
 
-            g.txman.ensure_transaction(persist)
+        g.txman.ensure_transaction(persist_tx)
+        with self._lock:
+            if self._persisted.get(pid, -1) < cur:
+                self._persisted[pid] = cur
+
+    # -- gap queries -----------------------------------------------------------
+    def intervals(self, pid: str) -> list[tuple[int, int]]:
+        with self._lock:
+            return [tuple(iv) for iv in self._ranges.get(pid, [[0, 0]])]
+
+    def max_applied(self, pid: str) -> int:
+        with self._lock:
+            ivs = self._ranges.get(pid)
+            return ivs[-1][1] if ivs else 0
+
+    def has_gap(self, pid: str) -> bool:
+        with self._lock:
+            return len(self._ranges.get(pid, ())) > 1
+
+    def gaps(self, pid: str) -> list[tuple[int, int]]:
+        """The missing seq ranges between applied intervals — what a
+        targeted repair catch-up must re-fetch."""
+        with self._lock:
+            ivs = self._ranges.get(pid, [])
+            return [
+                (ivs[i][1] + 1, ivs[i + 1][0] - 1)
+                for i in range(len(ivs) - 1)
+            ]
 
     def items(self):
-        return self._map.items()
+        with self._lock:
+            return list(self._map.items())
 
 
 class Replication:
@@ -318,9 +431,24 @@ class Replication:
         self._apply_cv = threading.Condition()
         self._apply_worker: Optional[threading.Thread] = None
         self._apply_busy = 0
-        #: how far each peer has acknowledged MY log (their applied seq);
-        #: min over interested peers gates log truncation
+        #: how far each peer has acknowledged MY log (their CONTIGUOUS
+        #: applied seq — gap-aware); min over interested peers gates log
+        #: truncation, so a peer stuck behind a gap pins the floor until
+        #: its repair catch-up has what it needs
         self.peer_acks: dict[str, int] = {}
+        #: last known HEAD of each peer's log (push/catch-up/digest
+        #: metadata rides it along) — ``replication_lag`` reads this
+        self.peer_heads: dict[str, int] = {}
+        #: peers with a detected apply gap whose targeted repair
+        #: catch-up is in flight (cleared when a catch-up page arrives,
+        #: so a lost repair request re-triggers on the next apply cycle)
+        self._gap_repairs: set[str] = set()
+        #: contiguous position at each peer's LAST digest-result — the
+        #: anti-entropy stall detector: behind-the-head is only repaired
+        #: when we made no progress since the previous probe (or on the
+        #: first probe), so steady in-flight ingest doesn't trigger a
+        #: redundant catch-up every tick
+        self._ae_seen_pos: dict[str, int] = {}
         #: auto-truncate the op log once every interested peer has
         #: acknowledged at least `truncate_batch` entries past the floor
         self.auto_truncate = True
@@ -345,13 +473,13 @@ class Replication:
         # redelivered remove can never land after a newer re-add.
         # Receivers apply idempotently (store_closure is a write-through
         # upsert keyed by gid) and the SeenMap records only applied
-        # progress, so a duplicated push is a no-op. Honest limit: a
-        # message dropped past max_redeliveries is a real gap — the
-        # receiver's max-applied ack may already have advanced past it,
-        # so incremental catch-up alone does not refetch it (pre-existing
-        # semantics for any lost push); full convergence for such a peer
-        # is the TransferGraph bootstrap, and gap-aware acks are a seeded
-        # ROADMAP follow-up.
+        # progress, so a duplicated push is a no-op. A message dropped
+        # past max_redeliveries is a real wire loss — but no longer a
+        # SILENT one: the receiver's SeenMap tracks applied-seq
+        # CONTIGUITY, so the hole shows the moment a later push lands
+        # (targeted catch-up repairs it), and the periodic anti-entropy
+        # digest catches the before-a-silence case; the journal below
+        # additionally lets the queue itself survive a process death.
         self.send_attempts = 3
         self.send_backoff_s = 0.02
         self.send_backoff_max_s = 0.25
@@ -366,6 +494,33 @@ class Replication:
         #: emptied entries are popped so dict truthiness == "work queued"
         self._redelivery: dict[str, Any] = {}
         self._redelivery_n = 0
+        #: crash-surviving redelivery queue: path of a JSONL journal
+        #: (None + a persistent graph → defaulted beside the store at
+        #: attach()). Rewritten crash-atomically (fsync + os.replace,
+        #: the ops/checkpoint discipline) by the worker whenever the
+        #: queue changes; replayed on attach, so queued-but-undelivered
+        #: pushes survive a process death instead of dying with it.
+        #: Receivers apply idempotently, so replay is safe by
+        #: construction.
+        self.journal_path: Optional[str] = None
+        self._journal_dirty = False
+        #: minimum spacing of DIRTY-queue journal rewrites: each save is
+        #: O(total backlog), so a hot ingest loop against one dead peer
+        #: would otherwise pay a growing multi-MB rewrite EVERY worker
+        #: cycle, throttling replication to the healthy peers through
+        #: the shared worker. An EMPTY queue always saves immediately —
+        #: the state flush() reports settled stays journal-exact; the
+        #: widened crash window only risks re-losing messages the gap
+        #: tracking / anti-entropy backstops already repair.
+        self.journal_save_interval_s = 0.25
+        self._journal_last_save = 0.0
+        #: last seq actually pushed per peer (anchored at the log head
+        #: when the interest registers): pushes carry it as ``prev`` so
+        #: interest-filtered receivers can tell a predicate skip from a
+        #: wire loss. RAM-only is safe: fanout only reaches peers in
+        #: ``peer_interests``, and the interest handler re-anchors on
+        #: every (re)registration
+        self._sent_head: dict[str, int] = {}
         #: peers whose LAST ladder exhausted → fresh pushes skip straight
         #: to the redelivery queue until the grace expires, so one dead
         #: peer's backoff sleeps cannot head-of-line-block the worker's
@@ -386,6 +541,15 @@ class Replication:
         g.events.add_listener(ev.HGAtomReplacedEvent, self._on_replaced)
         self._listening = True
         self._stopping = False
+        if self.journal_path is None:
+            loc = getattr(getattr(g, "config", None), "location", None)
+            if loc:
+                import os
+
+                self.journal_path = os.path.join(
+                    loc, "replication.redelivery.jsonl"
+                )
+        self._journal_replay()
         self._worker = threading.Thread(
             target=self._drain, name="replication-push", daemon=True
         )
@@ -509,8 +673,8 @@ class Replication:
                 log_batch, pushes = [], []
             try:
                 self.log.persist_many(log_batch)  # one tx for the batch
-                for _, kind, h, entry in pushes:
-                    self._fanout(kind, h, entry)
+                for (seq, _, _), kind, h, entry in pushes:
+                    self._fanout(kind, h, entry, seq)
                 # truncation that lost a race against a hot ingest loop
                 # retries here, when the writer has gone quiet
                 self._maybe_truncate()
@@ -538,6 +702,18 @@ class Replication:
                     "replication redelivery pass failed", exc_info=True
                 )
             finally:
+                if self._journal_dirty:
+                    # persist queue changes BEFORE flush() can observe
+                    # the cycle as settled — journal == queue state.
+                    # Rate-limited while a backlog churns (each save is
+                    # O(backlog)); the settled/EMPTY state always saves
+                    now_m = time.monotonic()
+                    if (not self._redelivery
+                            or now_m - self._journal_last_save
+                            >= self.journal_save_interval_s):
+                        self._journal_dirty = False
+                        self._journal_last_save = now_m
+                        self._journal_save()
                 with self._cv:
                     self._draining -= len(batch)
                     self._cv.notify_all()
@@ -644,10 +820,10 @@ class Replication:
             "root": entry["root"],
         }
 
-    def _fanout(self, kind: str, h: int, entry: dict) -> None:
+    def _fanout(self, kind: str, h: int, entry: dict, seq: int = 0) -> None:
         if kind == "remove":
             for pid in list(self.peer_interests):
-                self._push(pid, "remove", entry)
+                self._push(pid, "remove", entry, seq)
             return
         targets = [
             pid for pid, cond in list(self.peer_interests.items())
@@ -660,7 +836,7 @@ class Replication:
         # so expand to the full closure (same rule as catch-up serving)
         entry = self._expand_for_wire(kind, entry)
         for pid in targets:
-            self._push(pid, kind, entry)
+            self._push(pid, kind, entry, seq)
 
     def _matches(self, cond, h: int) -> bool:
         try:
@@ -668,11 +844,26 @@ class Replication:
         except Exception:
             return False
 
-    def _push(self, pid: str, kind: str, entry: dict) -> None:
+    def _push(self, pid: str, kind: str, entry: dict,
+              seq: int = 0) -> None:
+        # the push carries the ENTRY's own seq (gap-aware receivers
+        # record exactly which log positions they applied — a batch-wide
+        # head would make every entry of a drained batch look applied the
+        # moment any one of them lands) plus the current head, so the
+        # receiver's advertised lag is fresh on every push, plus ``prev``
+        # — the last seq actually PUSHED to this peer: seqs in
+        # (prev, seq) were skipped by the peer's own interest predicate,
+        # so the receiver covers them as accounted-for instead of
+        # reading every predicate skip as a wire loss and burning a
+        # full-log repair catch-up per apply cycle (a REAL loss is a
+        # pushed-but-dropped seq — prev points AT it, never past it)
+        s = seq or self.log.head
+        prev = self._sent_head.get(pid, 0)
+        self._sent_head[pid] = s
         msg = M.make_message(
             M.INFORM, self.ACTIVITY_TYPE,
             {"what": "push", "kind": kind, "entry": entry,
-             "seq": self.log.head},
+             "seq": s, "head": self.log.head, "prev": prev},
         )
         # distributed tracing (worker thread, one enabled read): the push
         # roots a cross-process tree — the receiver's apply subtree joins
@@ -742,6 +933,7 @@ class Replication:
             q = self._redelivery[pid] = deque()
         q.append((message, attempt))
         self._redelivery_n += 1
+        self._journal_dirty = True
         with self._cv:
             self._cv.notify_all()
 
@@ -762,6 +954,7 @@ class Replication:
                 if self._send_reliable(pid, msg):
                     q.popleft()
                     self._redelivery_n -= 1
+                    self._journal_dirty = True
                     continue
                 # ladder failed: leave the rest queued behind the head
                 # (per-peer order is the invariant), probe again next
@@ -769,12 +962,84 @@ class Replication:
                 if attempt >= self.max_redeliveries:
                     q.popleft()
                     self._redelivery_n -= 1
+                    self._journal_dirty = True
                     m.incr("peer.redelivery_dropped")
                 else:
                     q[0] = (msg, attempt + 1)
                 break
             if not q:
                 self._redelivery.pop(pid, None)
+
+    # -- redelivery journal (crash-surviving queue) -----------------------------
+    def _journal_replay(self) -> None:
+        """Load a surviving journal into the redelivery queue (peer
+        open). Order within the file IS per-peer submission order — the
+        save writes queues front-to-back — so the per-peer ordering
+        invariant survives the restart too."""
+        path = self.journal_path
+        if path is None:
+            return
+        import json
+        import os
+
+        if not os.path.exists(path):
+            return
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    rec = json.loads(line)
+                    pid = rec["pid"]
+                    q = self._redelivery.get(pid)
+                    if q is None:
+                        q = self._redelivery[pid] = deque()
+                    q.append((rec["message"], int(rec.get("attempt", 1))))
+                    self._redelivery_n += 1
+        except Exception:
+            import logging
+
+            logging.getLogger("hypergraphdb_tpu.peer").warning(
+                "redelivery journal %s unreadable; starting empty", path,
+                exc_info=True,
+            )
+
+    def _journal_save(self) -> None:
+        """Crash-atomic rewrite of the redelivery journal (worker thread
+        only, after a cycle that changed the queue): same-directory tmp,
+        fsync, ``os.replace`` — the ``ops/checkpoint._atomic_write``
+        discipline, so a death at any instant leaves the previous
+        complete journal, never a torn one. An unwritable path logs and
+        degrades to the old dies-with-the-process behavior."""
+        path = self.journal_path
+        if path is None:
+            return
+        import json
+
+        from hypergraphdb_tpu.ops.checkpoint import _atomic_write
+
+        lines = []
+        for pid, q in self._redelivery.items():
+            for msg, attempt in q:
+                lines.append(json.dumps(
+                    {"pid": pid, "attempt": attempt, "message": msg},
+                    sort_keys=True,
+                ))
+        data = "".join(line + "\n" for line in lines).encode("utf-8")
+        try:
+            # the ONE crash-atomic publish (tmp + fsync + os.replace +
+            # the registered crash point), not a drifting inline copy:
+            # ordinary failure cleans the tmp, an InjectedCrash at
+            # peer.journal.save leaves it behind like a real kill
+            _atomic_write(path, lambda f: f.write(data),
+                          "peer.journal.save")
+        except Exception:
+            import logging
+
+            logging.getLogger("hypergraphdb_tpu.peer").warning(
+                "redelivery journal save failed (%s)", path, exc_info=True
+            )
 
     # -- interest publication ---------------------------------------------------
     def publish_interest(self, condition) -> None:
@@ -788,13 +1053,16 @@ class Replication:
             ))
 
     # -- catch-up ---------------------------------------------------------------
-    def catch_up(self, pid: str) -> None:
+    def catch_up(self, pid: str) -> bool:
         """Ask ``pid`` for its log entries after my recorded position
         (reliable-send: a dropped request retries with backoff — losing
         it would silently stall convergence until the next manual call).
-        Traced: each page roots one cross-process tree — request here,
-        ``catchup_serve`` on the server, ``apply`` back here — joined on
-        the propagated trace id."""
+        Returns whether the request was SENT (False when even the
+        reliable-send budget couldn't reach the peer — the caller's cue
+        that no catchup-result will ever arrive). Traced: each page
+        roots one cross-process tree — request here, ``catchup_serve``
+        on the server, ``apply`` back here — joined on the propagated
+        trace id."""
         self.peer.graph.metrics.incr("peer.catchups")
         msg = M.make_message(
             M.REQUEST, self.ACTIVITY_TYPE,
@@ -811,6 +1079,56 @@ class Replication:
         if tr is not None:
             tr.finish_terminal("sent" if ok else "error",
                                **({} if ok else {"error": "SendFailed"}))
+        return ok
+
+    def _check_gap(self, sender: str) -> None:
+        """Receiver-side gap repair (apply worker): applied-seq intervals
+        with a hole mean a push was lost past the redelivery budget —
+        exactly the divergence the old max-applied ack could never see.
+        Trigger ONE targeted catch-up from the contiguous ack (the pages
+        re-cover the hole; re-applying the already-applied tail is
+        idempotent); the in-flight mark clears when a catch-up page
+        arrives, so a lost repair request re-triggers instead of wedging.
+        NOTE for interest-FILTERED peers: a seq the sender's predicate
+        skipped looks like a hole too — the repair catch-up then fetches
+        it, which matches catch-up's existing unfiltered semantics."""
+        if not self.last_seen.has_gap(sender):
+            self._gap_repairs.discard(sender)
+            return
+        if sender in self._gap_repairs:
+            return
+        self._gap_repairs.add(sender)
+        self.peer.graph.metrics.incr("peer.gaps_detected")
+        try:
+            if not self.catch_up(sender):
+                # the request never left (reliable-send budget spent):
+                # no catchup-result will ever clear the mark — drop it
+                # so the next apply cycle re-triggers instead of wedging
+                self._gap_repairs.discard(sender)
+        except Exception:  # noqa: BLE001 - retried on the next cycle
+            self._gap_repairs.discard(sender)
+
+    def anti_entropy(self, pid: str) -> None:
+        """Backstop convergence probe: ask ``pid`` for its log digest
+        (head/floor) and catch up if our contiguous position is behind.
+        Contiguity tracking only detects a loss once a LATER push lands;
+        when the lost pushes were the last traffic before a silence,
+        nothing ever exposes the hole — this periodic digest exchange
+        does. Cheap on both sides (a few ints on the wire); safe from
+        any thread (reliable-send may sleep its bounded backoff)."""
+        self.peer.graph.metrics.incr("peer.anti_entropy_probes")
+        self._send_reliable(pid, M.make_message(
+            M.REQUEST, self.ACTIVITY_TYPE, {"what": "digest"},
+        ))
+
+    def replication_lag(self, pid: str) -> int:
+        """Entries of ``pid``'s log not yet contiguously applied here —
+        the replica staleness measure the serving gate and ``/healthz``
+        advertise. Based on the freshest head ``pid`` told us (every
+        push/catch-up/digest carries one), so between probes it can
+        under-report; the anti-entropy cadence bounds that window."""
+        return max(0, self.peer_heads.get(pid, 0)
+                   - self.last_seen.get(pid, 0))
 
     # -- message handling (runs on the peer's dispatch path) --------------------
     def handle(self, sender: str, msg: dict) -> bool:
@@ -822,6 +1140,10 @@ class Replication:
         what = content.get("what")
         if what == "interest":
             cond = content.get("condition")
+            # anchor the per-peer push chain at the CURRENT head: seqs
+            # at or below it predate the interest — the peer's own
+            # catch-up/bootstrap territory, never "skipped by predicate"
+            self._sent_head.setdefault(sender, self.log.head)
             self.peer_interests[sender] = (
                 None if cond is None else qser.from_json(cond)
             )
@@ -829,10 +1151,15 @@ class Replication:
             # apply OFF the dispatch thread — a slow closure store must not
             # stall unrelated peer traffic; the propagated trace context
             # rides along so the apply joins the sender's tree
+            seq = int(content.get("seq", 0))
+            head = int(content.get("head", seq))
+            if head > self.peer_heads.get(sender, 0):
+                self.peer_heads[sender] = head
+            prev = content.get("prev")  # None: pre-prev wire format
             self._enqueue_apply(
-                sender, [(content["kind"], content["entry"],
-                          int(content.get("seq", 0)),
-                          M.trace_context(msg))]
+                sender, [(content["kind"], content["entry"], seq,
+                          M.trace_context(msg),
+                          None if prev is None else int(prev))]
             )
         elif what == "catchup":
             # remote-child span: this serve hangs under the requester's
@@ -884,6 +1211,13 @@ class Replication:
         elif what == "catchup-result":
             floor = int(content.get("floor", 0))
             entries = content.get("entries") or []
+            head = int(content.get("head", 0))
+            if head > self.peer_heads.get(sender, 0):
+                self.peer_heads[sender] = head
+            # a catch-up page arrived: a pending gap-repair request is no
+            # longer in flight — if the gap survives this page, the next
+            # apply cycle re-triggers the repair
+            self._gap_repairs.discard(sender)
             if floor > self.last_seen.get(sender, 0) and not entries:
                 # the server truncated past our position: incremental
                 # catch-up cannot converge — a full bootstrap (TransferGraph)
@@ -892,7 +1226,6 @@ class Replication:
                 return True
             # a page-limited response may stop short of the server's head:
             # continue the catch-up after this page has been applied
-            head = int(content.get("head", 0))
             top = max((int(e["seq"]) for e in entries), default=0)
             tctx = M.trace_context(msg)
             self._enqueue_apply(
@@ -901,6 +1234,36 @@ class Replication:
                  for e in entries],
                 continue_catchup=bool(entries) and top < head,
             )
+        elif what == "digest":
+            # anti-entropy probe: answer with my log coordinates — cheap
+            # dispatch-thread work (two lock reads, no payloads)
+            self.peer.interface.send(sender, M.make_message(
+                M.INFORM, self.ACTIVITY_TYPE,
+                {"what": "digest-result", "head": self.log.head,
+                 "floor": self.log.floor},
+            ))
+        elif what == "digest-result":
+            head = int(content.get("head", 0))
+            floor = int(content.get("floor", 0))
+            if head > self.peer_heads.get(sender, 0):
+                self.peer_heads[sender] = head
+            mine = self.last_seen.get(sender, 0)
+            prev = self._ae_seen_pos.get(sender)
+            self._ae_seen_pos[sender] = mine
+            if mine < floor:
+                # truncated past us: incremental repair is impossible
+                self.needs_full_sync.add(sender)
+            elif head > mine and (prev is None or mine <= prev):
+                # the backstop caught divergence no push ever revealed
+                # (e.g. the LAST pushes before a silence were dropped
+                # past the redelivery budget — nothing later arrives to
+                # expose the hole via contiguity). Behind-the-head while
+                # STILL ADVANCING is ordinary in-flight lag — repairing
+                # it would shadow the push pipeline with a redundant
+                # catch-up every probe; a stalled position (or the first
+                # probe) is the loss signal
+                self.peer.graph.metrics.incr("peer.anti_entropy_repairs")
+                self.catch_up(sender)
         elif what == "ack":
             # receiver's applied position in MY log: feeds truncation
             seq = int(content.get("seq", 0))
@@ -936,16 +1299,22 @@ class Replication:
                     batch.append(self._apply_q.popleft())
                 self._apply_busy += 1
             try:
-                # per-sender high-water marks: ONE durable SeenMap write and
-                # one ack per sender per drained cycle, not per push
-                his: dict[str, int] = {}
+                # per-sender pre-batch contiguous positions: ONE ack per
+                # sender per drained cycle (sent only when the contiguous
+                # position advanced), not per push
+                pre: dict[str, int] = {}
                 failed: set[str] = set()
+                noack: set[str] = set()
                 conts: set[str] = set()
                 tracer = self.peer.tracer
                 for sender, items, cont in batch:
                     if cont:
                         conts.add(sender)
-                    for kind, entry, seq, tctx in items:
+                    # push items carry a 5th element: the sender's prev
+                    # pushed seq (predicate-skip accounting); catch-up
+                    # pages apply exact positions only
+                    for kind, entry, seq, tctx, *rest in items:
+                        prev = rest[0] if rest else None
                         if sender in failed:
                             # a failed apply must not be acked past — stop
                             # advancing this sender; catch-up refetches
@@ -980,32 +1349,63 @@ class Replication:
                         if tr is not None:
                             tr.finish_terminal("applied")
                         if seq:
-                            his[sender] = max(his.get(sender, 0), seq)
-                for sender, hi in his.items():
+                            if sender not in pre:
+                                pre[sender] = self.last_seen.get(sender)
+                            try:
+                                # gap-aware: record the exact position;
+                                # the contiguous ack advances only over
+                                # an unbroken applied prefix. RAM-only
+                                # here — ONE durable persist per sender
+                                # per cycle below, not one store tx per
+                                # in-order push
+                                self.last_seen.record_applied(
+                                    sender, seq, prev, persist=False)
+                            except Exception:
+                                # e.g. TransactionConflict after retries
+                                # under a hot ingest loop — the worker
+                                # must NEVER die (review r5 finding 1).
+                                # Not durably recorded → do not ack past
+                                # it either; the sender re-serves from
+                                # our last ack and _apply is idempotent.
+                                import logging
+
+                                logging.getLogger(
+                                    "hypergraphdb_tpu.peer"
+                                ).warning(
+                                    "seen-map update failed for %s",
+                                    sender, exc_info=True,
+                                )
+                                noack.add(sender)
+                for sender, before in pre.items():
+                    if sender in noack:
+                        continue
+                    cur = self.last_seen.get(sender)
                     try:
-                        if hi > self.last_seen.get(sender, 0):
-                            self.last_seen.set(sender, hi)
+                        # the cycle's ONE durable write for this sender
+                        # (no-op when nothing advanced); an unpersisted
+                        # position must not be acked — skip, the sender
+                        # re-serves from our last durable ack and the
+                        # next cycle retries the persist
+                        self.last_seen.persist(sender)
                     except Exception:
-                        # e.g. TransactionConflict after retries under a hot
-                        # ingest loop — the worker must NEVER die (review r5
-                        # finding 1). Not durably recorded → do not ack past
-                        # it either; the sender re-serves from our last ack
-                        # and _apply is idempotent.
                         import logging
 
-                        logging.getLogger("hypergraphdb_tpu.peer").warning(
-                            "seen-map update failed for %s", sender,
-                            exc_info=True,
-                        )
+                        logging.getLogger(
+                            "hypergraphdb_tpu.peer"
+                        ).warning("seen-map persist failed for %s",
+                                  sender, exc_info=True)
+                        self._check_gap(sender)
                         continue
-                    try:
-                        self.peer.graph.metrics.incr("peer.acks")
-                        self.peer.interface.send(sender, M.make_message(
-                            M.INFORM, self.ACTIVITY_TYPE,
-                            {"what": "ack", "seq": hi},
-                        ))
-                    except Exception:  # noqa: BLE001 - peer may be gone
-                        pass
+                    if cur > before:
+                        try:
+                            self.peer.graph.metrics.incr("peer.acks")
+                            self.peer.interface.send(sender, M.make_message(
+                                M.INFORM, self.ACTIVITY_TYPE,
+                                {"what": "ack", "seq": cur},
+                            ))
+                        except Exception:  # noqa: BLE001 - peer gone
+                            pass
+                    self._check_gap(sender)
                 # page-limited catch-up: pull the next page now that this
                 # one is applied and acknowledged
                 for sender in conts - failed:
@@ -1043,11 +1443,14 @@ class Replication:
         g = self.peer.graph
         self._tls.applying = True
         try:
-            if kind == "remove":
-                local = transfer.lookup_local(g, entry["gid"])
-                if local is not None and g.contains(int(local)):
-                    g.remove(int(local))
-                return
-            transfer.store_closure(g, entry["atoms"])
+            # under the peer's apply mutex: a concurrently-streaming
+            # snapshot transfer must not race this gid's check-then-act
+            with self.peer.apply_lock:
+                if kind == "remove":
+                    local = transfer.lookup_local(g, entry["gid"])
+                    if local is not None and g.contains(int(local)):
+                        g.remove(int(local))
+                    return
+                transfer.store_closure(g, entry["atoms"])
         finally:
             self._tls.applying = False

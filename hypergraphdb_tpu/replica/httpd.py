@@ -1,0 +1,202 @@
+"""The HTTP skin of the replicated tier: ``POST /submit`` + ``GET /healthz``.
+
+One tiny stdlib server class worn twice:
+
+- each **replica node** (and the primary) runs a :class:`SubmitServer`
+  over its own serve runtime — the per-node submit surface the front
+  door forwards to (:class:`~hypergraphdb_tpu.replica.router.HTTPBackend`);
+- the **front door** runs a :class:`SubmitServer` whose submit function
+  IS :meth:`~hypergraphdb_tpu.replica.router.FrontDoor.submit` — the
+  one URL callers see.
+
+Status mapping (what :class:`~.router.HTTPBackend` keys its typed
+errors off)::
+
+    200  answered                      (JSON ServeResult shape)
+    400  Unservable / malformed        (the REQUEST is the problem)
+    503  AdmissionGated / QueueFull /  (the NODE is — re-route)
+         RuntimeClosed
+    504  DeadlineExceeded              (the budget is — propagate)
+    500  anything else                 (bug — re-route + investigate)
+
+Error bodies are JSON ``{"error": <type name>, "message": <str>}`` so
+routers can distinguish a lag-gate refusal from a real failure without
+string-matching prose. ``/metrics`` stays the
+:class:`~hypergraphdb_tpu.obs.http.TelemetryServer`'s job — run one
+beside this per process; ``/healthz`` is duplicated here because the
+front door and load balancers need it ON the submit port.
+
+No jax imports; handlers hold no runtime locks (``submit`` blocks on
+the request's future only), so a slow request never stalls a scrape.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from hypergraphdb_tpu.obs.http import HealthProbe
+from hypergraphdb_tpu.serve.types import (
+    AdmissionGated,
+    DeadlineExceeded,
+    QueueFull,
+    RuntimeClosed,
+    Unservable,
+)
+
+#: exception type → HTTP status (first match wins, order matters:
+#: subclasses before ServeError-wide defaults)
+_STATUS = (
+    (AdmissionGated, 503),
+    (QueueFull, 503),
+    (RuntimeClosed, 503),
+    (DeadlineExceeded, 504),
+    (Unservable, 400),
+    ((KeyError, ValueError, TypeError), 400),
+)
+
+
+def _status_of(exc: BaseException) -> int:
+    for types, code in _STATUS:
+        if isinstance(exc, types):
+            return code
+    return 500
+
+
+class _Handler(BaseHTTPRequestHandler):
+    timeout = 30  # never block the handler thread on a half-open client
+
+    def _respond(self, status: int, payload: dict) -> None:
+        body = (json.dumps(payload, sort_keys=True) + "\n").encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
+        srv: "SubmitServer" = self.server.submit_server  # type: ignore[attr-defined]
+        path = self.path.split("?", 1)[0]
+        if path != "/healthz":
+            self._respond(404, {"error": "NotFound", "message": path})
+            return
+        try:
+            healthy, payload = (srv.health() if srv.health is not None
+                                else (True, {}))
+        except Exception as e:  # noqa: BLE001 - a broken probe ≠ dead server
+            self._respond(500, {"error": type(e).__name__,
+                                "message": str(e)})
+            return
+        self._respond(200 if healthy else 503, payload)
+
+    def do_POST(self) -> None:  # noqa: N802
+        srv: "SubmitServer" = self.server.submit_server  # type: ignore[attr-defined]
+        path = self.path.split("?", 1)[0]
+        if path != "/submit":
+            self._respond(404, {"error": "NotFound", "message": path})
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(n).decode("utf-8"))
+            if not isinstance(payload, dict):
+                raise ValueError("request body must be a JSON object")
+        except Exception as e:  # noqa: BLE001 - unparsable body
+            self._respond(400, {"error": type(e).__name__,
+                                "message": str(e)})
+            return
+        try:
+            result = srv.submit_fn(payload)
+        except BaseException as e:  # noqa: BLE001 - typed status mapping
+            self._respond(_status_of(e), {"error": type(e).__name__,
+                                          "message": str(e)})
+            if not isinstance(e, Exception):
+                raise  # a real kill (InjectedCrash) must still kill
+            return
+        self._respond(200, result)
+
+    def log_message(self, fmt, *args) -> None:  # requests are not news
+        pass
+
+
+class SubmitServer:
+    """The submit endpoint thread (``port=0`` binds ephemeral; read it
+    back from ``.port``). ``submit_fn`` takes the decoded JSON payload
+    and returns the response dict — wire
+    ``lambda p: submit_payload(node.runtime, p, timeout)`` for a node,
+    or ``frontdoor.submit`` for the router. Lifecycle mirrors
+    ``obs.http.TelemetryServer`` (start/stop or context manager; stop
+    releases the port; no restart after stop)."""
+
+    def __init__(self, submit_fn: Callable[[dict], dict],
+                 health: Optional[HealthProbe] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.submit_fn = submit_fn
+        self.health = health
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.daemon_threads = True
+        self._server.submit_server = self  # type: ignore[attr-defined]
+        self.host, self.port = self._server.server_address[:2]
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "SubmitServer":
+        with self._lock:
+            if self._closed:
+                raise RuntimeError(
+                    "SubmitServer was stopped (port released); "
+                    "construct a new one"
+                )
+            if self._thread is not None:
+                return self
+            self._thread = t = threading.Thread(
+                target=self._server.serve_forever,
+                name=f"hg-submit-{self.port}", daemon=True,
+            )
+        t.start()
+        return self
+
+    def stop(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            t, self._thread = self._thread, None
+        if t is not None:
+            self._server.shutdown()
+            t.join(timeout=5)
+        self._server.server_close()
+
+    def __enter__(self) -> "SubmitServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def node_server(node, timeout_s: float = 30.0,
+                host: str = "127.0.0.1", port: int = 0,
+                authoritative: bool = False) -> SubmitServer:
+    """A replica node's submit endpoint: runtime + health in one call.
+    ``authoritative=True`` marks a PRIMARY's endpoint: an unknown gid
+    answers 400 (the gid is wrong) instead of 503 (merely not here yet)."""
+    from hypergraphdb_tpu.replica.router import submit_payload
+
+    return SubmitServer(
+        lambda p: submit_payload(node.runtime, p, timeout_s,
+                                 authoritative=authoritative),
+        health=node.health_probe(), host=host, port=port,
+    )
+
+
+def frontdoor_server(frontdoor, host: str = "127.0.0.1",
+                     port: int = 0) -> SubmitServer:
+    """The front door's public endpoint."""
+    return SubmitServer(frontdoor.submit, health=frontdoor.health_probe(),
+                        host=host, port=port)

@@ -1,0 +1,501 @@
+"""The front door: one SUBMIT surface over the primary + N replicas.
+
+Placement policy (read spreading):
+
+1. refresh each replica's health (a background poll, or lazily when the
+   poll is off) — ``/healthz``-shaped probes yield ``healthy`` plus the
+   advertised ``replication_lag``;
+2. order healthy replicas by advertised lag and round-robin within the
+   least-lagged group, so equally-fresh replicas share load instead of
+   the first one eating it all;
+3. skip replicas whose per-replica circuit breaker gate is OPEN — a
+   dead replica costs ``breaker_threshold`` failed probes ONCE, then
+   its load re-routes without paying a timeout per request until the
+   cooldown releases a half-open probe. A health poll that sees the
+   replica answering again RESETS the gate (immediate re-admission on
+   rejoin);
+4. the primary is the exact-answer fallback: any request no replica
+   could serve (all dead, all gated past their lag bound, typed
+   refusals) lands there — degraded placement, zero caller-visible
+   errors for in-budget requests.
+
+Typed refusals that re-route: transport errors, 5xx, timeouts,
+:class:`~hypergraphdb_tpu.serve.AdmissionGated` (the replica's lag
+gate), :class:`~hypergraphdb_tpu.serve.QueueFull`. Permanent request
+errors (:class:`~hypergraphdb_tpu.serve.Unservable`, malformed
+payloads) and an expired deadline propagate immediately — no backend
+could do better, and burning the breaker on them would punish a healthy
+replica for a caller bug.
+
+Backends are duck-typed (``id`` / ``submit(payload, timeout)`` /
+``health()``): :class:`LocalBackend` wraps an in-process runtime (tests,
+single-host tiers), :class:`HTTPBackend` speaks to a
+:class:`~hypergraphdb_tpu.replica.httpd.SubmitServer` over real sockets.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence
+
+from hypergraphdb_tpu.fault import (
+    CLOSED,
+    OPEN,
+    CircuitBreaker,
+    PermanentFault,
+    TransientFault,
+)
+from hypergraphdb_tpu.serve.types import (
+    AdmissionGated,
+    DeadlineExceeded,
+    Unservable,
+)
+from hypergraphdb_tpu.utils.metrics import Metrics
+
+#: request errors no re-route can fix: propagate, never penalize the
+#: backend's breaker for them
+_PERMANENT = (Unservable, PermanentFault, KeyError, ValueError, TypeError)
+
+
+def submit_payload(runtime, payload: dict, timeout: float,
+                   authoritative: bool = False) -> dict:
+    """One wire-shaped request → the runtime → a wire-shaped response.
+    The single serve-payload schema, shared by the local backend and the
+    HTTP handler so both paths answer byte-identically::
+
+        {"kind": "bfs", "seed": 7, "max_hops": 2, "deadline_s": 0.5}
+        {"kind": "pattern", "anchors": [3, 9], "type_handle": 4}
+
+    Response: ``{"kind", "count", "matches", "truncated", "epoch",
+    "served_by"}``. ``authoritative`` marks the PRIMARY's source-of-truth
+    view: a gid it doesn't know exists nowhere, which is the caller's
+    error — on a replica the same miss is a replication race."""
+    kind = payload.get("kind")
+    deadline = payload.get("deadline_s")
+
+    def _resolve(gid: str) -> int:
+        # gid-addressed requests are location-transparent: the SAME
+        # payload serves on any backend, whatever local handles its
+        # history assigned (raw-handle payloads remain for single-node
+        # callers that never leave one handle space)
+        from hypergraphdb_tpu.peer import transfer
+
+        g = getattr(runtime, "graph", None)
+        h = None if g is None else transfer.lookup_local(g, str(gid))
+        if h is None:
+            if authoritative:
+                # the source of truth doesn't know it: the gid is wrong
+                # (deleted or typo'd) — a permanent caller error, NOT a
+                # retryable refusal, or a 503-retrying client would poll
+                # an unanswerable request forever
+                raise Unservable(f"unknown gid {gid!r}")
+            # "not HERE (yet)" — a replica may simply trail the atom's
+            # creation; AdmissionGated makes the router re-route (the
+            # primary has it) without a breaker penalty, instead of
+            # surfacing a caller error for a replication race
+            raise AdmissionGated(f"unknown gid {gid!r} on this node")
+        return int(h)
+
+    if kind == "bfs":
+        seed = (_resolve(payload["seed_gid"]) if "seed_gid" in payload
+                else int(payload["seed"]))
+        fut = runtime.submit_bfs(
+            seed,
+            max_hops=(None if payload.get("max_hops") is None
+                      else int(payload["max_hops"])),
+            deadline_s=deadline,
+            include_seed=bool(payload.get("include_seed", True)),
+        )
+    elif kind == "pattern":
+        anchors = ([_resolve(a) for a in payload["anchor_gids"]]
+                   if "anchor_gids" in payload
+                   else [int(a) for a in payload["anchors"]])
+        fut = runtime.submit_pattern(
+            anchors,
+            type_handle=(None if payload.get("type_handle") is None
+                         else int(payload["type_handle"])),
+            deadline_s=deadline,
+        )
+    else:
+        raise Unservable(f"unknown request kind {kind!r}")
+    res = fut.result(timeout=timeout)
+    out = {
+        "kind": res.kind,
+        "count": int(res.count),
+        "matches": [int(m) for m in res.matches],
+        "truncated": bool(res.truncated),
+        "epoch": int(res.epoch),
+        "served_by": res.served_by,
+    }
+    if payload.get("gids"):
+        # matches are LOCAL handles of the answering node; a caller
+        # comparing answers across backends (or following up against a
+        # different node) asks for the global-id view — replicated atoms
+        # carry one gid everywhere, unreplicated ones map to None
+        from hypergraphdb_tpu.peer import transfer
+
+        g = getattr(runtime, "graph", None)
+        out["match_gids"] = (
+            None if g is None
+            else [transfer.existing_gid(g, int(m)) for m in res.matches]
+        )
+    return out
+
+
+class LocalBackend:
+    """In-process backend over one serve runtime + optional health probe
+    (a :class:`~hypergraphdb_tpu.replica.node.ReplicaNode` passes its
+    :meth:`health_probe`; a primary passes ``runtime_health``)."""
+
+    def __init__(self, backend_id: str, runtime, health=None,
+                 role: str = "replica"):
+        self.id = backend_id
+        self.runtime = runtime
+        self.role = role
+        self._health = health
+
+    def submit(self, payload: dict, timeout: float) -> dict:
+        return submit_payload(self.runtime, payload, timeout,
+                              authoritative=self.role == "primary")
+
+    def health(self):
+        if self._health is None:
+            return True, {"role": self.role}
+        return self._health()
+
+
+class HTTPBackend:
+    """A backend behind a :class:`~.httpd.SubmitServer` URL. Non-2xx
+    submit responses raise typed: 4xx → :class:`PermanentFault` (the
+    request is the problem), everything else → :class:`TransientFault`
+    (the backend is — re-route)."""
+
+    def __init__(self, backend_id: str, url: str, role: str = "replica",
+                 health_timeout_s: float = 5.0):
+        self.id = backend_id
+        self.url = url.rstrip("/")
+        self.role = role
+        self.health_timeout_s = health_timeout_s
+
+    def submit(self, payload: dict, timeout: float) -> dict:
+        req = urllib.request.Request(
+            self.url + "/submit",
+            data=json.dumps(payload).encode("utf-8"),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return json.loads(r.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            body = e.read().decode("utf-8", "replace")[:300]
+            try:
+                kind = json.loads(body).get("error")
+            except Exception:  # noqa: BLE001 - non-JSON error body
+                kind = None
+            if kind == "AdmissionGated":
+                # the replica's lag gate, not a failure: the router
+                # re-routes WITHOUT a breaker penalty
+                raise AdmissionGated(body) from e
+            if kind == "DeadlineExceeded":
+                # the CALLER's budget expired, not the backend: must
+                # propagate un-struck (a 504 read as TransientFault
+                # would burn the breaker of a healthy replica and
+                # retry a dead-on-arrival request across the tier)
+                raise DeadlineExceeded(body) from e
+            if 400 <= e.code < 500:
+                raise PermanentFault(
+                    f"{self.id} rejected the request ({e.code}): {body}"
+                ) from e
+            raise TransientFault(
+                f"{self.id} failed ({e.code}): {body}"
+            ) from e
+        except OSError as e:  # refused/reset/timeout — the wire's fault
+            raise TransientFault(f"{self.id} unreachable: {e}") from e
+
+    def health(self):
+        try:
+            with urllib.request.urlopen(self.url + "/healthz",
+                                        timeout=self.health_timeout_s) as r:
+                return True, json.loads(r.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read().decode("utf-8"))
+            except Exception:  # noqa: BLE001 - non-JSON error body
+                payload = {}
+            return False, payload
+        # plain OSError (dead socket) propagates: the caller counts it
+        # as unreachable
+
+
+@dataclass
+class RouterConfig:
+    """Front-door knobs."""
+
+    breaker_threshold: int = 2      # consecutive failures → OPEN
+    breaker_cooldown_s: float = 0.5
+    #: health snapshots older than this refresh before placement
+    health_refresh_s: float = 0.25
+    #: background poll cadence (0 = poll only lazily at placement)
+    poll_interval_s: float = 0.25
+    #: distinct replicas tried before falling back to the primary
+    max_attempts: int = 2
+    submit_timeout_s: float = 30.0
+    clock: Optional[Callable[[], float]] = None
+
+
+class FrontDoor:
+    """The router. Thread-safe: requests may arrive from many HTTP
+    handler threads; placement state is one small locked dict and the
+    breaker locks itself."""
+
+    def __init__(self, primary, replicas: Sequence, config:
+                 Optional[RouterConfig] = None):
+        self.config = config or RouterConfig()
+        self.primary = primary
+        self.replicas = list(replicas)
+        self.clock = self.config.clock or time.monotonic
+        self.breaker = CircuitBreaker(
+            threshold=self.config.breaker_threshold,
+            cooldown_s=self.config.breaker_cooldown_s,
+            clock=self.clock,
+        )
+        self.metrics = Metrics()
+        self._lock = threading.Lock()
+        #: backend id → (healthy, advertised lag, snapshot time)
+        self._health: dict[str, tuple[bool, int, float]] = {}
+        self._rr = 0
+        self._poll_stop = threading.Event()
+        self._poll_thread: Optional[threading.Thread] = None
+        #: one refresh at a time: a lazy-mode submit that finds a probe
+        #: already in flight places with the snapshot it has instead of
+        #: queueing another N-probe sweep behind it
+        self._refresh_gate = threading.Lock()
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "FrontDoor":
+        self.refresh_health()
+        t = None
+        if self.config.poll_interval_s > 0:
+            with self._lock:      # check-and-set: two start()s, one poll
+                if self._poll_thread is None:
+                    self._poll_stop.clear()
+                    self._poll_thread = t = threading.Thread(
+                        target=self._poll_loop, name="frontdoor-health",
+                        daemon=True,
+                    )
+        if t is not None:
+            t.start()
+        return self
+
+    def stop(self) -> None:
+        self._poll_stop.set()
+        with self._lock:
+            t, self._poll_thread = self._poll_thread, None
+        if t is not None:
+            t.join(timeout=5)
+
+    def __enter__(self) -> "FrontDoor":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- health / placement ---------------------------------------------------
+    def refresh_health(self) -> None:
+        """Probe every replica's health once. A backend whose health
+        TRANSITIONS unhealthy → healthy (the rejoin edge) is re-admitted
+        immediately (breaker reset) — rejoin should not wait out a
+        cooldown ladder the outage already paid for. Deliberately
+        edge-triggered: a backend whose ``/healthz`` lies green while
+        its submits fail must NOT be level-reset every poll, or the
+        breaker could never bound its probes.
+
+        Probes run CONCURRENTLY (one short-lived thread per replica) and
+        at most one sweep at a time: the wait is bounded by the slowest
+        single probe, not their sum, and a blackholed replica (SYN
+        dropped — urlopen eats its whole timeout) cannot stack N×timeout
+        onto a lazy-mode submit path nor fan one sweep per handler
+        thread."""
+        if not self._refresh_gate.acquire(blocking=False):
+            return  # a sweep is in flight; place with the snapshot we have
+        try:
+            now = self.clock()
+            results: dict[str, tuple[bool, int]] = {}
+
+            def probe(be):
+                try:
+                    healthy, payload = be.health()
+                    lag = int(payload.get("replication_lag", 0))
+                except Exception:  # noqa: BLE001 - unreachable == unhealthy
+                    healthy, lag = False, 0
+                results[be.id] = (healthy, lag)
+
+            if len(self.replicas) <= 1:
+                for be in self.replicas:
+                    probe(be)
+            else:
+                threads = [
+                    threading.Thread(target=probe, args=(be,),
+                                     name=f"frontdoor-probe-{be.id}",
+                                     daemon=True)
+                    for be in self.replicas
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+            for be in self.replicas:
+                healthy, lag = results.get(be.id, (False, 0))
+                with self._lock:
+                    prev = self._health.get(be.id)
+                    self._health[be.id] = (healthy, lag, now)
+                if (healthy and prev is not None and not prev[0]
+                        and self.breaker.state_of(be.id) != CLOSED):
+                    self.breaker.reset(be.id)
+                    self.metrics.incr("router.readmissions")
+        finally:
+            self._refresh_gate.release()
+
+    def _poll_loop(self) -> None:
+        while not self._poll_stop.wait(self.config.poll_interval_s):
+            try:
+                self.refresh_health()
+            except Exception:  # noqa: BLE001 - the poll must survive
+                import logging
+
+                logging.getLogger("hypergraphdb_tpu.replica").warning(
+                    "front-door health poll failed", exc_info=True
+                )
+
+    def _placement(self) -> list:
+        """Healthy replicas, least-lagged first, round-robin within the
+        least-lagged group (the spread), breaker-OPEN gates skipped."""
+        now = self.clock()
+        with self._lock:
+            stale = any(
+                self._health.get(be.id, (False, 0, -1e9))[2]
+                < now - self.config.health_refresh_s
+                for be in self.replicas
+            )
+        if stale and self.config.poll_interval_s <= 0:
+            self.refresh_health()
+        with self._lock:
+            known = {
+                be.id: self._health.get(be.id, (False, 0, 0.0))
+                for be in self.replicas
+            }
+            self._rr += 1
+            rr = self._rr
+        healthy = [be for be in self.replicas if known[be.id][0]]
+        if not healthy:
+            return []
+        healthy.sort(key=lambda be: known[be.id][1])
+        min_lag = known[healthy[0].id][1]
+        grp = [be for be in healthy if known[be.id][1] == min_lag]
+        rest = [be for be in healthy if known[be.id][1] != min_lag]
+        k = rr % len(grp)
+        ordered = grp[k:] + grp[:k] + rest
+        # peek, don't allow: placement ranks candidates the request may
+        # never reach — consuming a half-open probe token here would
+        # starve the backend's actual recovery probe (the submit loop
+        # calls allow() right before dispatching)
+        return [be for be in ordered if self.breaker.peek(be.id)]
+
+    # -- submit ---------------------------------------------------------------
+    def submit(self, payload: dict,
+               timeout: Optional[float] = None) -> dict:
+        """Route one request: replicas by placement order (bounded
+        attempts), then the primary. The response's ``routed_to`` names
+        the backend that answered."""
+        timeout = timeout if timeout is not None \
+            else self.config.submit_timeout_s
+        self.metrics.incr("router.submitted")
+        attempts = 0
+        for be in self._placement():
+            if attempts >= self.config.max_attempts:
+                break
+            if not self.breaker.allow(be.id):
+                # lost the race for a half-open probe token between
+                # placement's peek and here — skip without burning an
+                # attempt on a backend we never tried
+                continue
+            attempts += 1
+            try:
+                res = be.submit(payload, timeout)
+            except (DeadlineExceeded, *_PERMANENT):
+                # no other backend can answer this better — and the
+                # breaker must not punish a replica for a caller bug
+                self.metrics.incr("router.errors")
+                raise
+            except AdmissionGated:
+                # the replica's lag gate refused: a typed, HEALTHY
+                # refusal — re-route without a breaker penalty
+                self.metrics.incr("router.lag_rerouted")
+                continue
+            except Exception:  # noqa: BLE001 - transport/timeout/5xx
+                # the breaker (not the health cache) owns failure
+                # memory: K consecutive failures OPEN the gate and bound
+                # the probes; health stays the poll's own observation so
+                # the rejoin edge (unhealthy → healthy) is unambiguous
+                self.breaker.record_failure(be.id)
+                self.metrics.incr("router.rerouted")
+                continue
+            self.breaker.record_success(be.id)
+            self.metrics.incr("router.routed_replica")
+            res["routed_to"] = be.id
+            return res
+        # exact-answer fallback: the primary
+        self.metrics.incr("router.primary_fallbacks")
+        try:
+            res = self.primary.submit(payload, timeout)
+        except Exception:
+            self.metrics.incr("router.errors")
+            raise
+        res["routed_to"] = self.primary.id
+        return res
+
+    # -- health surface --------------------------------------------------------
+    def health_probe(self):
+        """The router's own ``/healthz``: per-backend health/lag/breaker
+        plus the routing counters. Healthy while ANY backend (replica or
+        primary) can take traffic — the tier is degraded-not-down by
+        design."""
+
+        def probe():
+            with self._lock:
+                snap = dict(self._health)
+            backends = {}
+            any_replica = False
+            for be in self.replicas:
+                healthy, lag, t = snap.get(be.id, (False, 0, 0.0))
+                state = self.breaker.state_of(be.id)
+                if healthy and state != OPEN:
+                    any_replica = True
+                backends[be.id] = {
+                    "healthy": healthy,
+                    "replication_lag": lag,
+                    "breaker": state,
+                }
+            primary_ok = True
+            ph = getattr(self.primary, "health", None)
+            if ph is not None:
+                try:
+                    primary_ok = bool(ph()[0])
+                except Exception:  # noqa: BLE001 - unreachable == down
+                    primary_ok = False
+            payload = {
+                "role": "router",
+                "primary": self.primary.id,
+                "primary_healthy": primary_ok,
+                "backends": backends,
+                "counters": dict(self.metrics.counters),
+            }
+            return any_replica or primary_ok, payload
+
+        return probe

@@ -39,6 +39,7 @@ Entry point::
 """
 
 from hypergraphdb_tpu.serve.types import (
+    AdmissionGated,
     BFSRequest,
     Clock,
     DeadlineExceeded,
@@ -59,6 +60,7 @@ from hypergraphdb_tpu.serve.runtime import (
 )
 
 __all__ = [
+    "AdmissionGated",
     "AdmissionQueue",
     "Batcher",
     "BFSRequest",

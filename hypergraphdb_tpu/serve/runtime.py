@@ -90,6 +90,11 @@ class ServeConfig:
     clock: Optional[Clock] = None           # injectable time source
     manual: bool = False                    # no thread; tests call step()
     latency_window: int = 4096
+    #: pre-admission fitness gate: a callable returning None (admit) or
+    #: a reason string (refuse with AdmissionGated). The replica tier
+    #: wires its replication-lag bound here, so a lagging replica sheds
+    #: to the router instead of answering past its staleness contract.
+    admission_gate: Optional[Callable[[], Optional[str]]] = None
     tracer: Optional[object] = None         # hgobs Tracer; None → global
     device_timing: bool = False             # launch→ready deltas per batch
     # -- self-healing (hgfault) ----------------------------------------------
@@ -718,7 +723,19 @@ class ServeRuntime:
         :class:`~.types.RuntimeClosed` after close; a deadline that expires
         while blocked lands ON the future as DeadlineExceeded. A higher
         ``priority`` class pops first at batch formation (FIFO within a
-        class); shedding and backpressure are priority-blind."""
+        class); shedding and backpressure are priority-blind. An
+        ``admission_gate`` refusal raises
+        :class:`~.types.AdmissionGated` BEFORE any queue state is
+        touched (routers re-route; the request costs this node
+        nothing)."""
+        gate = self.config.admission_gate
+        if gate is not None:
+            reason = gate()
+            if reason:
+                self.stats.record_gated()
+                from hypergraphdb_tpu.serve.types import AdmissionGated
+
+                raise AdmissionGated(str(reason))
         now = self.clock()
         dl = (deadline_s if deadline_s is not None
               else self.config.default_deadline_s)

@@ -30,6 +30,7 @@ LEGACY_TO_DOTTED = {
     "completed": "serve.completed",
     "shed_deadline": "serve.shed_deadline",
     "rejected_queue_full": "serve.rejected_queue_full",
+    "gated": "serve.gated",
     "cancelled": "serve.cancelled",
     "errors": "serve.errors",
     "host_fallbacks": "serve.host_fallbacks",
@@ -53,6 +54,7 @@ DOTTED_NAMES = (
     "serve.completed",
     "serve.shed_deadline",
     "serve.rejected_queue_full",
+    "serve.gated",
     "serve.cancelled",
     "serve.errors",
     "serve.host_fallbacks",
@@ -99,6 +101,7 @@ class ServeStats:
         self._completed = r.counter("serve.completed")
         self._shed = r.counter("serve.shed_deadline")
         self._rejected = r.counter("serve.rejected_queue_full")
+        self._gated = r.counter("serve.gated")
         self._cancelled = r.counter("serve.cancelled")
         self._errors = r.counter("serve.errors")
         self._host_fallbacks = r.counter("serve.host_fallbacks")
@@ -120,7 +123,7 @@ class ServeStats:
         self._key_trips: dict = {}
         self._own = (
             self._submitted, self._completed, self._shed, self._rejected,
-            self._cancelled, self._errors, self._host_fallbacks,
+            self._gated, self._cancelled, self._errors, self._host_fallbacks,
             self._batches, self._device_dispatches, self._device_seconds,
             self._retries, self._breaker_trips, self._breaker_state,
             self._lanes_real, self._lanes_padded, self._latency,
@@ -154,6 +157,13 @@ class ServeStats:
     def record_reject(self) -> None:
         with self._lock:
             self._rejected.inc()
+
+    def record_gated(self) -> None:
+        """An admission-gate refusal (e.g. a replica past its lag
+        bound): the request was never admitted, so it is outside the
+        submitted/completed identity — counted on its own."""
+        with self._lock:
+            self._gated.inc()
 
     def record_cancel(self) -> None:
         with self._lock:
@@ -279,6 +289,10 @@ class ServeStats:
         return self._rejected.value
 
     @property
+    def gated(self) -> int:
+        return self._gated.value
+
+    @property
     def cancelled(self) -> int:
         return self._cancelled.value
 
@@ -339,6 +353,7 @@ class ServeStats:
                 "completed": self._completed.value,
                 "shed_deadline": self._shed.value,
                 "rejected_queue_full": self._rejected.value,
+                "gated": self._gated.value,
                 "cancelled": self._cancelled.value,
                 "errors": self._errors.value,
                 "host_fallbacks": self._host_fallbacks.value,

@@ -25,10 +25,17 @@ class DeadlineExceeded(ServeError):
     """The request's deadline expired before a device dispatch — shed in
     the admission queue (load shedding), the dispatch was never paid."""
 
-    def __init__(self, waited_s: float):
-        super().__init__(f"deadline exceeded after {waited_s * 1e3:.1f} ms "
-                         "in the admission queue")
-        self.waited_s = waited_s
+    def __init__(self, waited_s):
+        # the router re-raises this across an HTTP hop with the server's
+        # error body as the message — only a local shed knows the wait
+        if isinstance(waited_s, (int, float)):
+            super().__init__(
+                f"deadline exceeded after {waited_s * 1e3:.1f} ms "
+                "in the admission queue")
+            self.waited_s = float(waited_s)
+        else:
+            super().__init__(str(waited_s))
+            self.waited_s = None
 
 
 class QueueFull(ServeError):
@@ -42,6 +49,13 @@ class RuntimeClosed(ServeError):
 class Unservable(ServeError):
     """The condition/request is outside the batchable subset — run it
     through ``graph.find_all`` instead."""
+
+
+class AdmissionGated(ServeError):
+    """The runtime's ``admission_gate`` refused this request — the node
+    is temporarily unfit to answer within its contract (e.g. a replica
+    whose replication lag exceeds its staleness bound). Retry elsewhere:
+    a router treats this as "re-route", never as a caller error."""
 
 
 # ---------------------------------------------------------------- requests

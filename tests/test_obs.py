@@ -534,6 +534,42 @@ def test_adaptive_controller_scales_down_and_recovers():
     assert tracer.sample_rate_of("t") >= 0.05
 
 
+def test_adaptive_per_kind_isolates_hot_root_kind():
+    """One hot root kind (peer.push at replication qps) fills the buffer:
+    ITS scale halves to the floor while a cold kind keeps its whole
+    budget; only once the hot kind is floored and pressure persists does
+    the GLOBAL outer clamp engage (and idle drains recover both)."""
+    tracer, clock = make_tracer(max_finished=10)
+    tracer.enable_adaptive(target_fill=0.5, floor=0.05)
+    for _ in range(5):                       # flood to the watermark
+        tracer.start_trace("peer.push").finish()
+    assert tracer.sample_rate_of("peer.push") == 0.5
+    assert tracer.sample_rate_of("serve.request") == 1.0  # untouched
+    for _ in range(4):                       # press the hot kind to floor
+        tr = tracer.start_trace("peer.push")
+        tr.force_sample()
+        tr.finish()
+    assert tracer.sample_rate_of("peer.push") == 0.05
+    assert tracer.sample_rate_of("serve.request") == 1.0  # STILL whole
+    snap = tracer.sampling_snapshot()
+    assert snap["adaptive_kind_scales"]["peer.push"] == 0.05
+    assert snap["adaptive_scale"] == 1.0
+    # hot kind floored + sustained pressure → the global clamp engages
+    tr = tracer.start_trace("peer.push")
+    tr.force_sample()
+    tr.finish()
+    assert tracer.sampling_snapshot()["adaptive_scale"] == 0.5
+    assert tracer.sample_rate_of("serve.request") == 0.5
+    assert tracer.sample_rate_of("peer.push") == 0.05  # floor-clamped
+    # recovery: idle drains double kind scales and the global scale back
+    tracer.drain()                           # this drain still saw fill
+    for _ in range(6):
+        tracer.drain()
+    assert tracer.sample_rate_of("peer.push") == 1.0
+    assert tracer.sample_rate_of("serve.request") == 1.0
+    assert tracer.sampling_snapshot()["adaptive_kind_scales"] == {}
+
+
 def test_peek_does_not_consume():
     tracer, clock = make_tracer()
     tracer.start_trace("a").finish()

@@ -369,6 +369,75 @@ def test_aot_cache_corrupt_logs_warning(tmp_path, jit_fn, caplog):
     assert any("rebuilding" in r.message for r in caplog.records)
 
 
+def test_aot_gc_sweeps_superseded_generations(tmp_path, jit_fn):
+    """ROADMAP 4f: the open-time sweep deletes entries whose header
+    content_key is a SUPERSEDED generation once past the age bound; the
+    current generation is never touched (the prewarm relies on it)."""
+    import os
+    import time as _time
+
+    from hypergraphdb_tpu.ops import aot_cache as ac
+
+    args = (jnp.zeros((16,), jnp.float32),)
+    old = ac.AOTCache(root=str(tmp_path), content_key="gen-old")
+    old.get_or_compile("t.mul", jit_fn, args, {"n": 2})
+    old.get_or_compile("t.mul", jit_fn, args, {"n": 3})
+    cur = ac.AOTCache(root=str(tmp_path), content_key="gen-new",
+                      gc_max_age_s=None)          # no sweep at open
+    cur.get_or_compile("t.mul", jit_fn, args, {"n": 2})
+
+    def aot_files():
+        return [f for f in os.listdir(cur.dir) if f.endswith(".aot")]
+
+    assert len(aot_files()) == 3
+    # young superseded entries survive a lenient sweep...
+    cur.gc_max_age_s = 3600.0
+    assert cur.gc(now=_time.time() + 1.0) == 0
+    # ...and go once older than the bound — current generation stays
+    assert cur.gc(now=_time.time() + 2 * 3600.0) == 2
+    assert cur.stats.gc_removed == 2
+    assert len(aot_files()) == 1
+    # the survivor really is the current generation: a fresh open (the
+    # default sweep runs) still disk-hits without a compile
+    c2 = ac.AOTCache(root=str(tmp_path), content_key="gen-new")
+    c2.get_or_compile("t.mul", jit_fn, args, {"n": 2})
+    assert c2.stats.disk_hits == 1 and c2.stats.misses == 0
+
+
+def test_aot_gc_size_bound_and_tmp_leftovers(tmp_path, jit_fn):
+    """The size bound deletes oldest-superseded-first even when young,
+    never the current generation; abandoned ``*.tmp.*`` writer leftovers
+    go once past the age bound."""
+    import os
+    import time as _time
+
+    from hypergraphdb_tpu.ops import aot_cache as ac
+
+    args = (jnp.zeros((16,), jnp.float32),)
+    old = ac.AOTCache(root=str(tmp_path), content_key="gen-old")
+    for n in (2, 3, 4):
+        old.get_or_compile("t.mul", jit_fn, args, {"n": n})
+    cur = ac.AOTCache(root=str(tmp_path), content_key="gen-new",
+                      gc_max_age_s=None)
+    cur.get_or_compile("t.mul", jit_fn, args, {"n": 2})
+    leftover = os.path.join(cur.dir, "deadbeef.aot.tmp.123")
+    with open(leftover, "wb") as f:
+        f.write(b"crashed writer leftover")
+
+    cur.gc_max_age_s = 3600.0
+    cur.gc_max_bytes = 1                    # force over-budget
+    assert cur.gc(now=_time.time() + 1.0) == 3   # young, but over budget
+    survivors = [f for f in os.listdir(cur.dir) if f.endswith(".aot")]
+    assert survivors and all(
+        cur._entry_content_key(os.path.join(cur.dir, f)) == "gen-new"
+        for f in survivors
+    )
+    # the young tmp leftover survived; past the age bound it goes too
+    assert os.path.exists(leftover)
+    assert cur.gc(now=_time.time() + 2 * 3600.0) == 1
+    assert not os.path.exists(leftover)
+
+
 def test_aot_key_separates_shapes_and_statics(tmp_path, jit_fn):
     from hypergraphdb_tpu.ops import aot_cache as ac
 
@@ -421,3 +490,25 @@ def test_aot_dispatch_results_match_plain_jit(graph, tmp_path):
         rt.close()
     a, b = res.values()
     assert a.count == b.count and np.array_equal(a.matches, b.matches)
+
+
+def test_aot_gc_disabled_by_none_is_inert(tmp_path, jit_fn):
+    """``gc_max_age_s=None`` is the documented off switch: a MANUAL
+    ``gc()`` must be a no-op too — reading None as age 0 would delete
+    every superseded entry and any tmp a concurrent writer is
+    mid-writing."""
+    import os
+
+    from hypergraphdb_tpu.ops import aot_cache as ac
+
+    args = (jnp.zeros((16,), jnp.float32),)
+    old = ac.AOTCache(root=str(tmp_path), content_key="gen-old")
+    old.get_or_compile("t.mul", jit_fn, args, {"n": 2})
+    cur = ac.AOTCache(root=str(tmp_path), content_key="gen-new",
+                      gc_max_age_s=None)
+    with open(os.path.join(cur.dir, "w.tmp.123"), "wb") as f:
+        f.write(b"half-written")
+    assert cur.gc() == 0
+    names = set(os.listdir(cur.dir))
+    assert "w.tmp.123" in names
+    assert any(n.endswith(".aot") for n in names)

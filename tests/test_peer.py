@@ -423,12 +423,22 @@ def test_ack_driven_truncation(two_peers):
         lambda: p1.replication.peer_acks.get("peer-2", 0) >= 30, timeout=15
     )
     assert _wait(lambda: p1.replication.log.floor > 0, timeout=15)
-    # a catch-up from before the floor flags the full-sync path
-    p2.replication.last_seen._map["peer-1"] = 0
-    p2.replication.catch_up("peer-1")
-    assert _wait(
-        lambda: "peer-1" in p2.replication.needs_full_sync, timeout=15
-    )
+    # a catch-up from before the floor flags the full-sync path. The
+    # rewind must cover BOTH SeenMap views (contiguous map + applied
+    # ranges) and is re-applied each poll: a catch-up continuation or
+    # gap-repair page still in flight (sent before flush, applied
+    # after) can restore the clock via record_applied and turn one
+    # rewound catch-up into a no-op — re-rewinding wins once the
+    # stragglers run dry, since nothing new is being pushed.
+    def rewound_catchup_flags_full_sync():
+        seen = p2.replication.last_seen
+        with seen._lock:
+            seen._map["peer-1"] = 0
+            seen._ranges["peer-1"] = [[0, 0]]
+        p2.replication.catch_up("peer-1")
+        return "peer-1" in p2.replication.needs_full_sync
+
+    assert _wait(rewound_catchup_flags_full_sync, timeout=15)
 
 
 def test_slow_apply_does_not_stall_dispatch(two_peers):

@@ -1,0 +1,223 @@
+"""Recovery drill: a replica InjectedCrash-killed at each snapshot-
+transfer chunk boundary, restarted, and required to rejoin (resume or
+clean re-bootstrap) and converge to the primary's canonical content —
+differential-equal vs an uninterrupted replica.
+
+The kill: ``InjectedCrash`` armed on the replica's position-addressed
+CONFIRM pull at chunk index ``k``. The client applies chunk ``k`` FIRST
+and pulls next second, so the crash lands exactly on the k-th chunk
+boundary — with the chunk's atoms partially durable in the replica's
+graph, the worst possible restart state. ``InjectedCrash`` is a
+``BaseException``, so no ``except Exception`` healing layer can swallow
+it — but in-process the unwound stack is ONE worker thread, while a
+real kill takes the whole process (and the peer plane is deliberately
+robust to single-thread deaths: the stall watchdog would quietly
+re-pull and heal). The ``process_kill`` fixture completes the
+simulation: the instant the crash unwinds its thread, the victim's
+transport is severed — nothing received from then on, exactly a killed
+process's silence. The stalled transfer then fails typed
+(``TransientFault`` after the resume budget) and the restarted node
+must make the partially-applied graph converge anyway (gid
+write-through makes the re-transfer idempotent)."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+import hypergraphdb_tpu as hg
+from hypergraphdb_tpu.fault import InjectedCrash, TransientFault, \
+    global_faults
+from hypergraphdb_tpu.peer import transfer
+from hypergraphdb_tpu.peer.peer import HyperGraphPeer
+from hypergraphdb_tpu.peer.transport import LoopbackNetwork
+from hypergraphdb_tpu.replica import ReplicaConfig, ReplicaNode
+from hypergraphdb_tpu.serve import ServeConfig
+
+
+@pytest.fixture
+def faults():
+    f = global_faults()
+    f.reset()
+    yield f
+    f.reset()
+    f.disable()
+
+
+@pytest.fixture
+def process_kill(monkeypatch):
+    """InjectedCrash unwinding ANY thread == the PROCESS died. The hook
+    counts the kill, keeps the intended traceback out of the test log,
+    and — when the test registered ``state["transport"]`` — severs that
+    transport on the spot, so the in-process victim goes as silent as a
+    real corpse (single-thread deaths alone the peer plane survives by
+    design)."""
+    state = {"transport": None, "crashes": []}
+    orig = threading.excepthook
+
+    def hook(args):
+        if args.exc_type is InjectedCrash:
+            state["crashes"].append(args)
+            t = state["transport"]
+            if t is not None:
+                t.stop()
+            return
+        orig(args)
+
+    monkeypatch.setattr(threading, "excepthook", hook)
+    return state
+
+
+def serve_cfg():
+    return ServeConfig(max_linger_s=0.001, prewarm_aot=False)
+
+
+def replica_cfg():
+    return ReplicaConfig(primary="primary",
+                         anti_entropy_interval_s=0.1,
+                         bootstrap_page=8,         # ~5 chunks
+                         bootstrap_timeout_s=30.0,
+                         serve=serve_cfg())
+
+
+def wait_digest_equal(ga, gb, timeout=30.0):
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if transfer.content_digest(ga) == transfer.content_digest(gb):
+            return True
+        time.sleep(0.02)
+    return False
+
+
+@pytest.mark.parametrize("crash_chunk", [1, 2, 3, 4])
+def test_crash_at_each_chunk_boundary_rejoins_and_converges(
+        faults, process_kill, crash_chunk):
+    net = LoopbackNetwork()
+    gp = hg.HyperGraph()
+    pp = HyperGraphPeer.loopback(gp, net, identity="primary")
+    pp.replication.debounce_s = 0.005
+    pp.start()
+    nodes = [int(gp.add(f"d{i}")) for i in range(30)]
+    for i in range(0, 28, 2):
+        gp.add_link([nodes[i], nodes[i + 1]], value=f"dl{i}")
+
+    # the uninterrupted CONTROL replica — the differential baseline
+    gc_ = hg.HyperGraph()
+    control = ReplicaNode(
+        gc_, HyperGraphPeer.loopback(gc_, net, identity="control"),
+        replica_cfg())
+    control.start()
+    assert pp.replication.flush()
+    assert wait_digest_equal(gp, gc_)
+
+    # the VICTIM: InjectedCrash at the crash_chunk-th CONFIRM pull
+    gr = hg.HyperGraph()
+    faults.enable(seed=crash_chunk)
+    faults.arm(
+        "peer.transport.send", at={crash_chunk}, error=InjectedCrash,
+        when=lambda ctx: (ctx.get("activity") == "cact-transfer"
+                          and ctx.get("performative") == "confirm"),
+    )
+    pr = HyperGraphPeer.loopback(gr, net, identity="victim")
+    process_kill["transport"] = pr.interface    # what the kill severs
+    victim = ReplicaNode(gr, pr, ReplicaConfig(
+        primary="primary", anti_entropy_interval_s=0.1,
+        bootstrap_page=8, bootstrap_timeout_s=30.0,
+        bootstrap_retry_after_s=0.05, bootstrap_max_resumes=3,
+        serve=serve_cfg()))
+    # the dead node hears nothing more: the transfer stalls and fails
+    # typed after the resume budget; the node never reaches serving
+    with pytest.raises((TransientFault, TimeoutError)):
+        victim.start()
+    assert len(process_kill["crashes"]) == 1    # the kill really fired
+    assert faults.fired("peer.transport.send") == 1
+    assert not victim.bootstrapped
+    # the partially-applied graph holds SOME but not all atoms
+    n_applied = sum(1 for _ in gr.atoms())
+    assert n_applied > 0
+    pr.stop()                                   # bury the dead process
+
+    # RESTART over the same (partially bootstrapped) graph
+    faults.disarm("peer.transport.send")
+    pr2 = HyperGraphPeer.loopback(gr, net, identity="victim")
+    node2 = ReplicaNode(gr, pr2, replica_cfg())
+    node2.start()
+    try:
+        # a crash mid-transfer never anchored the clock → the rejoin is
+        # a CLEAN RE-BOOTSTRAP (idempotent over the partial apply)
+        assert node2.bootstrap_mode == "transfer"
+        assert node2.wait_converged(timeout=30)
+        # canonical content: rejoined == primary == uninterrupted
+        assert wait_digest_equal(gp, gr)
+        assert (transfer.content_digest(gr)
+                == transfer.content_digest(gc_))
+        # and it SERVES: reads flow on the rejoined node
+        gid0 = transfer.gid_of(gp, nodes[0], "primary")
+        local = int(transfer.lookup_local(gr, gid0))
+        res = node2.runtime.submit_bfs(local, max_hops=1) \
+                   .result(timeout=30)
+        assert res.count >= 2
+    finally:
+        node2.stop()
+        control.stop()
+        pp.stop()
+        gp.close()
+        gr.close()
+        gc_.close()
+
+
+def test_crash_after_transfer_rejoins_by_resume(faults, process_kill):
+    """The other boundary: the crash lands AFTER the transfer anchored
+    the clock (mid-follow) — the rejoin must take the cheap resume path
+    and converge by catch-up alone. (No transport registered with the
+    kill hook: this drill stops the node explicitly, modelling an
+    operator restart rather than a mid-transfer corpse.)"""
+    net = LoopbackNetwork()
+    gp = hg.HyperGraph()
+    pp = HyperGraphPeer.loopback(gp, net, identity="primary")
+    pp.replication.debounce_s = 0.005
+    pp.start()
+    for i in range(12):
+        gp.add(f"s{i}")
+    gr = hg.HyperGraph()
+    node = ReplicaNode(
+        gr, HyperGraphPeer.loopback(gr, net, identity="victim"),
+        replica_cfg())
+    node.start()
+    assert pp.replication.flush()
+    assert wait_digest_equal(gp, gr)
+    # kill the follower's receive loop with a push-delivery crash
+    faults.enable(seed=0)
+    faults.arm(
+        "peer.transport.send", at={1}, error=InjectedCrash,
+        when=lambda ctx: (ctx.get("activity") == "replication"
+                          and ctx.get("target") == "primary"),
+    )
+    gp.add("during-crash")          # the ack send kills the apply side
+    pp.replication.flush()
+    # give the victim's doomed ack a moment to fire, then bury it
+    import time
+
+    deadline = time.monotonic() + 10
+    while not process_kill["crashes"] and time.monotonic() < deadline:
+        time.sleep(0.02)
+    faults.disarm("peer.transport.send")
+    node.stop()
+    gp.add("after-crash")
+    pp.replication.flush()
+    # restart: clock is anchored → RESUME, catch-up converges the tail
+    node2 = ReplicaNode(
+        gr, HyperGraphPeer.loopback(gr, net, identity="victim"),
+        replica_cfg())
+    node2.start()
+    try:
+        assert node2.bootstrap_mode == "resume"
+        assert wait_digest_equal(gp, gr)
+    finally:
+        node2.stop()
+        pp.stop()
+        gp.close()
+        gr.close()
