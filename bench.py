@@ -1065,6 +1065,227 @@ g.close()
     }
 
 
+def bench_c7(snap, info):
+    """c7_pattern_join: worst-case-optimal conjunctive pattern joins —
+    anchored triangle and 2-path COUNTING over the 10M-atom graph
+    (hgjoin: GHD-planned multiway intersections, ``ops/join``), K
+    anchors per batched dispatch, vs the vectorized numpy host engine on
+    the same co-incidence CSR. Count-only mode: the device download is
+    one (K,) int32 per window, so the number measures the join engine,
+    not the host link. Exact-count shape policy (``var_pad_max``) — any
+    lane the caps still truncate is excluded from the differential and
+    reported.
+
+    Env knobs: BENCH_SEEDS (anchors per window), BENCH_C7_MAX_DEG
+    (anchor co-degree bound — hubs route to the serving tier's host
+    lane in production, same honesty here), BENCH_C7_ROW_CAP /
+    BENCH_C7_PAD_CAP (executor caps), BENCH_C7_BASELINE_N (host-engine
+    sample), BENCH_C7_REPS."""
+    import jax
+
+    from hypergraphdb_tpu.join.ir import (
+        ConjunctivePattern,
+        JoinAtom,
+        split_constants,
+    )
+    from hypergraphdb_tpu.join.planner import plan_join
+    from hypergraphdb_tpu.ops.join import execute_join, neighbor_csr
+
+    r = np.random.default_rng(43)
+    K = int(os.environ.get("BENCH_SEEDS", 1024))
+    # few lanes × big row bucket: a 2-path through a 512-wide anchor can
+    # bind ~10^5 tuples, and the binding table pools all lanes — 16
+    # lanes under a 2^20 bucket keeps dense anchors exact where 128
+    # lanes would overflow (and truncate) on every dispatch
+    lanes = int(os.environ.get("BENCH_C7_LANES", 16))
+    reps = int(os.environ.get("BENCH_C7_REPS", 8))
+    max_deg = int(os.environ.get("BENCH_C7_MAX_DEG", 512))
+    row_cap = int(os.environ.get("BENCH_C7_ROW_CAP", 1 << 20))
+    pad_cap = int(os.environ.get("BENCH_C7_PAD_CAP", 2048))
+    base_n = min(int(os.environ.get("BENCH_C7_BASELINE_N", 128)), K)
+
+    t0 = time.perf_counter()
+    off, flat = neighbor_csr(snap)  # one-time per snapshot, like ELL
+    nbr_build_s = time.perf_counter() - t0
+    off64 = off.astype(np.int64)
+
+    # anchors: entities with a non-trivial but bounded co-row whose
+    # NEIGHBOURS' co-rows also fit the pad — a zipf hub's row can run
+    # into the millions, and a production deployment routes hub-anchored
+    # patterns to the serving tier's exact host lane (truncation-honest
+    # executor + host re-serve); the bench measures the device-servable
+    # population, same honesty
+    e0, l0 = info["entities"]
+    N = snap.num_atoms
+    all_w = off64[1: N + 1] - off64[:N]
+    widths = all_w[e0:l0]
+    cand = np.flatnonzero((widths >= 2) & (widths <= max_deg)) + e0
+    if len(cand):
+        # subsample BEFORE the per-anchor neighbour scan: the scan is a
+        # host loop, and 8×K candidates is plenty to fill K lanes
+        cand = cand[r.integers(0, len(cand),
+                               size=min(8 * K, len(cand)))]
+        nbr_max = np.array([
+            all_w[flat[off64[a]: off64[a + 1]]].max(initial=0)
+            for a in cand
+        ])
+        cand = cand[nbr_max <= pad_cap]
+    if not len(cand):
+        raise RuntimeError("c7: no device-servable anchors at this "
+                           "scale; lower BENCH_C7_MAX_DEG / raise "
+                           "BENCH_C7_PAD_CAP")
+    anchors = cand[r.integers(0, len(cand), size=K)].astype(np.int64)
+
+    def pattern_of(shape: str, a0: int) -> ConjunctivePattern:
+        if shape == "triangle":   # a–y, y–z, z–a
+            return ConjunctivePattern(
+                vars=("y", "z"),
+                atoms=(JoinAtom("co", "y", int(a0)),
+                       JoinAtom("co", "y", "z"),
+                       JoinAtom("co", "z", int(a0))),
+            )
+        return ConjunctivePattern(   # 2-path: a–y, y–z
+            vars=("y", "z"),
+            atoms=(JoinAtom("co", "y", int(a0)),
+                   JoinAtom("co", "z", "y")),
+        )
+
+    def host_counts(shape: str, aa: np.ndarray) -> np.ndarray:
+        """The vectorized numpy host engine: per-anchor sorted-array
+        intersections over the same co-incidence CSR rows."""
+        out = np.zeros(len(aa), dtype=np.int64)
+        for i, a in enumerate(aa):
+            row = flat[off64[a]: off64[a + 1]].astype(np.int64)
+            if shape == "triangle":
+                out[i] = sum(
+                    len(np.intersect1d(
+                        flat[off64[y]: off64[y + 1]], row,
+                        assume_unique=True,
+                    )) for y in row
+                )
+            else:
+                # enumerate (y, z) bindings the way a join engine must
+                # (z ≠ a, z ≠ y by irreflexivity) — counting via degree
+                # arithmetic would be the special-case shortcut, not
+                # the conjunctive-pattern workload under test
+                zs = flat[np.concatenate([
+                    np.arange(off64[y], off64[y + 1]) for y in row
+                ]) if len(row) else np.empty(0, dtype=np.int64)]
+                out[i] = int((zs != a).sum())
+        return out
+
+    result: dict = {
+        "anchors": K,
+        "nbr_build_s": round(nbr_build_s, 2),
+        "nbr_edges": int(off64[snap.num_atoms]),
+    }
+    for shape, n_consts in (("triangle", 2), ("path2", 1)):
+        pat = pattern_of(shape, int(anchors[0]))
+        sig, consts0 = split_constants(pat)
+        plan = plan_join(snap, pat, sig, consts0)
+        consts = np.repeat(anchors[:, None], n_consts, axis=1) \
+            .astype(np.int32)
+        # pad the anchor list to a lanes multiple so every dispatch
+        # shares ONE compiled shape (counts are sliced back to K)
+        if K % lanes:
+            consts = np.concatenate(
+                [consts, np.repeat(consts[:1], lanes - K % lanes, 0)]
+            )
+
+        def window(n_anchors=len(consts)):
+            """n_anchors through ``lanes``-wide dispatches (bounding the
+            pooled binding table) — returns the async handle list."""
+            return [
+                execute_join(
+                    snap, plan, consts[i: i + lanes], top_r=0,
+                    count_only=True, row_cap=row_cap, pad_cap=pad_cap,
+                    var_pad_max=True,
+                )
+                for i in range(0, n_anchors, lanes)
+            ]
+
+        compile_info = _timed_warmup(lambda: jax.block_until_ready(
+            [ex.counts for ex in window(min(lanes, K))]
+        ))
+
+        def timed():
+            t0 = time.perf_counter()
+            exs = window()
+            jax.block_until_ready([ex.counts for ex in exs])
+            return K / (time.perf_counter() - t0), exs
+
+        dev_qps, exs = best_of(timed, n=reps)
+        counts = np.concatenate(
+            [np.asarray(ex.counts, dtype=np.int64) for ex in exs]
+        )[:K]
+        trunc = np.concatenate(
+            [np.asarray(ex.trunc) for ex in exs]
+        )[:K]
+
+        def host_window():
+            t0 = time.perf_counter()
+            hc = host_counts(shape, anchors[:base_n])
+            return base_n / (time.perf_counter() - t0), hc
+
+        host_qps, hc = best_of(host_window, n=2)
+        exact = ~trunc[:base_n]
+        agree = bool(np.array_equal(counts[:base_n][exact], hc[exact]))
+        result[shape] = {
+            "device_anchors_per_sec": round(dev_qps, 1),
+            "host_anchors_per_sec": round(host_qps, 1),
+            "vs_host": (round(dev_qps / host_qps, 2)
+                        if host_qps else None),
+            "bindings_total": int(counts[~trunc].sum()),
+            "n_truncated": int(trunc.sum()),
+            "differential_equal": agree,
+            "plan": plan.describe(),
+            **compile_info,
+        }
+        if not agree:
+            bad = np.flatnonzero(
+                exact & (counts[:base_n] != hc)
+            )[:5]
+            result[shape]["differential_diff"] = [
+                [int(anchors[i]), int(counts[i]), int(hc[i])]
+                for i in bad
+            ]
+    telemetry = _telemetry_dump("c7")
+    if telemetry:
+        result["telemetry"] = telemetry
+    result["recorded_to"] = _record_c7(result)
+    return result
+
+
+def _record_c7(result: dict) -> Optional[str]:
+    """Persist the c7 pattern-join numbers (device-vs-host ratio for
+    triangle + 2-path counting, truncation honesty, differential
+    verdict) to ``BENCH_C7_<tag>.json`` next to this file — the
+    committed record the ISSUE asks for. Best-effort like
+    :func:`_record_c6`."""
+    tag = os.environ.get("BENCH_C7_TAG", "local")
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), f"BENCH_C7_{tag}.json"
+    )
+    record = {
+        "schema_version": 1,
+        "recorded_unix": int(time.time()),
+        "tag": tag,
+        "backend": _backend_name(),
+        "c7_pattern_join": {k: v for k, v in result.items()
+                            if k not in ("telemetry", "recorded_to")},
+    }
+    try:
+        with open(path, "w") as f:
+            json.dump(record, f, indent=2, sort_keys=True)
+            f.write("\n")
+    except OSError as e:
+        import sys
+
+        print(f"bench: could not write {path}: {e}", file=sys.stderr)
+        return None
+    return os.path.basename(path)
+
+
 def _record_c6(result: dict) -> Optional[str]:
     """Persist the c6 serving numbers (ratio, occupancy, percentiles) to
     ``BENCH_C6_<tag>.json`` next to this file — the committed record the
@@ -1150,6 +1371,11 @@ def _config_c6() -> dict:
     return bench_c6()
 
 
+def _config_c7() -> dict:
+    snap, info, _ = _build_10m()
+    return _with_telemetry("c7", lambda: bench_c7(snap, info))
+
+
 def _run_isolated(name: str) -> dict:
     """Run one config in a FRESH python subprocess.
 
@@ -1204,6 +1430,7 @@ def main() -> None:
         c2 = _run_isolated("c2")
         c5 = _run_isolated("c5")
         c6 = _run_isolated("c6")
+        c7 = _run_isolated("c7")
         graph = c4.pop("_graph")
     else:  # legacy in-process path (BENCH_ISOLATE=0): order still matters
         # c6's cold-start probe BEFORE any config initializes the device
@@ -1221,6 +1448,7 @@ def main() -> None:
         c2 = _with_telemetry("c2", bench_c2)
         c5 = _with_telemetry("c5", bench_c5)
         c6 = bench_c6(cold=cold)
+        c7 = _with_telemetry("c7", lambda: bench_c7(snap, info))
         graph = {
             "n_atoms": info["n_atoms"],
             "total_arity": info["total_arity"],
@@ -1237,6 +1465,7 @@ def main() -> None:
             "c4_bfs_3hop_10m": c4,
             "c5_streaming": c5,
             "c6_serving": c6,
+            "c7_pattern_join": c7,
         },
         "graph": graph,
     }))

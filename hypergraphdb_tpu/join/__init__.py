@@ -1,0 +1,61 @@
+"""hgjoin: worst-case-optimal conjunctive pattern joins on TPU.
+
+The subsystem that closes ROADMAP item 1: arbitrary conjunctive
+patterns over incidence sets — triangles, paths, stars, anchored
+multi-atom conjunctions — planned as left-deep generalized hypertree
+decompositions (:mod:`~hypergraphdb_tpu.join.planner`) and executed as
+batched per-variable multiway intersections on the CSR snapshot
+(:mod:`~hypergraphdb_tpu.ops.join`), with ``graph.find_all``-based
+exact host evaluation (:mod:`~hypergraphdb_tpu.join.host`) as both the
+differential oracle and the serving fallback lane.
+
+Entry points::
+
+    from hypergraphdb_tpu import join
+    p = join.extract_pattern(g, {
+        "y": q.co_incident(join.var("z")) & ...,  # condition spec
+        "z": ...,
+    })
+    sig, consts = join.split_constants(p)
+    plan = join.plan_join(g.snapshot(), p)
+    tuples = join.host_join(g, p)                 # exact ground truth
+
+Serving rides ``ServeRuntime.submit_join`` / ``query.bridge.
+to_join_request`` — see the README "Pattern joins" section.
+"""
+
+from hypergraphdb_tpu.join.host import host_join, host_join_count
+from hypergraphdb_tpu.join.ir import (
+    ConjunctivePattern,
+    JoinAtom,
+    JoinUnsupported,
+    PatternSignature,
+    extract_pattern,
+    pattern_to_conditions,
+    split_constants,
+)
+from hypergraphdb_tpu.join.planner import (
+    DeviceJoinPlan,
+    JoinPlan,
+    JoinStep,
+    plan_join,
+)
+from hypergraphdb_tpu.query.variables import Var, var
+
+__all__ = [
+    "ConjunctivePattern",
+    "DeviceJoinPlan",
+    "JoinAtom",
+    "JoinPlan",
+    "JoinStep",
+    "JoinUnsupported",
+    "PatternSignature",
+    "Var",
+    "extract_pattern",
+    "host_join",
+    "host_join_count",
+    "pattern_to_conditions",
+    "plan_join",
+    "split_constants",
+    "var",
+]

@@ -1,0 +1,109 @@
+"""Exact host evaluation of conjunctive patterns — the ground truth.
+
+Recursive enumeration through the ordinary single-variable query engine:
+binding variables in order, each variable's candidates come from
+``graph.find_all`` over the clauses whose references are already bound
+(the compiler's own cost-based planning answers each step), and every
+deferred cross-reference is checked via the conditions' ``satisfies``
+contract the moment its last variable binds. This is the differential
+oracle ``tests/test_join.py`` holds the device executor to, and the
+serving tier's exact fallback lane — deliberately a SEPARATE
+implementation path from ``ops/join.py`` (find_all + satisfies vs CSR
+kernels), so agreement is evidence.
+"""
+
+from __future__ import annotations
+
+from hypergraphdb_tpu.join.ir import (
+    ConjunctivePattern,
+    JoinUnsupported,
+    pattern_to_conditions,
+)
+from hypergraphdb_tpu.query import conditions as c
+from hypergraphdb_tpu.query.variables import substitute, variables_of
+
+
+def _clauses(cond) -> tuple:
+    return cond.clauses if isinstance(cond, c.And) else (cond,)
+
+
+def host_join(graph, pattern: ConjunctivePattern) -> list[tuple]:
+    """Enumerate every binding tuple of ``pattern`` (variables in
+    ``pattern.vars`` order), sorted lexicographically. Always complete:
+    a capped enumeration would be a DFS-order sample, not the
+    lexicographic prefix a truncation differential needs — callers
+    slice the sorted result instead."""
+    spec = pattern_to_conditions(pattern)
+    # owner clauses, tagged with their free variables
+    items = []
+    for v, cond in spec.items():
+        for cl in _clauses(cond):
+            items.append((v, cl, frozenset(variables_of(cl))))
+    # binding order must be FEASIBLE, not the spec's declaration order:
+    # each variable needs a generating clause whose references are
+    # already bound when its turn comes (the device planner reorders
+    # freely — e.g. {'y': co(var('z')), 'z': co(a)} binds z first).
+    # Greedy: repeatedly take any unbound variable with a ready
+    # generator; emitted tuples stay in pattern.vars order.
+    order: list[str] = []
+    bound_set: set[str] = set()
+    remaining = list(pattern.vars)
+    while remaining:
+        ready = next(
+            (v for v in remaining if any(
+                owner == v and free <= bound_set
+                for owner, _, free in items
+            )),
+            None,
+        )
+        if ready is None:
+            raise JoinUnsupported(
+                f"variables {remaining} have no constant-anchored path "
+                "into the pattern (disconnected or unanchored)"
+            )
+        order.append(ready)
+        bound_set.add(ready)
+        remaining.remove(ready)
+    consts = {int(a.key) for a in pattern.atoms if not a.key_is_var}
+    out: list[tuple] = []
+
+    def bind(depth: int, bound: dict) -> bool:
+        if depth == len(order):
+            out.append(tuple(bound[v] for v in pattern.vars))
+            return False
+        v = order[depth]
+        gen: list = []
+        checks: list = []
+        for owner, cl, free in items:
+            if owner == v and free <= bound.keys():
+                gen.append(substitute(cl, bound) if free else cl)
+            elif (owner != v and owner in bound and v in free
+                  and free <= bound.keys() | {v}):
+                checks.append((owner, cl))
+        cond_v = gen[0] if len(gen) == 1 else c.And(*gen)
+        for h in sorted(int(x) for x in graph.find_all(cond_v)):
+            if pattern.distinct and (
+                h in consts or any(h == b for b in bound.values())
+            ):
+                continue
+            ok = True
+            for owner, cl in checks:
+                inst = substitute(cl, {**bound, v: h})
+                if not inst.satisfies(graph, bound[owner]):
+                    ok = False
+                    break
+            if not ok:
+                continue
+            bound[v] = h
+            stop = bind(depth + 1, bound)
+            del bound[v]
+            if stop:
+                return True
+        return False
+
+    bind(0, {})
+    return sorted(out)
+
+
+def host_join_count(graph, pattern: ConjunctivePattern) -> int:
+    return len(host_join(graph, pattern))

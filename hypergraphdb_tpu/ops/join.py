@@ -1,0 +1,546 @@
+"""Batched worst-case-optimal join executor: leapfrog as vector kernels.
+
+The device lowering of ``join/planner.JoinPlan``: a binding table of
+variable columns grows one variable per step, exactly the TrieJax
+execution model (PAPERS.md — per-variable multiway set intersections)
+vectorized the way every kernel in this repo is: K independent requests
+ride one padded batch, intersections are branchless binary searches
+against CSR rows (``ops/setops.segment_member_mask``'s discipline), and
+the binding table lives in **shape buckets** so a long-running server
+compiles a bounded program set.
+
+Per step::
+
+    keys    = column j of the table (or a per-request constant)
+    cand    = CSR row gather of keys           (K·R, pad)   — expansion
+    cand   &= cand ∈ row(other)                per filter   — leapfrog
+    cand   &= type/distinct masks
+    table'  = compact survivors into the next row bucket
+
+Truncation honesty: a CSR row wider than the expansion pad, or a
+compaction that would overflow the row bucket, flags the owning request
+in ``trunc`` — its count is then a LOWER bound and its prefix honest,
+and the serving tier re-serves exactly that request on host
+(``serve/runtime``'s exact-at-collect discipline). Nothing is silently
+dropped.
+
+The co-incidence relation (two atoms sharing a link — the pattern edge)
+is materialized once per snapshot as :func:`neighbor_csr`, the binary
+adjacency the reference's ZigZag join walks through B-tree cursors
+(``impl/ZigZagIntersectionResult.java:37-75``), here two flat arrays.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hypergraphdb_tpu import verify as hgverify
+from hypergraphdb_tpu.ops.setops import SENTINEL, _bucket
+from hypergraphdb_tpu.ops.snapshot import CSRSnapshot
+
+#: exemplar candidate slots (R × pad) of the registered
+#: ``join_expand_step`` trace — normalizes the committed hgverify byte
+#: budget into the planner's bytes-per-probe cost constant
+EXEMPLAR_SLOTS = 8 * 8
+
+#: default binding-table row cap (rows per batch, all requests pooled)
+DEFAULT_ROW_CAP = 1 << 15
+
+#: default expansion-pad cap (CSR rows wider than this flag truncation);
+#: the effective per-step pad is additionally bounded by ``slot_budget``
+#: divided by the live row count, so a wide pad is only ever paid while
+#: the table is narrow
+DEFAULT_PAD_CAP = 1 << 10
+
+#: default candidate-slot budget per expand step (rows × pad) — the
+#: executor's peak-memory bound: 2^25 int32 slots ≈ 128 MB
+DEFAULT_SLOT_BUDGET = 1 << 25
+
+#: co-incidence materialization budget, in ordered pairs (Σ arity·(a-1)
+#: over links). Past it the relation itself is gigabytes and the build
+#: would stall (or OOM) whatever thread asked — callers decline to the
+#: host path instead. Override: HG_JOIN_MAX_NBR_PAIRS, hard-clamped
+#: below int32 range: the CSR offsets (and the device kernels' gather
+#: indices) are int32, so a larger relation would silently wrap —
+#: corrupt-but-exact-looking answers, the one failure mode this
+#: subsystem's truncation-honest contract forbids.
+NBR_MAX_PAIRS = min(
+    int(__import__("os").environ.get("HG_JOIN_MAX_NBR_PAIRS", 1 << 28)),
+    (1 << 31) - 256,
+)
+
+
+# ---------------------------------------------------------------- nbr CSR
+
+
+def nbr_pair_count(snap: CSRSnapshot) -> int:
+    """Ordered co-incidence pairs the snapshot's links imply (before
+    dedupe) — the build cost AND an upper bound on the relation's size,
+    O(N) from the arity column."""
+    ar = snap.arity[: snap.num_atoms].astype(np.int64)
+    return int((ar * np.maximum(ar - 1, 0)).sum())
+
+
+def neighbor_csr(snap: CSRSnapshot) -> tuple[np.ndarray, np.ndarray]:
+    """The co-incidence adjacency as a CSR, cached on the snapshot:
+    ``flat[offsets[u]:offsets[u+1]]`` = sorted unique atoms sharing at
+    least one link with ``u`` (never ``u`` itself — the relation is
+    irreflexive, see ``conditions.CoIncident``). Row ``N`` (the dummy)
+    is empty. Built vectorized from the target relation: every link
+    contributes all ordered pairs of its distinct targets."""
+    cached = getattr(snap, "_nbr_csr", None)
+    if cached is not None:
+        return cached
+    pairs = nbr_pair_count(snap)
+    if pairs > NBR_MAX_PAIRS:
+        from hypergraphdb_tpu.join.ir import JoinUnsupported
+
+        raise JoinUnsupported(
+            f"co-incidence relation would materialize {pairs} pairs "
+            f"(budget {NBR_MAX_PAIRS}, HG_JOIN_MAX_NBR_PAIRS); joins on "
+            "this snapshot run on the host path"
+        )
+    N = snap.num_atoms
+    e = snap.n_edges_tgt
+    t = snap.tgt_flat[:e].astype(np.int64)
+    src = snap.tgt_src[:e].astype(np.int64)
+    if e:
+        # entries are grouped by link (records ascending); for entry i of
+        # a link with arity a, pair it with all a entries of that link
+        lens_link = np.asarray(
+            snap.tgt_offsets[1:] - snap.tgt_offsets[:-1], dtype=np.int64
+        )
+        a_e = lens_link[src]                       # owning link's arity
+        ss_e = snap.tgt_offsets[src].astype(np.int64)  # segment start
+        left = np.repeat(t, a_e)
+        co_pos = np.repeat(ss_e, a_e) + (
+            np.arange(int(a_e.sum()), dtype=np.int64)
+            - np.repeat(np.cumsum(a_e) - a_e, a_e)
+        )
+        right = t[co_pos]
+        keep = left != right                       # irreflexive by VALUE
+        left, right = left[keep], right[keep]
+        order = np.lexsort((right, left))
+        left, right = left[order], right[order]
+        if len(left):
+            uniq = np.ones(len(left), dtype=bool)
+            uniq[1:] = (left[1:] != left[:-1]) | (right[1:] != right[:-1])
+            left, right = left[uniq], right[uniq]
+    else:
+        left = right = np.empty(0, dtype=np.int64)
+    offsets = np.zeros(N + 2, dtype=np.int32)
+    np.cumsum(np.bincount(left, minlength=N + 1), out=offsets[1: N + 2])
+    flat = right.astype(np.int32)
+    if len(flat) % 128:
+        pad = np.full(128 - len(flat) % 128, N, dtype=np.int32)
+        flat = np.concatenate([flat, pad])
+    elif not len(flat):
+        flat = np.full(128, N, dtype=np.int32)
+    out = (offsets, flat)
+    object.__setattr__(snap, "_nbr_csr", out)
+    return out
+
+
+def neighbor_csr_device(snap: CSRSnapshot):
+    """Device twin of :func:`neighbor_csr`, uploaded once per snapshot."""
+    cached = getattr(snap, "_nbr_csr_dev", None)
+    if cached is not None:
+        return cached
+    offsets, flat = neighbor_csr(snap)
+    out = (jnp.asarray(offsets), jnp.asarray(flat))
+    object.__setattr__(snap, "_nbr_csr_dev", out)
+    return out
+
+
+# ---------------------------------------------------------------- kernels
+
+
+def _member_elementwise(flat, starts, ends, queries):
+    """``queries[i, j] ∈ flat[starts[i, j]:ends[i, j]]`` — the
+    elementwise-bounds twin of ``setops.segment_member_mask`` (there the
+    segment is per ROW; here per element, for reversed membership tests
+    whose segment comes from the candidate itself)."""
+    emax = flat.shape[0] - 1
+    lo = starts.astype(jnp.int32)
+    hi = ends.astype(jnp.int32)
+
+    def body(_, state):
+        lo, hi = state
+        active = lo < hi
+        mid = (lo + hi) >> 1
+        v = flat[jnp.minimum(mid, emax)]
+        go_right = v < queries
+        lo = jnp.where(active & go_right, mid + 1, lo)
+        hi = jnp.where(active & ~go_right, mid, hi)
+        return lo, hi
+
+    lo, hi = jax.lax.fori_loop(0, 32, body, (lo, hi))
+    found = flat[jnp.minimum(lo, emax)]
+    return (lo < ends.astype(jnp.int32)) & (found == queries) \
+        & (queries != SENTINEL)
+
+
+@hgverify.entry(
+    shapes=lambda: (
+        (hgverify.sds((33,), "int32"), hgverify.sds((64,), "int32"),
+         hgverify.sds((8, 1), "int32"), hgverify.sds((8,), "int32"),
+         hgverify.sds((8,), "bool"), hgverify.sds((4, 2), "int32"),
+         (hgverify.sds((33,), "int32"),),
+         (hgverify.sds((64,), "int32"),),
+         hgverify.sds((32,), "int32")),
+        {},
+    ),
+    statics={
+        "exp_sel": ("const", 0),
+        "filt_sel": ((False, "col", 0),),
+        "type_handle": -1,
+        "pad": 8, "rows_out": 16, "n_lanes": 4,
+        "n_distinct_cols": 1, "distinct_consts": True, "dedupe": False,
+    },
+)
+@partial(jax.jit, static_argnames=(
+    "exp_sel", "filt_sel", "type_handle", "pad", "rows_out", "n_lanes",
+    "n_distinct_cols", "distinct_consts", "dedupe",
+))
+def join_expand_step(
+    exp_offsets: jax.Array,   # (N+2,) int32 — expansion CSR offsets
+    exp_flat: jax.Array,      # (E,) int32 — expansion CSR payload
+    cols: jax.Array,          # (R, T) int32 bound binding columns (T ≥ 0)
+    lanes: jax.Array,         # (R,) int32 request lane per binding row
+    valid: jax.Array,         # (R,) bool
+    consts: jax.Array,        # (n_lanes, A) int32 per-request constants
+    filt_offsets: tuple,      # one (N+2,) per membership filter
+    filt_flats: tuple,        # one (E',) per membership filter
+    type_of: jax.Array,       # (N+1,) int32
+    *,
+    exp_sel: tuple,           # ("col", j) | ("const", slot)
+    filt_sel: tuple,          # ((rev, "col"|"const", idx), ...)
+    type_handle: int,         # -1 = unconstrained
+    pad: int,                 # expansion width bucket
+    rows_out: int,            # binding-row bucket after this step
+    n_lanes: int,             # request lanes (K)
+    n_distinct_cols: int,     # earlier columns candidates must differ from
+    distinct_consts: bool,    # candidates must differ from every constant
+    dedupe: bool,             # expansion rows may repeat values (tgt)
+) -> tuple:
+    """Bind ONE variable for every binding row of a K-request batch:
+    expand candidates from the keyed CSR row, leapfrog-intersect against
+    the filter relations, and compact survivors into the next row
+    bucket. Returns ``(cols', lanes', valid', lane_counts, lane_trunc)``
+    — counts are THIS step's exact per-request survivor totals (counted
+    before compaction, so a bucket overflow never corrupts them);
+    ``lane_trunc`` flags requests whose expansion row overflowed ``pad``
+    or whose survivors overflowed ``rows_out``."""
+    R, T = cols.shape
+    dummy = type_of.shape[0] - 1
+
+    def key_of(sel):
+        kind, idx = sel
+        k = cols[:, idx] if kind == "col" else consts[lanes, idx]
+        return jnp.where(valid, k, dummy)
+
+    key = key_of(exp_sel)
+    starts = exp_offsets[key]
+    ends = exp_offsets[key + 1]
+    widths = ends - starts
+    over_row = (widths > pad) & valid
+    lane_ix = jnp.arange(pad, dtype=jnp.int32)
+    cmask = lane_ix[None, :] < jnp.minimum(widths, pad)[:, None]
+    idx = jnp.minimum(starts[:, None] + lane_ix[None, :],
+                      exp_flat.shape[0] - 1)
+    cand = jnp.where(cmask, exp_flat[idx], SENTINEL)
+    cmask = cmask & valid[:, None]
+    if dedupe:
+        # target tuples may repeat a value; keep the first occurrence so
+        # binding rows stay DISTINCT tuples. Sort-based — stable argsort
+        # keeps equal values in position order, so marking each sorted
+        # element equal to its predecessor drops every occurrence but
+        # the first at O(pad·log pad) per row (a pairwise compare would
+        # be O(pad²) elements and a (pad, pad) constant — at the
+        # one-shot path's wide pads, gigabytes)
+        ord_ = jnp.argsort(cand, axis=1)
+        sc = jnp.take_along_axis(cand, ord_, axis=1)
+        dup_sorted = jnp.concatenate(
+            [jnp.zeros((R, 1), bool), sc[:, 1:] == sc[:, :-1]], axis=1
+        )
+        dup = jnp.zeros_like(dup_sorted).at[
+            jnp.arange(R, dtype=jnp.int32)[:, None], ord_
+        ].set(dup_sorted)
+        cmask = cmask & ~dup
+    safe = jnp.where(cmask, cand, dummy)
+    for (rev, kind, kidx), off_f, flat_f in zip(
+        filt_sel, filt_offsets, filt_flats
+    ):
+        o = key_of((kind, kidx))
+        if not rev:
+            # candidate ∈ row(key): per-row segment, shared bounds
+            from hypergraphdb_tpu.ops.setops import segment_member_mask
+
+            cmask = cmask & segment_member_mask(
+                flat_f, off_f[o], off_f[o + 1], cand
+            )
+        else:
+            # key ∈ row(candidate): per-element segments
+            qo = jnp.broadcast_to(o[:, None], cand.shape)
+            cmask = cmask & _member_elementwise(
+                flat_f, off_f[safe], off_f[safe + 1], qo
+            )
+    if type_handle >= 0:
+        cmask = cmask & (type_of[safe] == type_handle)
+    for j in range(n_distinct_cols):
+        cmask = cmask & (cand != cols[:, j, None])
+    if distinct_consts:
+        for s in range(consts.shape[1]):
+            cmask = cmask & (cand != consts[lanes, s][:, None])
+    lane_counts = jnp.zeros(n_lanes, jnp.int32).at[lanes].add(
+        cmask.sum(axis=1, dtype=jnp.int32)
+    )
+    # compaction: survivors first (stable — canonical row order is
+    # preserved), into the next bucket
+    flat_mask = cmask.reshape(-1)
+    src_row = jnp.repeat(jnp.arange(R, dtype=jnp.int32), pad)
+    order = jnp.argsort(~flat_mask)
+    sel = order[:rows_out]
+    new_valid = flat_mask[sel]
+    rsel = src_row[sel]
+    new_cols = jnp.concatenate(
+        [cols[rsel], cand.reshape(-1)[sel][:, None]], axis=1
+    )
+    new_lanes = lanes[rsel]
+    dropped = order[rows_out:]
+    trunc_i = jnp.zeros(n_lanes, jnp.int32)
+    trunc_i = trunc_i.at[lanes[src_row[dropped]]].add(
+        flat_mask[dropped].astype(jnp.int32), mode="drop"
+    )
+    trunc_i = trunc_i.at[lanes].add(over_row.astype(jnp.int32))
+    return new_cols, new_lanes, new_valid, lane_counts, trunc_i > 0
+
+
+@hgverify.entry(
+    shapes=lambda: (hgverify.sds((16, 2), "int32"),
+                    hgverify.sds((16,), "int32"),
+                    hgverify.sds((16,), "bool")),
+    statics={"top_r": 4, "n_lanes": 4, "sort_cols": (0, 1)},
+)
+@partial(jax.jit, static_argnames=("top_r", "n_lanes", "sort_cols"))
+def join_finalize(
+    cols: jax.Array,   # (R, V) int32 complete binding rows
+    lanes: jax.Array,  # (R,) int32
+    valid: jax.Array,  # (R,) bool
+    *,
+    top_r: int,
+    n_lanes: int,
+    sort_cols: tuple,  # column indices in sort priority (highest first)
+) -> jax.Array:
+    """Compact per-request result prefixes: the first ``top_r`` binding
+    tuples of every lane, ascending lexicographically by ``sort_cols``
+    (the caller passes the REQUEST's variable order mapped onto the
+    plan's column layout, so prefixes read canonically however the
+    planner reordered) — ``(n_lanes, top_r, V)`` int32, -1-padded. The
+    download per batch is O(K · top_r · V) however large the binding
+    table ran."""
+    R, V = cols.shape
+    lane_k = jnp.where(valid, lanes, n_lanes)
+    order = jnp.arange(R, dtype=jnp.int32)
+    for j in reversed(sort_cols):
+        order = order[jnp.argsort(cols[order, j])]
+    order = order[jnp.argsort(lane_k[order])]
+    sl = lane_k[order]
+    idx = jnp.arange(R, dtype=jnp.int32)
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), sl[1:] != sl[:-1]]
+    )
+    seg_start = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(first, idx, 0)
+    )
+    pos = idx - seg_start
+    rows = cols[order]
+    out = jnp.full((n_lanes, top_r, V), -1, jnp.int32)
+    return out.at[sl, pos].set(rows, mode="drop")
+
+
+# ---------------------------------------------------------------- execution
+
+
+@dataclass
+class JoinExecution:
+    """Async device handles of one executed join batch — pair with
+    ``np.asarray`` / :meth:`full_bindings` to sync. ``counts[k]`` is
+    exact unless ``trunc[k]`` (then a lower bound — the serving tier
+    re-serves that request on host)."""
+
+    order: tuple
+    counts: jax.Array                  # (K,) int32
+    trunc: jax.Array                   # (K,) bool
+    tuples: Optional[jax.Array] = None  # (K, top_r, V) int32, -1 pad
+    cols: Optional[jax.Array] = None    # full mode: final binding rows
+    lanes: Optional[jax.Array] = None
+    valid: Optional[jax.Array] = None
+
+    def full_bindings(self, lane: int) -> np.ndarray:
+        """All complete binding rows of one request lane, host-side —
+        (n, V) int64 in canonical (table) order."""
+        if self.cols is None:
+            raise ValueError("execute_join(full=True) required")
+        cols = np.asarray(self.cols)
+        keep = np.asarray(self.valid) & (np.asarray(self.lanes) == lane)
+        return cols[keep].astype(np.int64)
+
+
+def _rel_arrays(snap: CSRSnapshot, dev, rel: str):
+    if rel == "co":
+        return neighbor_csr_device(snap)
+    if rel == "inc":
+        return dev.inc_offsets, dev.inc_links
+    return dev.tgt_offsets, dev.tgt_flat
+
+
+def _rel_host_offsets(snap: CSRSnapshot, rel: str):
+    if rel == "co":
+        return neighbor_csr(snap)[0]
+    if rel == "inc":
+        return snap.inc_offsets
+    return snap.tgt_offsets
+
+
+def _rel_max_width(snap: CSRSnapshot, rel: str) -> int:
+    """The relation's widest row — a per-(snapshot, relation) invariant,
+    cached like ``_nbr_csr``: recomputing the O(N) diff+max per step per
+    dispatch would charge pure host bookkeeping to every timed device
+    window (the c7 bench runs 64 dispatches per rep)."""
+    cache = getattr(snap, "_join_wmax", None)
+    if cache is None:
+        cache = {}
+        object.__setattr__(snap, "_join_wmax", cache)
+    if rel not in cache:
+        off = np.asarray(_rel_host_offsets(snap, rel)[: snap.num_atoms + 1],
+                         dtype=np.int64)
+        cache[rel] = int(np.max(np.diff(off), initial=1))
+    return cache[rel]
+
+
+def execute_join(
+    snap: CSRSnapshot,
+    plan,                    # join/planner.JoinPlan
+    consts: np.ndarray,      # (K, n_consts) int32 — per-request constants
+    *,
+    top_r: int = 16,
+    full: bool = False,      # keep the final binding table downloadable
+    count_only: bool = False,
+    seeds: Optional[np.ndarray] = None,  # pre-bound var-0 candidates
+    row_cap: int = DEFAULT_ROW_CAP,
+    pad_cap: int = DEFAULT_PAD_CAP,
+    var_pad_max: bool = False,
+    n_real: Optional[int] = None,
+    slot_budget: int = DEFAULT_SLOT_BUDGET,
+) -> JoinExecution:
+    """Run ``plan`` for K same-signature requests in one batched pass —
+    async (no host sync; every return field is a device handle).
+
+    Shape policy (the compile-bounding half of the contract): expansion
+    pads for constant-keyed steps come from the BATCH's actual maximum
+    row width, power-of-two bucketed and capped at ``pad_cap``;
+    variable-keyed steps use the plan's estimate bucket
+    (``var_pad_max=True`` pays the relation's true max row width instead
+    — the exact-count mode the c7 bench runs). Row buckets grow
+    multiplicatively and cap at ``row_cap``. Anything the caps cut
+    off surfaces per request in ``trunc`` — never silently.
+
+    ``seeds`` replaces the first step: the given ids become the var-0
+    binding column of ONE request lane (the benchmark's global-counting
+    mode — chunk the id space, sum the counts)."""
+    dev = snap.device
+    K, A = (int(consts.shape[0]), int(consts.shape[1]))
+    consts = np.ascontiguousarray(consts, dtype=np.int32)
+    consts_dev = jnp.asarray(consts) if A else jnp.zeros((K, 0), jnp.int32)
+    if seeds is None:
+        cols = jnp.zeros((K, 0), jnp.int32)
+        lanes = jnp.arange(K, dtype=jnp.int32)
+        # pad lanes (serving's pad-to-bucket shapes) start invalid: they
+        # cost their slots but never gather, count, or truncate
+        valid = (jnp.ones(K, bool) if n_real is None
+                 else jnp.arange(K, dtype=jnp.int32) < int(n_real))
+        steps = plan.steps
+    else:
+        if K != 1:
+            raise ValueError("seeds mode is single-lane (K == 1)")
+        seeds = np.asarray(seeds, dtype=np.int32)
+        cols = jnp.asarray(seeds)[:, None]
+        lanes = jnp.zeros(len(seeds), jnp.int32)
+        valid = jnp.ones(len(seeds), bool)
+        steps = plan.steps[1:]
+    trunc = jnp.zeros(K, bool)
+    # a 1-variable plan in seeds mode has no steps left: the seeds ARE
+    # the complete bindings
+    counts = (jnp.zeros(K, jnp.int32).at[lanes].add(valid.astype(jnp.int32))
+              if seeds is not None and not steps
+              else jnp.zeros(K, jnp.int32))
+    for s in steps:
+        R = int(cols.shape[0])
+        if s.source_key.kind == "const":
+            off_h = _rel_host_offsets(snap, s.source_rel)
+            # real lanes only: zero-filled pad lanes would price every
+            # sparse batch's pad by atom 0's row (a hub in age-ordered
+            # id spaces)
+            real = consts if n_real is None else consts[:n_real]
+            keys = np.clip(real[:, s.source_key.index], 0, snap.num_atoms)
+            w = int(np.max(off_h[keys + 1] - off_h[keys], initial=1))
+        elif var_pad_max:
+            # exact-count mode (bench): pay the relation's true max row
+            # width so only the pad_cap itself can truncate
+            w = _rel_max_width(snap, s.source_rel)
+        else:
+            # the estimate is a relation AVERAGE; 4× headroom keeps
+            # ordinary rows in-pad (hubs past it flag trunc honestly)
+            w = 4 * (int(s.width_est) + 1)
+        # the pad is additionally bounded by the candidate-slot budget
+        # (R × pad is the step's peak tensor): a one-row table may pay a
+        # six-figure pad (wide one-shot anchors), a deep table only a
+        # narrow one — constant memory either way
+        pad = _bucket(
+            max(min(w, pad_cap, max(slot_budget // max(R, 1), 8)), 1),
+            minimum=8,
+        )
+        rows_out = min(_bucket(R * pad), row_cap, R * pad)
+        exp_off, exp_flat = _rel_arrays(snap, dev, s.source_rel)
+        filt_sel = []
+        filt_offs = []
+        filt_flats = []
+        for f in s.filters:
+            fo, ff = _rel_arrays(snap, dev, f.rel)
+            filt_sel.append((f.rev, f.key.kind, f.key.index))
+            filt_offs.append(fo)
+            filt_flats.append(ff)
+        n_dist = int(cols.shape[1]) if plan.distinct else 0
+        cols, lanes, valid, counts, step_trunc = join_expand_step(
+            exp_off, exp_flat, cols, lanes, valid, consts_dev,
+            tuple(filt_offs), tuple(filt_flats), dev.type_of,
+            exp_sel=(s.source_key.kind, s.source_key.index),
+            filt_sel=tuple(filt_sel),
+            type_handle=(-1 if s.type_handle is None
+                         else int(s.type_handle)),
+            pad=pad, rows_out=rows_out, n_lanes=K,
+            n_distinct_cols=n_dist,
+            distinct_consts=plan.distinct and A > 0,
+            dedupe=s.dedupe,
+        )
+        trunc = trunc | step_trunc
+    out = JoinExecution(order=plan.order, counts=counts, trunc=trunc)
+    if count_only:
+        return out
+    if top_r > 0:
+        sort_cols = tuple(
+            plan.order.index(v) for v in plan.sig.vars
+        )
+        out.tuples = join_finalize(cols, lanes, valid,
+                                   top_r=top_r, n_lanes=K,
+                                   sort_cols=sort_cols)
+    if full:
+        out.cols, out.lanes, out.valid = cols, lanes, valid
+    return out

@@ -1,8 +1,10 @@
 """Condition → batched serving request: the supported subset.
 
-The serving runtime batches two device shapes — K-seed BFS and K
-conjunctive incident patterns. This module maps the query-condition
-vocabulary onto them:
+The serving runtime batches three device shapes — K-seed BFS, K
+conjunctive incident patterns, and K same-signature conjunctive-pattern
+JOINS (triangles, paths, stars, anchored multi-variable conjunctions —
+the hgjoin subsystem). This module maps the query-condition vocabulary
+onto them:
 
 ==========================================  ================================
 condition                                   request
@@ -12,12 +14,21 @@ condition                                   request
 ``TypedIncident(t, T)``                     ``PatternRequest((t,), T)``
 ``Link(t1, .., tn)``                        ``PatternRequest((t1, .., tn))``
 ``And(Incident.., [AtomType])``             ``PatternRequest(anchors, T)``
+``And(CoIncident.., ..)``                   ``JoinRequest(sig, consts)``
+multi-variable spec (``to_join_request``)   ``JoinRequest(sig, consts)``
 ==========================================  ================================
+
+A single condition whose ``And`` mixes ``CoIncident`` with the incident
+vocabulary becomes a one-variable join; a *spec* — ``{var: condition}``
+with ``query.variables.Var`` cross-references — becomes a multi-variable
+join via :func:`to_join_request` (``extract_pattern`` → signature/
+constant split; see the README "Pattern joins" table for the exact
+vocabulary: CoIncident/Incident/Target/AtomType per variable).
 
 Anything else — value predicates, Or/Not, regex, unbounded BFS — raises a
 typed :class:`~hypergraphdb_tpu.serve.types.Unservable`: the caller runs
 those through ``graph.find_all`` (the planner's host/one-shot device
-paths stay exact and general; the serving subset is deliberately the two
+paths stay exact and general; the serving subset is deliberately the
 batch-native shapes). This is honest scoping, not a fallback-in-disguise:
 a serving tier that silently degraded to one-shot execution would destroy
 the latency contract it exists to provide.
@@ -25,9 +36,12 @@ the latency contract it exists to provide.
 
 from __future__ import annotations
 
+from typing import Mapping
+
 from hypergraphdb_tpu.query import conditions as c
 from hypergraphdb_tpu.serve.types import (
     BFSRequest,
+    JoinRequest,
     PatternRequest,
     Unservable,
 )
@@ -66,7 +80,20 @@ def to_request(graph, condition, *, default_max_hops: int = 2):
         )
     if isinstance(condition, c.Link):
         return PatternRequest(tuple(int(t) for t in condition.targets))
+    if isinstance(condition, c.CoIncident):
+        # distinct=False: a single-variable CONDITION has find_all
+        # semantics — CoIncident is already irreflexive and Incident(a)
+        # legitimately admits a self-targeting a (the same reasoning as
+        # the compiler's try_single_var_join); distinct=True would
+        # silently drop that atom on the serve path only
+        return to_join_request(graph, {"x": condition}, distinct=False)
     if isinstance(condition, c.And):
+        if any(isinstance(cl, c.CoIncident) for cl in condition.clauses):
+            # adjacency conjunctions (common neighbours, anchored
+            # patterns) are the join lane's one-variable shape;
+            # distinct=False per the single-variable contract above
+            return to_join_request(graph, {"x": condition},
+                                   distinct=False)
         anchors: list[int] = []
         type_h = None
         for cl in condition.clauses:
@@ -96,3 +123,25 @@ def to_request(graph, condition, *, default_max_hops: int = 2):
         f"{type(condition).__name__} is outside the batchable subset; "
         "use graph.find_all"
     )
+
+
+def to_join_request(graph, spec: Mapping[str, c.HGQueryCondition],
+                    distinct: bool = True) -> JoinRequest:
+    """Translate a multi-variable condition SPEC (``{var: condition}``,
+    cross-references spelled with ``query.variables.Var``) into a
+    batchable :class:`JoinRequest`, or raise :class:`Unservable`
+    (``join/ir.JoinUnsupported`` is a subclass) naming the clause
+    outside the pattern vocabulary. The signature/constant split means
+    two requests for the same SHAPE — a triangle at atom 17, a triangle
+    at atom 99 — share one batch key and ride one compiled program."""
+    from hypergraphdb_tpu.join.ir import extract_pattern, split_constants
+
+    pattern = extract_pattern(graph, spec, distinct=distinct)
+    if not any(not a.key_is_var for a in pattern.atoms):
+        raise Unservable(
+            "a servable join needs at least one constant anchor; "
+            "unanchored (whole-graph) patterns run through "
+            "ops.join.execute_join's seeds mode instead"
+        )
+    sig, consts = split_constants(pattern)
+    return JoinRequest(sig, consts)

@@ -245,6 +245,53 @@ class TypedIncidencePlan(Plan):
 
 
 @dataclass
+class NeighborsPlan(Plan):
+    """The co-incidence neighbourhood of an atom — every atom sharing at
+    least one link with ``other`` (``conditions.CoIncident``): the union
+    of the target tuples of ``other``'s incidence row, minus ``other``
+    itself. The host leaf the join subsystem's ground truth runs on; the
+    device twin is one row of ``ops/join.neighbor_csr``."""
+
+    other: int
+
+    def run(self, graph):
+        links = graph.get_incidence_set(self.other).array()
+        if not len(links):
+            return _EMPTY
+        snap = graph._snapshot_cache
+        if snap is not None and snap.version == graph._mutations and (
+            links < snap.num_atoms
+        ).all():
+            starts = snap.tgt_offsets[links].astype(np.int64)
+            lens = snap.arity[links].astype(np.int64)
+            idx = np.repeat(starts, lens) + (
+                np.arange(int(lens.sum())) - np.repeat(
+                    np.cumsum(lens) - lens, lens
+                )
+            )
+            out = snap.tgt_flat[idx].astype(np.int64)
+        else:
+            ts: list[int] = []
+            for l in links.tolist():
+                try:
+                    ts.extend(int(t) for t in graph.get_targets(l))
+                except Exception:
+                    continue
+            out = np.asarray(ts, dtype=np.int64)
+        out = np.unique(out)
+        return out[out != int(self.other)]
+
+    def estimate(self, graph):
+        # each incident link contributes (arity - 1) co-targets; the
+        # flat factor keeps the estimate O(1) (no row materialization)
+        # while ordering correctly against sibling incidence estimates
+        return 2.0 * float(graph.store.incidence_count(self.other))
+
+    def describe(self):
+        return f"neighbors({self.other})"
+
+
+@dataclass
 class TargetSetPlan(Plan):
     """The (sorted, deduped) targets of a link."""
 
@@ -1059,6 +1106,8 @@ def _leaf_plan(graph, cond: c.HGQueryCondition) -> Optional[Plan]:
         return ValueSetPlan(vt.to_key(cond.value), cond.op, kind=vt.kind)
     if isinstance(cond, c.Incident):
         return IncidentPlan(int(cond.target))
+    if isinstance(cond, c.CoIncident):
+        return NeighborsPlan(int(cond.other))
     if isinstance(cond, c.PositionedIncident):
         # incidence narrows, position check stays a predicate (cheap)
         return IncidentPlan(int(cond.target))
@@ -1190,6 +1239,38 @@ def _try_value_pushdown(graph, clauses: Sequence[c.HGQueryCondition]
     )
 
 
+def _try_join_pushdown(graph, clauses: Sequence[c.HGQueryCondition]
+                       ) -> Optional[Plan]:
+    """Recognize ``And(CoIncident+, [Incident*], [AtomType])`` — a
+    single-variable conjunctive PATTERN (common neighbours, anchored
+    adjacency) — and hand it to the join planner's cost-based device
+    plan (``join/planner.DeviceJoinPlan``). The join plan carries the
+    classic host translation as its fallback and compares costs at run
+    time, so ``translate()`` stays the one arbiter between the
+    ``IntersectPlan``/``PipePlan`` host family and the multiway-
+    intersection executor. Any clause outside the pattern vocabulary →
+    None (generic planning)."""
+    if not graph.config.query.prefer_device:
+        return None
+    if not any(isinstance(cl, c.CoIncident) for cl in clauses):
+        return None
+    for cl in clauses:
+        if not isinstance(cl, (c.CoIncident, c.Incident, c.AtomType)):
+            return None
+        if isinstance(cl, (c.CoIncident, c.Incident)):
+            ref = cl.other if isinstance(cl, c.CoIncident) else cl.target
+            try:
+                int(ref)
+            except (TypeError, ValueError):
+                return None  # unbound Var: multi-variable specs go
+                             # through join.extract_pattern, not here
+    from hypergraphdb_tpu.join.planner import try_single_var_join
+
+    return try_single_var_join(
+        graph, clauses, fallback=_translate_and(graph, clauses)
+    )
+
+
 def translate(graph, cond: c.HGQueryCondition, parallel_or: bool = False) -> Plan:
     """Translate a simplified DNF condition into a physical plan
     (``QueryCompile.translate`` → ``ToQueryMap`` dispatch)."""
@@ -1200,6 +1281,9 @@ def translate(graph, cond: c.HGQueryCondition, parallel_or: bool = False) -> Pla
         )
     if isinstance(cond, c.And):
         pushed = _try_value_pushdown(graph, cond.clauses)
+        if pushed is not None:
+            return pushed
+        pushed = _try_join_pushdown(graph, cond.clauses)
         if pushed is not None:
             return pushed
         return _translate_and(graph, cond.clauses)

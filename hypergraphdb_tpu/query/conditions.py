@@ -247,6 +247,32 @@ class Incident(HGQueryCondition):
 
 
 @dataclass(frozen=True)
+class CoIncident(HGQueryCondition):
+    """Atoms sharing at least one link with ``other`` — the binary
+    adjacency view of the hypergraph (two atoms are co-incident when some
+    link's target tuple contains both). This is the edge relation of
+    conjunctive PATTERN queries (triangles, paths, stars — ``join/``):
+    a pattern edge between two variables lowers to one CoIncident clause.
+
+    By definition an atom is never co-incident with itself (a link
+    containing ``a`` twice does not make ``a`` its own neighbour) — the
+    relation is irreflexive and symmetric. ``other`` may be a query
+    ``Var`` inside a pattern spec; as a standalone condition it must be
+    a concrete handle."""
+
+    other: HGHandle
+
+    def satisfies(self, graph, h):
+        if int(h) == int(self.other):
+            return False
+        mine = graph.get_incidence_set(h)
+        theirs = graph.get_incidence_set(self.other)
+        # probe the smaller incidence set against the larger
+        a, b = (mine, theirs) if len(mine) <= len(theirs) else (theirs, mine)
+        return any(int(l) in b for l in a)
+
+
+@dataclass(frozen=True)
 class TypedIncident(HGQueryCondition):
     """Links of a given TYPE pointing at ``target`` — the first-class form
     of the reference's bdb-native typed-incidence query

@@ -89,6 +89,12 @@ def incident(target) -> c.Incident:
     return c.Incident(_h(target))
 
 
+def co_incident(other) -> c.CoIncident:
+    """Atoms sharing at least one link with ``other`` — the pattern-edge
+    relation of conjunctive joins (``join/``); irreflexive."""
+    return c.CoIncident(_h(other))
+
+
 def typed_incident(target, t) -> c.TypedIncident:
     """Links of type ``t`` incident to ``target`` (the bdb-native
     typed-incidence query as a first-class condition)."""

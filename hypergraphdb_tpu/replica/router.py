@@ -5,9 +5,14 @@ Placement policy (read spreading):
 1. refresh each replica's health (a background poll, or lazily when the
    poll is off) — ``/healthz``-shaped probes yield ``healthy`` plus the
    advertised ``replication_lag``;
-2. order healthy replicas by advertised lag and round-robin within the
-   least-lagged group, so equally-fresh replicas share load instead of
-   the first one eating it all;
+2. order healthy replicas by advertised lag FIRST (freshness is the
+   caller-visible contract), then break lag ties by a load score read
+   from the same ``/healthz`` body — admission ``queue_depth`` plus a
+   weighted penalty for a non-closed serve breaker (``breaker_worst``)
+   — and round-robin within the equally-lagged-and-loaded group, so
+   equally-fresh replicas share load instead of the first one eating it
+   all while a deep-queued or degraded replica sheds placement to an
+   idle sibling (ROADMAP 3c);
 3. skip replicas whose per-replica circuit breaker gate is OPEN — a
    dead replica costs ``breaker_threshold`` failed probes ONCE, then
    its load re-routes without paying a timeout per request until the
@@ -245,6 +250,11 @@ class RouterConfig:
     poll_interval_s: float = 0.25
     #: distinct replicas tried before falling back to the primary
     max_attempts: int = 2
+    #: load-score penalty per ``breaker_worst`` code unit (0 closed /
+    #: 1 half-open / 2 open): a replica whose own serve breaker is
+    #: degraded loses lag-tied placement to ``weight×code`` queued
+    #: requests' worth of load
+    load_breaker_weight: float = 16.0
     submit_timeout_s: float = 30.0
     clock: Optional[Callable[[], float]] = None
 
@@ -268,7 +278,8 @@ class FrontDoor:
         self.metrics = Metrics()
         self._lock = threading.Lock()
         #: backend id → (healthy, advertised lag, snapshot time)
-        self._health: dict[str, tuple[bool, int, float]] = {}
+        #: backend id → (healthy, lag, load score, snapshot time)
+        self._health: dict[str, tuple[bool, int, float, float]] = {}
         self._rr = 0
         self._poll_stop = threading.Event()
         self._poll_thread: Optional[threading.Thread] = None
@@ -326,15 +337,21 @@ class FrontDoor:
             return  # a sweep is in flight; place with the snapshot we have
         try:
             now = self.clock()
-            results: dict[str, tuple[bool, int]] = {}
+            results: dict[str, tuple[bool, int, float]] = {}
+            w = self.config.load_breaker_weight
 
             def probe(be):
                 try:
                     healthy, payload = be.health()
                     lag = int(payload.get("replication_lag", 0))
+                    # the load-aware tiebreak inputs (ROADMAP 3c), from
+                    # the SAME body operators scrape: queued admissions
+                    # + a penalty while the serve breaker is not closed
+                    load = (float(payload.get("queue_depth", 0))
+                            + w * float(payload.get("breaker_worst", 0)))
                 except Exception:  # noqa: BLE001 - unreachable == unhealthy
-                    healthy, lag = False, 0
-                results[be.id] = (healthy, lag)
+                    healthy, lag, load = False, 0, 0.0
+                results[be.id] = (healthy, lag, load)
 
             if len(self.replicas) <= 1:
                 for be in self.replicas:
@@ -351,10 +368,10 @@ class FrontDoor:
                 for t in threads:
                     t.join()
             for be in self.replicas:
-                healthy, lag = results.get(be.id, (False, 0))
+                healthy, lag, load = results.get(be.id, (False, 0, 0.0))
                 with self._lock:
                     prev = self._health.get(be.id)
-                    self._health[be.id] = (healthy, lag, now)
+                    self._health[be.id] = (healthy, lag, load, now)
                 if (healthy and prev is not None and not prev[0]
                         and self.breaker.state_of(be.id) != CLOSED):
                     self.breaker.reset(be.id)
@@ -374,12 +391,14 @@ class FrontDoor:
                 )
 
     def _placement(self) -> list:
-        """Healthy replicas, least-lagged first, round-robin within the
-        least-lagged group (the spread), breaker-OPEN gates skipped."""
+        """Healthy replicas, least-lagged first, load-score tiebreak
+        within a lag tie (queue depth + breaker penalty from
+        ``/healthz``), round-robin within the equal-(lag, load) head
+        group (the spread), breaker-OPEN gates skipped."""
         now = self.clock()
         with self._lock:
             stale = any(
-                self._health.get(be.id, (False, 0, -1e9))[2]
+                self._health.get(be.id, (False, 0, 0.0, -1e9))[3]
                 < now - self.config.health_refresh_s
                 for be in self.replicas
             )
@@ -387,7 +406,7 @@ class FrontDoor:
             self.refresh_health()
         with self._lock:
             known = {
-                be.id: self._health.get(be.id, (False, 0, 0.0))
+                be.id: self._health.get(be.id, (False, 0, 0.0, 0.0))
                 for be in self.replicas
             }
             self._rr += 1
@@ -395,10 +414,18 @@ class FrontDoor:
         healthy = [be for be in self.replicas if known[be.id][0]]
         if not healthy:
             return []
-        healthy.sort(key=lambda be: known[be.id][1])
-        min_lag = known[healthy[0].id][1]
-        grp = [be for be in healthy if known[be.id][1] == min_lag]
-        rest = [be for be in healthy if known[be.id][1] != min_lag]
+
+        def score(be):
+            # load is QUANTIZED for grouping: exact float equality would
+            # let one queued request's jitter collapse the round-robin
+            # spread onto a single replica per poll window (herding) —
+            # a few requests of depth difference is noise, not signal
+            return (known[be.id][1], int(known[be.id][2]) // 8)
+
+        healthy.sort(key=score)
+        best = score(healthy[0])
+        grp = [be for be in healthy if score(be) == best]
+        rest = [be for be in healthy if score(be) != best]
         k = rr % len(grp)
         ordered = grp[k:] + grp[:k] + rest
         # peek, don't allow: placement ranks candidates the request may
@@ -473,13 +500,15 @@ class FrontDoor:
             backends = {}
             any_replica = False
             for be in self.replicas:
-                healthy, lag, t = snap.get(be.id, (False, 0, 0.0))
+                healthy, lag, load, t = snap.get(be.id,
+                                                 (False, 0, 0.0, 0.0))
                 state = self.breaker.state_of(be.id)
                 if healthy and state != OPEN:
                     any_replica = True
                 backends[be.id] = {
                     "healthy": healthy,
                     "replication_lag": lag,
+                    "load_score": load,
                     "breaker": state,
                 }
             primary_ok = True

@@ -43,6 +43,8 @@ from hypergraphdb_tpu.serve.types import (
     BFSRequest,
     Clock,
     DeadlineExceeded,
+    JoinRequest,
+    JoinResult,
     PatternRequest,
     QueueFull,
     RuntimeClosed,
@@ -67,6 +69,8 @@ __all__ = [
     "Clock",
     "DeadlineExceeded",
     "DeviceExecutor",
+    "JoinRequest",
+    "JoinResult",
     "MicroBatch",
     "PatternRequest",
     "QueueFull",

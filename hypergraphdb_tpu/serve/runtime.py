@@ -67,6 +67,8 @@ from hypergraphdb_tpu.serve.stats import ServeStats
 from hypergraphdb_tpu.serve.types import (
     BFSRequest,
     Clock,
+    JoinRequest,
+    JoinResult,
     PatternRequest,
     ServeResult,
     Ticket,
@@ -113,6 +115,15 @@ class ServeConfig:
     aot_cache_dir: Optional[str] = None     # AOT compile cache; None → env
     prewarm_aot: bool = True                # compile K buckets at startup
     prewarm_hops: Optional[tuple] = None    # hops to warm; None → (default,)
+    #: pattern anchor arities P to prewarm per bucket (ROADMAP 4d) —
+    #: P is a device shape dim, one compiled program each; () disables
+    prewarm_pattern_arities: tuple = (1, 2)
+    #: build + upload the co-incidence CSR at startup (deployments that
+    #: serve joins): the build is O(Σ arity²) — done lazily it would
+    #: land on the dispatch thread inside the first join batch's
+    #: deadline window after every compaction. Opt-in: BFS/pattern-only
+    #: tiers should not pay it.
+    prewarm_join_nbr: bool = False
 
 
 @dataclass
@@ -133,6 +144,10 @@ class LaunchedBatch:
     #: the batch's device-execution attribution (ServeConfig.device_timing)
     t_device: object = None
     _t_launch: object = None
+    #: join batches: the ``join/planner.JoinPlan`` the lanes executed —
+    #: collect needs its column order to permute tuples back into the
+    #: request's variable order
+    join_plan: object = None
     #: double-buffer slot of this dispatch (dispatch sequence mod 2) —
     #: rides the ``device`` span and the profiler annotation so device
     #: time is attributable per pipeline slot
@@ -168,6 +183,8 @@ class DeviceExecutor:
         #: to this graph generation (quiet rebuild on mismatch).
         self.aot = self._open_aot_cache()
         self._aot_failed = False
+        #: (epoch, new_atoms scanned, verdict) — _join_mem_dirty's memo
+        self._join_dirty_memo: tuple = (-1, 0, False)
 
     def _open_aot_cache(self):
         import os
@@ -271,6 +288,22 @@ class DeviceExecutor:
                                      kw["n_atoms"], kw["overlay"],
                                      **statics)
 
+    def _serve_pattern(self, view, ell, anchors_dev, type_vec_dev):
+        """One pattern batch dispatch through the AOT cache when
+        configured (the prewarmed (bucket, P) executables — ROADMAP 4d:
+        join/pattern traffic in a fresh process must not pay
+        dispatch-thread compiles); plain jit otherwise."""
+        from hypergraphdb_tpu.ops.serving import pattern_serve_batch
+
+        args = (view.device, ell, anchors_dev, type_vec_dev)
+        statics = {"pad_len": self.config.pattern_pad,
+                   "top_r": self.config.top_r}
+        compiled = self._aot_dispatch("ops.serving.pattern_serve_batch",
+                                      pattern_serve_batch, args, statics)
+        if compiled is not None:
+            return compiled(*args)
+        return pattern_serve_batch(*args, **statics)
+
     def prewarm(self, buckets, max_hops: Optional[int] = None) -> int:
         """Compile (or load from the AOT cache) the BFS serving
         executables for every bucket width against the current pinned
@@ -291,6 +324,16 @@ class DeviceExecutor:
             bfs_serve_batch_fused,
         )
 
+        if self.config.prewarm_join_nbr:
+            # the join lane's co-incidence CSR: built + uploaded at
+            # deploy time (in-budget snapshots only — over budget it
+            # raises and the serve path declines to host anyway)
+            from hypergraphdb_tpu.ops.join import neighbor_csr_device
+
+            try:
+                neighbor_csr_device(self.mgr.base)
+            except Exception:  # noqa: BLE001 - never block startup
+                pass
         if self.aot is None and not (self.config.use_pallas_bfs
                                      and _pbfs.pallas_bfs_ok()):
             # nothing to warm: no cache to load, and the fused path (the
@@ -309,6 +352,16 @@ class DeviceExecutor:
                                     sync_delta=True)
         n = view.base.num_atoms
         top_r = min(self.config.top_r + 1, n + 1)
+        # the pattern lane's ELL targets + executables (ROADMAP 4d):
+        # without this, join/pattern traffic in a fresh process pays its
+        # (bucket, P) compiles on the dispatch thread at first flush
+        arities = (tuple(self.config.prewarm_pattern_arities or ())
+                   if self.aot is not None else ())
+        ell = None
+        if arities:
+            from hypergraphdb_tpu.ops.setops import ell_targets
+
+            ell = ell_targets(view.base)
         warm = 0
         for b in buckets:
             seeds = jnp.full((int(b),), n, dtype=jnp.int32)
@@ -316,6 +369,26 @@ class DeviceExecutor:
             fkw = self._fused_bfs_kwargs(view, int(b))
             if self.aot is None:
                 continue
+            if ell is not None:
+                from hypergraphdb_tpu.ops.serving import (
+                    NO_TYPE,
+                    pattern_serve_batch,
+                )
+
+                tvec = jnp.full((int(b),), NO_TYPE, dtype=jnp.int32)
+                for P in arities:
+                    anchors = jnp.full((int(b), int(P)), n,
+                                       dtype=jnp.int32)
+                    try:
+                        warm += self.aot.warm(
+                            "ops.serving.pattern_serve_batch",
+                            pattern_serve_batch,
+                            (view.device, ell, anchors, tvec),
+                            {"pad_len": self.config.pattern_pad,
+                             "top_r": self.config.top_r},
+                        )
+                    except Exception:  # noqa: BLE001 - never block startup
+                        continue
             for hops in hops_list:
                 # independent try blocks: a bucket whose unfused lowering
                 # fails must not forfeit the fused warm (or vice versa) —
@@ -426,8 +499,7 @@ class DeviceExecutor:
                             view, jnp.asarray(seeds), max_hops, top_r,
                         )
         elif kind == "pattern":
-            from hypergraphdb_tpu.ops.serving import NO_TYPE, \
-                pattern_serve_batch
+            from hypergraphdb_tpu.ops.serving import NO_TYPE
             from hypergraphdb_tpu.ops.setops import ell_targets
 
             P = batch.key[1]
@@ -456,11 +528,49 @@ class DeviceExecutor:
             if out.lane_tickets:
                 out.cand_records = self._capture_candidates(view)
                 with self._dispatch_cm("pattern", batch.bucket, P):
-                    out.dev_out = pattern_serve_batch(
-                        view.device, ell, jnp.asarray(anchors),
+                    out.dev_out = self._serve_pattern(
+                        view, ell, jnp.asarray(anchors),
                         jnp.asarray(type_vec),
-                        self.config.pattern_pad, self.config.top_r,
                     )
+        elif kind == "join":
+            sig = batch.key[1]
+            n = view.base.num_atoms
+            # a memtable LINK can mint bindings anywhere in the tuple
+            # space — not correctable against a compact device prefix.
+            # Exact-at-collect discipline, join edition: the whole batch
+            # takes the exact host path while the memtable is dirty
+            # (bounded by the next compaction), same honesty as the
+            # pattern lane's truncated-plus-dirty case.
+            plan = (None if self._join_mem_dirty(view)
+                    else self._join_plan(sig, batch.tickets[0].request,
+                                         view.base))
+            if plan is None:
+                out.host_tickets = list(batch.tickets)
+            else:
+                consts = np.zeros((batch.bucket, sig.n_consts),
+                                  dtype=np.int32)
+                lane = 0
+                for t in batch.tickets:
+                    cv = np.asarray(t.request.consts, dtype=np.int64)
+                    if len(cv) and (cv.min() < 0 or cv.max() >= n):
+                        out.host_tickets.append(t)  # beyond the base
+                        continue
+                    consts[lane] = cv
+                    out.lane_tickets.append((lane, t))
+                    lane += 1
+                if out.lane_tickets:
+                    from hypergraphdb_tpu.ops.join import execute_join
+
+                    out.join_plan = plan
+                    with self._dispatch_cm("join", batch.bucket,
+                                           len(plan.steps)):
+                        with self.tracer.span("join.execute",
+                                              sig=str(sig.atoms)):
+                            ex = execute_join(
+                                view.base, plan, consts,
+                                top_r=self.config.top_r, n_real=lane,
+                            )
+                    out.dev_out = (ex.counts, ex.trunc, ex.tuples)
         else:  # pragma: no cover - batch keys come from our own requests
             raise Unservable(f"unknown batch kind {kind!r}")
         if out.dev_out is not None:
@@ -510,8 +620,10 @@ class DeviceExecutor:
                 _, t_ready = block_timed(launched.dev_out,
                                          self.tracer.clock)
                 launched.t_device = (launched._t_launch, t_ready)
-            counts, first_r = (np.asarray(x) for x in launched.dev_out)
             kind = launched.batch.key[0]
+            if kind == "join":
+                return self._collect_join(launched)
+            counts, first_r = (np.asarray(x) for x in launched.dev_out)
             if kind == "pattern":
                 # batch-invariant memtable views, hoisted off the
                 # per-lane path (a 1024-lane batch over a deep memtable
@@ -534,6 +646,38 @@ class DeviceExecutor:
         out.extend(self._serve_host(launched.host_tickets, view.epoch))
         return out
 
+    def _collect_join(self, launched: LaunchedBatch) -> list:
+        """Join-batch result assembly: download the compact per-lane
+        windows, permute tuple columns from the plan's elimination order
+        back to the request's variable order, and re-serve any
+        truncation-flagged lane exactly on host (a flagged count is a
+        LOWER bound — honest, but not what a caller asked for)."""
+        view = launched.view
+        sig = launched.batch.key[1]
+        plan = launched.join_plan
+        counts, trunc, tuples = (np.asarray(x) for x in launched.dev_out)
+        perm = [plan.order.index(v) for v in sig.vars]
+        out = []
+        for lane, ticket in launched.lane_tickets:
+            try:
+                if trunc[lane]:
+                    self.stats.record_host_fallback()
+                    out.append((ticket,
+                                self._host_join(ticket.request,
+                                                view.epoch)))
+                    continue
+                rows = tuples[lane]
+                rows = rows[rows[:, 0] >= 0][:, perm].astype(np.int64)
+                count = int(counts[lane])
+                out.append((ticket, JoinResult(
+                    "join", count, rows, sig.vars,
+                    count > len(rows), view.epoch,
+                )))
+            except Exception as e:  # surface, don't kill the batch
+                out.append((ticket, e))
+        out.extend(self._serve_host(launched.host_tickets, view.epoch))
+        return out
+
     def collect_host(self, launched: LaunchedBatch) -> list:
         """Exact host re-serve of the WHOLE batch — the collect-failure
         recovery path: the device handles are poisoned but the pinned
@@ -552,9 +696,13 @@ class DeviceExecutor:
         for ticket in tickets:
             self.stats.record_host_fallback()
             try:
-                if ticket.request.kind == "bfs":
+                kind = ticket.request.kind
+                if kind == "bfs":
                     out.append((ticket, self._host_bfs(ticket.request,
                                                        epoch)))
+                elif kind == "join":
+                    out.append((ticket, self._host_join(ticket.request,
+                                                        epoch)))
                 else:
                     out.append((ticket, self._host_pattern(ticket.request,
                                                            epoch)))
@@ -610,6 +758,84 @@ class DeviceExecutor:
             return ServeResult("pattern", count, matches[:top_r], True,
                                view.epoch)
         return ServeResult("pattern", count, matches, False, view.epoch)
+
+    # -- join lane helpers ----------------------------------------------------
+    def _join_mem_dirty(self, view) -> bool:
+        """Does the memtable hold anything a join answer could see?
+        Tombstones/revalues can remove a result's only witness; a new
+        LINK can mint bindings anywhere in the tuple space. Fresh NODES
+        alone cannot (nothing in the base points at them), so pure-node
+        ingest keeps the device lane open.
+
+        Memoized per epoch with incremental suffix scans — ``new_atoms``
+        only grows within an epoch and a link verdict is sticky, so a
+        bulk pure-node ingest costs each batch only the atoms that
+        arrived since the last one, not an O(memtable) store walk on the
+        dispatch thread."""
+        if view.dead or view.revalued:
+            return True
+        epoch, n_seen, dirty = self._join_dirty_memo
+        if epoch != view.epoch:
+            n_seen, dirty = 0, False
+        if not dirty:
+            g = self.graph
+            for h in view.new_atoms[n_seen:]:
+                try:
+                    if g.get_targets(h):
+                        dirty = True
+                        break
+                except Exception:  # noqa: BLE001 - racing delete
+                    continue
+        self._join_dirty_memo = (view.epoch, len(view.new_atoms), dirty)
+        return dirty
+
+    def _join_plan(self, sig, req0: JoinRequest, base):
+        """The signature's compiled decomposition, planned once per
+        (signature, base snapshot): the plan's statics ARE the program
+        identity, so a cache hit here is a jit cache hit downstream. The
+        first request's constants seed the cardinality estimates; the
+        structure stays valid for every constant vector of the
+        signature. None → the planner declined (host path)."""
+        cache = getattr(base, "_join_plan_cache", None)
+        if cache is None:
+            cache = {}
+            object.__setattr__(base, "_join_plan_cache", cache)
+        if sig not in cache:
+            from hypergraphdb_tpu.join.ir import JoinUnsupported
+            from hypergraphdb_tpu.join.planner import plan_join
+            from hypergraphdb_tpu.ops.join import (
+                NBR_MAX_PAIRS,
+                nbr_pair_count,
+            )
+
+            try:
+                if any(a[0] == "co" for a in sig.atoms) and \
+                        nbr_pair_count(base) > NBR_MAX_PAIRS:
+                    # the co-incidence CSR would be gigabytes — decline
+                    # BEFORE launch ever asks execute_join to build it
+                    # on the dispatch thread
+                    cache[sig] = None
+                else:
+                    with self.tracer.span("join.plan",
+                                          sig=str(sig.atoms)):
+                        cache[sig] = plan_join(
+                            base, sig.bind(req0.consts), sig,
+                            req0.consts,
+                        )
+            except JoinUnsupported:
+                cache[sig] = None
+        return cache[sig]
+
+    def _host_join(self, req: JoinRequest, epoch: int) -> JoinResult:
+        from hypergraphdb_tpu.join.host import host_join
+
+        rows = host_join(self.graph, req.sig.bind(req.consts))
+        V = len(req.sig.vars)
+        arr = (np.asarray(rows, dtype=np.int64) if rows
+               else np.empty((0, V), dtype=np.int64))
+        top_r = self.config.top_r
+        return JoinResult("join", len(arr), arr[:top_r], req.sig.vars,
+                          len(arr) > top_r, epoch, served_by="host")
 
     # -- exact host fallbacks -------------------------------------------------
     def _host_bfs(self, req: BFSRequest, epoch: int) -> ServeResult:
@@ -799,6 +1025,21 @@ class ServeRuntime:
                            else int(type_handle)),
             deadline_s, priority,
         )
+
+    def submit_join(self, spec, distinct: bool = True,
+                    deadline_s: Optional[float] = None,
+                    priority: int = 0) -> Future:
+        """Admit a conjunctive-pattern JOIN: ``spec`` is either a
+        prebuilt :class:`~.types.JoinRequest` or a ``{var: condition}``
+        mapping with ``query.variables.Var`` cross-references
+        (``query/bridge.to_join_request`` does the extraction). Raises
+        :class:`~.types.Unservable` for specs outside the pattern
+        vocabulary. Resolves to a :class:`~.types.JoinResult`."""
+        if not isinstance(spec, JoinRequest):
+            from hypergraphdb_tpu.query.bridge import to_join_request
+
+            spec = to_join_request(self.graph, spec, distinct=distinct)
+        return self.submit(spec, deadline_s, priority)
 
     def submit_query(self, condition,
                      deadline_s: Optional[float] = None,

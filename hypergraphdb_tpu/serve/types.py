@@ -108,6 +108,43 @@ class PatternRequest:
         return ("pattern", len(self.anchors))
 
 
+@dataclass(frozen=True)
+class JoinRequest:
+    """A conjunctive-pattern join: the structural half is a hashable
+    ``join/ir.PatternSignature`` (``sig``) and the per-request half the
+    constant vector (``consts``) — the split_constants factoring, which
+    is exactly the batch-key/payload discipline: requests sharing one
+    signature ride one compiled multiway-intersection program
+    (``ops/join.execute_join``) as K lanes of one batch, however
+    different their anchor atoms.
+
+    Build via ``query.bridge.to_join_request`` (condition-spec front
+    door) or directly from ``join.split_constants``."""
+
+    sig: object                 # join/ir.PatternSignature (kept untyped:
+    consts: tuple[int, ...]     # this module stays jax/join-import-free)
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "consts", tuple(int(x) for x in self.consts)
+        )
+        n = getattr(self.sig, "n_consts", None)
+        if n is not None and n != len(self.consts):
+            raise Unservable(
+                f"signature expects {n} constants, got {len(self.consts)}"
+            )
+
+    @property
+    def kind(self) -> str:
+        return "join"
+
+    @property
+    def batch_key(self) -> tuple:
+        # the signature IS the compiled program's identity: elimination
+        # order, step statics, filter layout all derive from it
+        return ("join", self.sig)
+
+
 # ---------------------------------------------------------------- results
 
 
@@ -126,6 +163,25 @@ class ServeResult:                 # raise on >1-element comparisons
     kind: str               # "bfs" | "pattern"
     count: int
     matches: np.ndarray     # int64, ascending
+    truncated: bool
+    epoch: int
+    served_by: str = "device"
+
+
+@dataclass(frozen=True, eq=False)
+class JoinResult:
+    """One join request's answer: the first ``top_r`` binding tuples.
+
+    ``tuples`` is ``(n, V)`` int64, columns in the REQUEST's variable
+    order (``vars``), rows ascending lexicographically; ``truncated``
+    flags a binding set larger than the compact window (``count`` stays
+    exact — truncation-honest device lanes are re-served on the exact
+    host path before they get here, see ``DeviceExecutor.collect``)."""
+
+    kind: str               # always "join"
+    count: int
+    tuples: np.ndarray      # (n, V) int64, lexicographic ascending
+    vars: tuple             # column names, request order
     truncated: bool
     epoch: int
     served_by: str = "device"

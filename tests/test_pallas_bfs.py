@@ -456,23 +456,31 @@ def test_serve_runtime_warm_start_skips_compiles(graph, tmp_path):
 
     make_random_hypergraph(graph, n_nodes=60, n_links=120, seed=5)
     cfg = dict(buckets=(4, 8), max_linger_s=0.001, top_r=8,
-               aot_cache_dir=str(tmp_path), prewarm_hops=(2, 3))
+               aot_cache_dir=str(tmp_path), prewarm_hops=(2, 3),
+               prewarm_pattern_arities=(1, 2))
     rt1 = ServeRuntime(graph, ServeConfig(**cfg))
     r1 = rt1.submit_bfs(3, max_hops=2).result(timeout=60)
+    p1 = rt1.submit_pattern([3]).result(timeout=60)
     cold = rt1.stats_snapshot()["aot"]
     rt1.close()
-    assert cold["misses"] >= 4 and cold["puts"] >= 4  # 2 buckets x 2 hops
+    # 2 buckets x (2 hops + 2 pattern arities)
+    assert cold["misses"] >= 8 and cold["puts"] >= 8
 
     rt2 = ServeRuntime(graph, ServeConfig(**cfg))
     r2 = rt2.submit_bfs(3, max_hops=2).result(timeout=60)
     # a NON-default hops the config declared must be warm too — the
     # dispatch thread never compiles for any (bucket, hops) in the plan
     rt2.submit_bfs(3, max_hops=3).result(timeout=60)
+    # the pattern lane (ROADMAP 4d): first dispatch of BOTH warmed
+    # anchor arities must be compile-free too
+    p2 = rt2.submit_pattern([3]).result(timeout=60)
+    rt2.submit_pattern([3, 5]).result(timeout=60)
     warm = rt2.stats_snapshot()["aot"]
     rt2.close()
     assert warm["misses"] == 0, warm
-    assert warm["disk_hits"] >= 4 and warm["hits"] >= 4, warm
+    assert warm["disk_hits"] >= 8 and warm["hits"] >= 8, warm
     assert r1.count == r2.count and np.array_equal(r1.matches, r2.matches)
+    assert p1.count == p2.count and np.array_equal(p1.matches, p2.matches)
 
 
 def test_aot_dispatch_results_match_plain_jit(graph, tmp_path):

@@ -61,10 +61,13 @@ class FakeBackend:
     """Scripted backend: submit returns a tagged dict or raises what the
     script says; health is injectable."""
 
-    def __init__(self, backend_id, lag=0, healthy=True):
+    def __init__(self, backend_id, lag=0, healthy=True, queue_depth=0,
+                 breaker_worst=0):
         self.id = backend_id
         self.lag = lag
         self.healthy = healthy
+        self.queue_depth = queue_depth
+        self.breaker_worst = breaker_worst
         self.fail_with = None
         self.calls = 0
 
@@ -77,7 +80,9 @@ class FakeBackend:
     def health(self):
         if not self.healthy:
             raise ConnectionError("down")
-        return True, {"replication_lag": self.lag}
+        return True, {"replication_lag": self.lag,
+                      "queue_depth": self.queue_depth,
+                      "breaker_worst": self.breaker_worst}
 
 
 def make_router(replicas, **cfg_kw):
@@ -101,6 +106,36 @@ def test_placement_prefers_lower_lag():
     fd, _ = make_router([stale, fresh])
     for _ in range(4):
         assert fd.submit({"kind": "x"})["routed_to"] == "fresh"
+
+
+def test_placement_load_tiebreak_at_equal_lag():
+    """ROADMAP 3c: two equally-lagged replicas, one with a deep
+    admission queue — the idle one wins every placement; load never
+    overrides a LAG difference."""
+    idle = FakeBackend("idle", lag=0, queue_depth=0)
+    busy = FakeBackend("busy", lag=0, queue_depth=500)
+    fd, _ = make_router([busy, idle])
+    for _ in range(6):
+        assert fd.submit({"kind": "x"})["routed_to"] == "idle"
+    # lag-first stays the primary key: a fresher-but-busy replica still
+    # beats a laggier idle one
+    busy.lag, idle.lag = 0, 10
+    fd.refresh_health()
+    assert fd.submit({"kind": "x"})["routed_to"] == "busy"
+
+
+def test_placement_breaker_penalty_sheds_degraded_replica():
+    """A replica whose OWN serve breaker reports non-closed loses an
+    equal-lag, equal-queue tie to a clean sibling."""
+    clean = FakeBackend("clean", lag=0)
+    degraded = FakeBackend("degraded", lag=0, breaker_worst=2)
+    fd, _ = make_router([degraded, clean])
+    for _ in range(6):
+        assert fd.submit({"kind": "x"})["routed_to"] == "clean"
+    # the load score rides the router's own health payload
+    _, payload = fd.health_probe()()
+    assert payload["backends"]["degraded"]["load_score"] > \
+        payload["backends"]["clean"]["load_score"]
 
 
 def test_dead_replica_trips_breaker_and_reroutes_with_zero_errors():

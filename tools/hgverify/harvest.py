@@ -30,6 +30,7 @@ PRODUCT_MODULES = (
     "hypergraphdb_tpu.ops.pallas_bfs",
     "hypergraphdb_tpu.ops.incremental",
     "hypergraphdb_tpu.ops.serving",
+    "hypergraphdb_tpu.ops.join",
     "hypergraphdb_tpu.parallel.sharded",
 )
 

@@ -1,0 +1,63 @@
+#!/usr/bin/env bash
+# hgjoin gate: the conjunctive-pattern-join suite — the differential
+# suite (device executor == host find_all truth across triangle / path /
+# star / typed / link-variable shapes, truncation honesty, pad-lane
+# garbage, seeds-mode global counting, mid-ingest memtable visibility
+# through the serving lane), the query suites that own the compiler
+# pushdown + bridge, then the c7 pattern-join bench in SMOKE mode
+# (small graph, few anchors) proving the whole device pipeline runs
+# green and records its device-vs-host ratio + differential verdict to
+# BENCH_C7_smoke.json.
+#
+# Sits beside lint.sh (AST hazards), verify.sh (jaxpr ground truth +
+# cost budgets — the two ops/join entries gate there), chaos.sh,
+# obs.sh, perf.sh, and replica.sh: this one gates the join subsystem.
+#
+# Usage: tools/join.sh [extra pytest args]
+#   tools/join.sh -k serve            # one area, fast local run
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
+    tests/test_join.py \
+    tests/test_query.py \
+    tests/test_query_extensions.py \
+    tests/test_serve_differential.py \
+    -q -m 'not slow' -p no:cacheprovider "$@"
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "tools/join.sh: join tests failed (exit $rc)" >&2
+    exit "$rc"
+fi
+
+# -- c7 smoke: the bench pipeline end to end at toy scale --------------------
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+BENCH_ENTITIES="${BENCH_ENTITIES:-30000}" \
+BENCH_LINKS="${BENCH_LINKS:-120000}" \
+BENCH_SEEDS="${BENCH_SEEDS:-64}" \
+BENCH_C7_LANES="${BENCH_C7_LANES:-16}" \
+BENCH_C7_REPS="${BENCH_C7_REPS:-2}" \
+BENCH_C7_BASELINE_N="${BENCH_C7_BASELINE_N:-32}" \
+BENCH_C7_TAG="${BENCH_C7_TAG:-smoke}" \
+python - <<'PY'
+import json
+
+import bench
+
+r = bench._config_c7()
+for shape in ("triangle", "path2"):
+    assert r[shape]["differential_equal"], (shape, r[shape])
+    assert r[shape]["vs_host"] is not None, (shape, r[shape])
+print("tools/join.sh c7 smoke:", json.dumps({
+    s: {k: r[s][k] for k in ("vs_host", "bindings_total", "n_truncated",
+                             "differential_equal")}
+    for s in ("triangle", "path2")
+}))
+PY
+smoke_rc=$?
+if [ "$smoke_rc" -ne 0 ]; then
+    echo "tools/join.sh: c7 smoke failed (exit $smoke_rc)" >&2
+    exit "$smoke_rc"
+fi
+echo "tools/join.sh: join gate green"
+exit 0
