@@ -1256,6 +1256,160 @@ def bench_c7(snap, info):
     return result
 
 
+def bench_c8():
+    """c8_sharded: multi-chip sharded serving — per-device-count serve
+    throughput over the SAME graph, batched BFS buckets routed through
+    the mesh-sharded executor (``serve/sharded`` + ``ops/sharded_serving``)
+    at 1/2/4/8 devices vs the single-chip ``DeviceExecutor`` path, plus
+    a differential verdict (sharded results == single-chip results for a
+    probe set). Closed-loop flood (submit everything, wait): the number
+    under test is sustained batched throughput, and the scaling curve is
+    what the real-TPU sweep validates (CPU devices share host cores, so
+    virtual-mesh ratios UNDERSTATE real chips).
+
+    Env knobs: BENCH_C8_ENTITIES / _LINKS (graph scale; the 10M shape on
+    real hardware), BENCH_C8_REQUESTS, BENCH_C8_HOPS, BENCH_C8_DEVICES
+    (comma list, default "1,2,4,8" clipped to visible), BENCH_C8_TAG."""
+    import jax
+
+    from hypergraphdb_tpu import HyperGraph
+    from hypergraphdb_tpu.serve import ServeConfig, ServeRuntime
+
+    _telemetry_begin()
+    n_entities = int(os.environ.get("BENCH_C8_ENTITIES", 200_000))
+    n_links = int(os.environ.get("BENCH_C8_LINKS", 400_000))
+    n_requests = int(os.environ.get("BENCH_C8_REQUESTS", 2048))
+    hops = int(os.environ.get("BENCH_C8_HOPS", 2))
+    n_vis = len(jax.devices())
+    asked = [int(x) for x in os.environ.get(
+        "BENCH_C8_DEVICES", "1,2,4,8").split(",")]
+    # clamp (never silently drop) over-sized requests to the visible
+    # device count, dedupe ascending; an all-oversized list degrades to
+    # the honest [full mesh] instead of crashing after the single-chip
+    # measurement already ran
+    counts = sorted({min(x, n_vis) for x in asked if x >= 1}) or [n_vis]
+    if counts != sorted(set(asked)):
+        import sys
+
+        print(f"bench c8: device counts {asked} clamped to {counts} "
+              f"({n_vis} visible)", file=sys.stderr)
+
+    g = HyperGraph()
+    r = np.random.default_rng(23)
+    entities = g.bulk_import(values=np.arange(n_entities).tolist())
+    e0 = int(entities[0])
+    for s in range(0, n_links, 100_000):
+        m = min(100_000, n_links - s)
+        subj = r.integers(0, n_entities, size=m)
+        obj = r.integers(0, n_entities, size=m)
+        g.bulk_import(
+            values=[int(x) for x in range(s, s + m)],
+            target_lists=[[e0 + int(a), e0 + int(b)]
+                          for a, b in zip(subj, obj)],
+        )
+    g.enable_incremental(
+        headroom=1.8, delta_bucket_min=1 << 14,
+        pack_pad_multiple=int(os.environ.get("BENCH_C8_PAD", 1 << 17)),
+    )
+    seeds = (e0 + r.integers(0, n_entities, size=n_requests)).astype(
+        np.int64)
+
+    def run(cfg) -> tuple[float, list, int]:
+        rt = ServeRuntime(g, cfg)
+        try:
+            # warm each bucket shape off the clock
+            for b in cfg.buckets:
+                warm = [rt.submit_bfs(int(seeds[j % len(seeds)]),
+                                      max_hops=hops) for j in range(b)]
+                for f in warm:
+                    f.result(timeout=600)
+            rt.stats.reset()
+            t0 = time.perf_counter()
+            futs = [rt.submit_bfs(int(s), max_hops=hops) for s in seeds]
+            results = [f.result(timeout=600) for f in futs]
+            wall = time.perf_counter() - t0
+            probe_out = [(int(res.count), [int(m) for m in res.matches])
+                         for res in results[:64]]
+            return (len(results) / wall, probe_out,
+                    rt.stats.sharded_dispatches)
+        finally:
+            rt.close(drain=True, timeout=120)
+
+    base_cfg = dict(
+        buckets=(64, 256, 1024),
+        max_linger_s=float(os.environ.get("BENCH_C8_LINGER_S", 0.002)),
+        top_r=16, prewarm_aot=False,
+    )
+    single_qps, single_probe, _ = run(ServeConfig(sharded=False,
+                                                  **base_cfg))
+    per_dev = {}
+    diff_equal = True
+    sharded_dispatches = 0
+    for d in counts:
+        if d == 1:
+            per_dev["1"] = round(single_qps, 1)
+            continue
+        qps, probe_out, n_sharded = run(
+            ServeConfig(sharded=True, mesh_devices=d, **base_cfg))
+        per_dev[str(d)] = round(qps, 1)
+        diff_equal = diff_equal and probe_out == single_probe
+        sharded_dispatches += n_sharded
+    g.close()
+    top = str(max(int(k) for k in per_dev))
+    out = {
+        "entities": n_entities,
+        "links": n_links,
+        "requests": n_requests,
+        "hops": hops,
+        "devices": counts,
+        "served_qps_per_device_count": per_dev,
+        "single_chip_qps": round(single_qps, 1),
+        "sharded_vs_single_chip": (
+            round(per_dev[top] / single_qps, 2) if single_qps else None
+        ),
+        # proves the multi-device runs really took the mesh path (a
+        # silently-single-chip "sharded" run would be trivially
+        # differential-equal) — the shard.sh gate asserts it nonzero
+        "sharded_dispatches": sharded_dispatches,
+        "differential_equal": diff_equal,
+        "backend": _backend_name(),
+    }
+    telemetry = _telemetry_dump("c8")
+    if telemetry:
+        out["telemetry"] = telemetry
+    out["recorded_to"] = _record_c8(out)
+    return out
+
+
+def _record_c8(result: dict) -> Optional[str]:
+    """Persist the c8 sharded-serving scaling curve (per-device-count
+    qps, sharded-vs-single ratio, differential verdict) to
+    ``BENCH_C8_<tag>.json`` next to this file — the committed record the
+    real-TPU sweep validates. Best-effort like :func:`_record_c6`."""
+    tag = os.environ.get("BENCH_C8_TAG", "local")
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), f"BENCH_C8_{tag}.json"
+    )
+    record = {
+        "schema_version": 1,
+        "recorded_unix": int(time.time()),
+        "tag": tag,
+        "backend": _backend_name(),
+        "c8_sharded": {k: v for k, v in result.items()
+                       if k not in ("telemetry", "recorded_to")},
+    }
+    try:
+        with open(path, "w") as f:
+            json.dump(record, f, indent=2, sort_keys=True)
+            f.write("\n")
+    except OSError as e:
+        import sys
+
+        print(f"bench: could not write {path}: {e}", file=sys.stderr)
+        return None
+    return os.path.basename(path)
+
+
 def _record_c7(result: dict) -> Optional[str]:
     """Persist the c7 pattern-join numbers (device-vs-host ratio for
     triangle + 2-path counting, truncation honesty, differential
@@ -1376,6 +1530,10 @@ def _config_c7() -> dict:
     return _with_telemetry("c7", lambda: bench_c7(snap, info))
 
 
+def _config_c8() -> dict:
+    return _with_telemetry("c8", bench_c8)
+
+
 def _run_isolated(name: str) -> dict:
     """Run one config in a FRESH python subprocess.
 
@@ -1431,6 +1589,7 @@ def main() -> None:
         c5 = _run_isolated("c5")
         c6 = _run_isolated("c6")
         c7 = _run_isolated("c7")
+        c8 = _run_isolated("c8")
         graph = c4.pop("_graph")
     else:  # legacy in-process path (BENCH_ISOLATE=0): order still matters
         # c6's cold-start probe BEFORE any config initializes the device
@@ -1449,6 +1608,7 @@ def main() -> None:
         c5 = _with_telemetry("c5", bench_c5)
         c6 = bench_c6(cold=cold)
         c7 = _with_telemetry("c7", lambda: bench_c7(snap, info))
+        c8 = _with_telemetry("c8", bench_c8)
         graph = {
             "n_atoms": info["n_atoms"],
             "total_arity": info["total_arity"],
@@ -1466,6 +1626,7 @@ def main() -> None:
             "c5_streaming": c5,
             "c6_serving": c6,
             "c7_pattern_join": c7,
+            "c8_sharded": c8,
         },
         "graph": graph,
     }))

@@ -28,8 +28,12 @@ from typing import Iterable, Optional
 from hypergraphdb_tpu.obs.registry import Registry
 from hypergraphdb_tpu.obs.trace import Trace, Tracer
 
-#: bump on ANY change to the JSONL trace record shape
-TRACE_SCHEMA_VERSION = 1
+#: bump on ANY change to the JSONL trace record shape.
+#: v2: trace/span ids grew to full 128 bits (multi-chip pods put many
+#: processes behind one collector; the v1 62-bit space could collide on
+#: the join key). A v1 file's ids are not comparable with v2 ids, so the
+#: reader REJECTS v1 instead of silently mixing the two spaces.
+TRACE_SCHEMA_VERSION = 2
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 

@@ -64,6 +64,15 @@ def runtime_health(rt) -> HealthProbe:
         mgr = getattr(rt.executor, "mgr", None)
         if mgr is not None:
             payload["staleness_lag_edges"] = int(mgr.delta_edges)
+        mesh_rep = getattr(rt.executor, "mesh_report", None)
+        if callable(mesh_rep):
+            # a multi-chip pod advertises its mesh shape, gid-range
+            # partition map, and per-shard HBM occupancy — the fields
+            # shard-aware FrontDoor placement reads
+            try:
+                payload["mesh"] = mesh_rep()
+            except Exception:  # noqa: BLE001 - health must not 500 on it
+                pass
         healthy = (payload["accepting"]
                    and all(v != "open" for v in states.values()))
         return healthy, payload

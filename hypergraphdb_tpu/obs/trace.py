@@ -78,8 +78,13 @@ _ids = itertools.count(1)
 #: cross-process tree is reconstructed by (trace id, parent span id), so
 #: BOTH key spaces must be collision-free across processes — a server
 #: span whose local id equals the client's propagated parent id would
-#: misattach the remote subtree
-_TRACE_ID_BASE = random.SystemRandom().getrandbits(20) << 42
+#: misattach the remote subtree. FULL 128-BIT ids (86 random high bits
+#: over a 42-bit per-process counter): a multi-chip pod puts many
+#: processes behind ONE collector, and the former 62-bit space made
+#: cross-process collisions merely improbable instead of negligible —
+#: the id-width change is the trace-record schema v2 bump
+#: (``obs.export.TRACE_SCHEMA_VERSION``)
+_TRACE_ID_BASE = random.SystemRandom().getrandbits(86) << 42
 
 _FLIGHT = _global_flight()
 

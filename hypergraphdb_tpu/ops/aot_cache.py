@@ -98,6 +98,58 @@ def env_fingerprint(backend: Optional[str] = None) -> str:
     return f"jax{jax.__version__}_{backend or jax.default_backend()}"
 
 
+def _is_device(obj: Any) -> bool:
+    try:
+        import jax
+
+        return isinstance(obj, jax.Device)
+    except Exception:  # noqa: BLE001 - exotic jax versions
+        return type(obj).__name__ == "Device"
+
+
+def _dumps(obj: Any) -> bytes:
+    """Pickle with jax ``Device`` objects swapped for their ids: the
+    in/out treedefs of MESH-sharded entries carry the ``Mesh`` (and so
+    its device array) in pytree aux data, and devices are process
+    handles no pickler can serialize. The env fingerprint already pins
+    the backend, so re-resolving by id at load time is exact."""
+    import io
+
+    buf = io.BytesIO()
+    p = pickle.Pickler(buf)
+
+    def persistent_id(o):
+        if _is_device(o):
+            return ("hg_device", int(o.id))
+        return None
+
+    p.persistent_id = persistent_id
+    p.dump(obj)
+    return buf.getvalue()
+
+
+def _loads(data: bytes) -> Any:
+    import io
+
+    up = pickle.Unpickler(io.BytesIO(data))
+
+    def persistent_load(pid):
+        kind, did = pid
+        if kind != "hg_device":
+            raise pickle.UnpicklingError(f"unknown persistent id {pid!r}")
+        import jax
+
+        for d in jax.devices():
+            if int(d.id) == int(did):
+                return d
+        # fewer/different devices than the writer: a stale-shaped entry,
+        # surfaced as unreadable → quiet rebuild
+        raise pickle.UnpicklingError(f"device id {did} not present")
+
+    up.persistent_load = persistent_load
+    return up.load()
+
+
 def _aval_sig(x: Any) -> str:
     import jax
 
@@ -283,7 +335,7 @@ class AOTCache:
                     raise OSError(f"bad magic {magic!r}")
                 header = json.loads(f.readline().decode("utf-8"))
                 self._check_header(header, path)
-                payload, in_tree, out_tree = pickle.loads(f.read())
+                payload, in_tree, out_tree = _loads(f.read())
         except StaleEntry as e:
             # a different environment/content wrote this — quiet rebuild,
             # exactly the ellbfs.StalePlans discipline
@@ -348,7 +400,7 @@ class AOTCache:
             with open(tmp, "wb") as f:
                 f.write(_MAGIC)
                 f.write((json.dumps(header) + "\n").encode("utf-8"))
-                f.write(pickle.dumps((payload, in_tree, out_tree)))
+                f.write(_dumps((payload, in_tree, out_tree)))
             os.replace(tmp, path)
             self.stats.puts += 1
         except Exception as e:  # noqa: BLE001

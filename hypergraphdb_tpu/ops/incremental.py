@@ -144,15 +144,24 @@ class PinnedView(NamedTuple):
     device pair, AND the host memtable correction sets, all captured under a
     single manager lock. A batch built from one PinnedView can never
     straddle a compaction swap — the serve-layer twin of
-    :meth:`SnapshotManager.read_view` (which returns host-only views)."""
+    :meth:`SnapshotManager.read_view` (which returns host-only views).
+
+    ``sharded_base``/``sharded_delta`` are the multi-chip twins of
+    (``device``, ``delta``) — populated only when the manager has a mesh
+    attached (:meth:`SnapshotManager.attach_mesh`) and the view was
+    pinned with ``sharded=True``; the host correction sets apply to them
+    unchanged (same epoch, same memtable)."""
 
     base: CSRSnapshot
-    device: DeviceSnapshot
+    device: Optional[DeviceSnapshot]  # None for sharded views (never
+    #   materialize the full single-chip upload beside the shards)
     delta: Optional[DeviceDelta]  # None when pinned with sync_delta=False
     epoch: int          # compaction counter the pair belongs to
     dead: set           # tombstoned handles not yet baked into the base
     new_atoms: list     # handles added since the base pack (commit order)
     revalued: set       # values replaced since the base pack
+    sharded_base: object = None    # parallel.sharded.ShardedSnapshot
+    sharded_delta: object = None   # parallel.sharded.ShardedDelta
 
 
 class SnapshotManager:
@@ -225,6 +234,17 @@ class SnapshotManager:
         self._needs_recompact = False
         self._uploaded_marker = (-1, -1, -1)
         self._uploaded_atoms = 0
+        # multi-chip twins (attach_mesh): the sharded base is rebuilt per
+        # epoch OUTSIDE the lock, the sharded delta re-partitioned from
+        # the memtable under the same drift marker discipline as the
+        # single-chip device delta
+        self._mesh = None
+        self._shard_edge_chunk = 1 << 16
+        self._shard_delta_chunk = 4096
+        self._sharded_base = None
+        self._sharded_epoch = -1
+        self._sharded_delta = None
+        self._sharded_marker = (-1, -1, -1)
         graph.events.add_listener(ev.HGAtomAddedEvent, self._on_added)
         graph.events.add_listener(ev.HGAtomRemovedEvent, self._on_removed)
         graph.events.add_listener(ev.HGAtomReplacedEvent, self._on_replaced)
@@ -490,8 +510,80 @@ class SnapshotManager:
         if stale:
             self._refresh_device_delta_locked(marker)
 
+    # -- multi-chip twins -------------------------------------------------------
+    def attach_mesh(self, mesh, edge_chunk: int = 1 << 16,
+                    delta_edge_chunk: int = 4096) -> None:
+        """Give this manager a device mesh: ``pinned_view(sharded=True)``
+        then hands out the row-sharded (base, delta) twins alongside the
+        host correction sets. Idempotent for the same mesh; attaching a
+        DIFFERENT mesh drops the cached sharded state (next pin
+        re-shards)."""
+        with self._lock:
+            if self._mesh is not mesh:
+                self._sharded_base = None
+                self._sharded_epoch = -1
+                self._sharded_delta = None
+                self._sharded_marker = (-1, -1, -1)
+            self._mesh = mesh
+            self._shard_edge_chunk = edge_chunk
+            self._shard_delta_chunk = delta_edge_chunk
+
+    def _sync_sharded_delta_locked(self, max_lag_edges: int):
+        """Refresh the sharded delta when the memtable drifted past
+        ``max_lag_edges`` (caller holds the mgr lock AND the cached
+        sharded base matches the current epoch) — the multi-chip twin of
+        :meth:`_sync_device_delta_locked`. Re-partitions the whole
+        memtable per refresh (no append path yet: delta partitions
+        interleave across devices, so there is no stable tail to
+        splice)."""
+        from hypergraphdb_tpu.parallel.sharded import shard_host_delta
+
+        marker = (self.compactions, len(self._inc_links), len(self._dead))
+        stale = (self._sharded_delta is None
+                 or marker[0] != self._sharded_marker[0])
+        if not stale:
+            drift = (marker[1] - self._sharded_marker[1]
+                     + marker[2] - self._sharded_marker[2])
+            stale = drift > max_lag_edges
+        if stale:
+            self._sharded_delta = shard_host_delta(
+                self._sharded_base, self._host_delta_locked(),
+                edge_chunk=self._shard_delta_chunk,
+            )
+            self._sharded_marker = marker
+        return self._sharded_delta
+
+    def _ensure_sharded_base(self) -> None:
+        """Make the cached sharded base current (called OUTSIDE the lock:
+        sharding the base is an O(E) repartition + upload — holding the
+        mgr lock across it would stall every committing writer). The
+        epoch re-check loop mirrors how compaction publishes: shard,
+        then swap in only if no compaction moved the epoch meanwhile."""
+        from hypergraphdb_tpu.parallel.sharded import ShardedSnapshot
+
+        while True:
+            with self._lock:
+                if self._mesh is None:
+                    raise ValueError(
+                        "pinned_view(sharded=True) needs attach_mesh first"
+                    )
+                if self._sharded_epoch == self.compactions:
+                    return
+                base, epoch = self.base, self.compactions
+            sbase = ShardedSnapshot.from_host(
+                base, self._mesh, edge_chunk=self._shard_edge_chunk
+            )
+            with self._lock:
+                if self.compactions == epoch:
+                    self._sharded_base = sbase
+                    self._sharded_epoch = epoch
+                    self._sharded_delta = None
+                    self._sharded_marker = (-1, -1, -1)
+                    return
+
     def pinned_view(self, max_lag_edges: int = 0,
-                    sync_delta: bool = True) -> PinnedView:
+                    sync_delta: bool = True,
+                    sharded: bool = False) -> PinnedView:
         """The serving read unit: (base, device pair, memtable correction)
         captured under ONE lock. ``device()`` + a separate ``correction()``
         can straddle a background swap — a batch assembled from this view
@@ -503,21 +595,43 @@ class SnapshotManager:
         returns ``delta=None`` — for readers (the pattern serving path)
         that consume only the base plus the HOST correction sets, paying a
         host→HBM delta upload per memtable change on their hot path would
-        buy nothing."""
+        buy nothing.
+
+        ``sharded=True`` (mesh attached via :meth:`attach_mesh`) fills
+        ``sharded_base``/``sharded_delta`` with the row-sharded twins —
+        the multi-chip serving read unit. The single-chip device pair is
+        NOT synced for such views (a sharded reader pays no single-chip
+        delta upload); the host correction sets are shared."""
         self._maybe_compact()
-        with self._lock:
-            base = self.base
-            if sync_delta:
-                self._sync_device_delta_locked(max_lag_edges)
-            return PinnedView(
-                base=base,
-                device=base.device,
-                delta=self._device_delta if sync_delta else None,
-                epoch=self.compactions,
-                dead=set(self._dead),
-                new_atoms=list(self._new_atoms),
-                revalued=set(self._revalued),
-            )
+        while True:
+            if sharded:
+                self._ensure_sharded_base()
+            with self._lock:
+                if sharded and self._sharded_epoch != self.compactions:
+                    continue  # a compaction swapped mid-shard: re-shard
+                base = self.base
+                sbase = sdelta = None
+                if sharded:
+                    sbase = self._sharded_base
+                    sdelta = self._sync_sharded_delta_locked(max_lag_edges)
+                elif sync_delta:
+                    self._sync_device_delta_locked(max_lag_edges)
+                return PinnedView(
+                    base=base,
+                    # a sharded view must NOT materialize the single-chip
+                    # device snapshot: base.device is a cached_property
+                    # whose first touch uploads the FULL CSR to device 0
+                    # — exactly the copy sharding exists to avoid
+                    device=None if sharded else base.device,
+                    delta=(self._device_delta
+                           if sync_delta and not sharded else None),
+                    epoch=self.compactions,
+                    dead=set(self._dead),
+                    new_atoms=list(self._new_atoms),
+                    revalued=set(self._revalued),
+                    sharded_base=sbase,
+                    sharded_delta=sdelta,
+                )
 
     def wait_compacted(self, timeout: Optional[float] = None) -> bool:
         """Block until no compaction pass is in flight (bounded by
@@ -639,16 +753,22 @@ class SnapshotManager:
         multi-chip caller re-shards the base when ``epoch`` moves (the
         sharded twin of ``device()``'s epoch marker)."""
         with self._lock:
-            return {
-                "epoch": self.compactions,
-                "capacity": self._capacity,
-                "inc_links": np.asarray(self._inc_links, dtype=np.int32),
-                "inc_src": np.asarray(self._inc_src, dtype=np.int32),
-                "tgt_flat": np.asarray(self._tgt_flat, dtype=np.int32),
-                "tgt_src": np.asarray(self._tgt_src, dtype=np.int32),
-                "dead": np.fromiter(self._dead, dtype=np.int64)
-                if self._dead else np.empty(0, dtype=np.int64),
-            }
+            return self._host_delta_locked()
+
+    def _host_delta_locked(self) -> dict:
+        """The ONE memtable capture shape (caller holds the mgr lock) —
+        shared by :meth:`host_delta` and the sharded-delta refresh so
+        the two can never drift on what a delta carries."""
+        return {
+            "epoch": self.compactions,
+            "capacity": self._capacity,
+            "inc_links": np.asarray(self._inc_links, dtype=np.int32),
+            "inc_src": np.asarray(self._inc_src, dtype=np.int32),
+            "tgt_flat": np.asarray(self._tgt_flat, dtype=np.int32),
+            "tgt_src": np.asarray(self._tgt_src, dtype=np.int32),
+            "dead": np.fromiter(self._dead, dtype=np.int64)
+            if self._dead else np.empty(0, dtype=np.int64),
+        }
 
     def device_visible_new_atoms(self) -> list[int]:
         """New atoms whose delta edges are ALREADY uploaded to the device

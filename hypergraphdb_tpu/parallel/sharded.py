@@ -63,6 +63,7 @@ from hypergraphdb_tpu.ops.bitfrontier import (
 )
 from hypergraphdb_tpu.ops.snapshot import CSRSnapshot
 from hypergraphdb_tpu.ops.setops import SENTINEL, _bucket, member_mask, pad_sorted
+from hypergraphdb_tpu.storage.partitioned import PartitionMap
 
 #: name of the device-mesh axis rows/edges/candidates are sharded over
 AXIS = "shard"
@@ -126,13 +127,25 @@ class ShardedSnapshot:
     def n_dev(self) -> int:
         return self.mesh.devices.size
 
+    @property
+    def partition_map(self) -> PartitionMap:
+        """The gid-range owner map this snapshot's rows follow — derived,
+        not stored (the storage layer owns the map type; the layout here
+        is ``for_mesh``'s by construction)."""
+        return PartitionMap(n_parts=int(self.mesh.devices.size),
+                            part_size=self.n_loc,
+                            capacity=self.num_atoms + 1)
+
     @staticmethod
     def from_host(
         snap: CSRSnapshot, mesh: Mesh, edge_chunk: int = 1 << 16
     ) -> "ShardedSnapshot":
         n_dev = int(mesh.devices.size)
         N = snap.num_atoms
-        n_loc = -(-(N + 1) // (n_dev * 128)) * 128
+        # the row layout IS the storage partition map: one owner per
+        # contiguous gid range, 128-aligned (PartitionMap.for_mesh is the
+        # single source of the split arithmetic)
+        n_loc = PartitionMap.for_mesh(N + 1, n_dev).part_size
         n_pad = n_dev * n_loc
         shard = NamedSharding(mesh, P(AXIS))
 

@@ -67,6 +67,20 @@ from hypergraphdb_tpu.utils.metrics import Metrics
 _PERMANENT = (Unservable, PermanentFault, KeyError, ValueError, TypeError)
 
 
+def _request_ids(payload: dict) -> list:
+    """The RAW atom handles a submit payload names (seed / anchors) —
+    what shard-ownership placement compares against a backend's
+    advertised gid coverage. Gid-addressed forms resolve per-backend and
+    carry no global ordering, so they contribute nothing here."""
+    ids = []
+    if isinstance(payload.get("seed"), int):
+        ids.append(int(payload["seed"]))
+    anchors = payload.get("anchors")
+    if isinstance(anchors, (list, tuple)):
+        ids.extend(int(a) for a in anchors if isinstance(a, int))
+    return ids
+
+
 def submit_payload(runtime, payload: dict, timeout: float,
                    authoritative: bool = False) -> dict:
     """One wire-shaped request → the runtime → a wire-shaped response.
@@ -277,9 +291,9 @@ class FrontDoor:
         )
         self.metrics = Metrics()
         self._lock = threading.Lock()
-        #: backend id → (healthy, advertised lag, snapshot time)
-        #: backend id → (healthy, lag, load score, snapshot time)
-        self._health: dict[str, tuple[bool, int, float, float]] = {}
+        #: backend id → (healthy, lag, load score, advertised gid
+        #: capacity or None, snapshot time)
+        self._health: dict[str, tuple] = {}
         self._rr = 0
         self._poll_stop = threading.Event()
         self._poll_thread: Optional[threading.Thread] = None
@@ -337,7 +351,7 @@ class FrontDoor:
             return  # a sweep is in flight; place with the snapshot we have
         try:
             now = self.clock()
-            results: dict[str, tuple[bool, int, float]] = {}
+            results: dict[str, tuple] = {}
             w = self.config.load_breaker_weight
 
             def probe(be):
@@ -349,9 +363,19 @@ class FrontDoor:
                     # + a penalty while the serve breaker is not closed
                     load = (float(payload.get("queue_depth", 0))
                             + w * float(payload.get("breaker_worst", 0)))
+                    # shard ownership: a multi-chip pod advertises its
+                    # partition map; the covered id space bounds which
+                    # raw-handle requests its device path can own. No
+                    # advertisement = a full replica (covers everything).
+                    cover = None
+                    mesh = payload.get("mesh")
+                    if isinstance(mesh, dict):
+                        pm = mesh.get("partition_map") or {}
+                        if pm.get("capacity") is not None:
+                            cover = int(pm["capacity"])
                 except Exception:  # noqa: BLE001 - unreachable == unhealthy
-                    healthy, lag, load = False, 0, 0.0
-                results[be.id] = (healthy, lag, load)
+                    healthy, lag, load, cover = False, 0, 0.0, None
+                results[be.id] = (healthy, lag, load, cover)
 
             if len(self.replicas) <= 1:
                 for be in self.replicas:
@@ -368,10 +392,11 @@ class FrontDoor:
                 for t in threads:
                     t.join()
             for be in self.replicas:
-                healthy, lag, load = results.get(be.id, (False, 0, 0.0))
+                healthy, lag, load, cover = results.get(
+                    be.id, (False, 0, 0.0, None))
                 with self._lock:
                     prev = self._health.get(be.id)
-                    self._health[be.id] = (healthy, lag, load, now)
+                    self._health[be.id] = (healthy, lag, load, cover, now)
                 if (healthy and prev is not None and not prev[0]
                         and self.breaker.state_of(be.id) != CLOSED):
                     self.breaker.reset(be.id)
@@ -390,15 +415,18 @@ class FrontDoor:
                     "front-door health poll failed", exc_info=True
                 )
 
-    def _placement(self) -> list:
-        """Healthy replicas, least-lagged first, load-score tiebreak
-        within a lag tie (queue depth + breaker penalty from
-        ``/healthz``), round-robin within the equal-(lag, load) head
-        group (the spread), breaker-OPEN gates skipped."""
+    def _placement(self, payload: Optional[dict] = None) -> list:
+        """Healthy replicas ordered by SHARD OWNERSHIP first (a backend
+        whose advertised partition map covers the request's raw ids
+        beats one that would have to host-correct or re-route), then
+        least-lagged, then a load-score tiebreak within a lag tie (queue
+        depth + breaker penalty from ``/healthz``), round-robin within
+        the equal head group (the spread), breaker-OPEN gates
+        skipped."""
         now = self.clock()
         with self._lock:
             stale = any(
-                self._health.get(be.id, (False, 0, 0.0, -1e9))[3]
+                self._health.get(be.id, (False, 0, 0.0, None, -1e9))[4]
                 < now - self.config.health_refresh_s
                 for be in self.replicas
             )
@@ -406,7 +434,7 @@ class FrontDoor:
             self.refresh_health()
         with self._lock:
             known = {
-                be.id: self._health.get(be.id, (False, 0, 0.0, 0.0))
+                be.id: self._health.get(be.id, (False, 0, 0.0, None, 0.0))
                 for be in self.replicas
             }
             self._rr += 1
@@ -414,13 +442,18 @@ class FrontDoor:
         healthy = [be for be in self.replicas if known[be.id][0]]
         if not healthy:
             return []
+        req_ids = _request_ids(payload) if payload else []
+        req_hi = max(req_ids) if req_ids else None
 
         def score(be):
             # load is QUANTIZED for grouping: exact float equality would
             # let one queued request's jitter collapse the round-robin
             # spread onto a single replica per poll window (herding) —
             # a few requests of depth difference is noise, not signal
-            return (known[be.id][1], int(known[be.id][2]) // 8)
+            cover = known[be.id][3]
+            owns = (req_hi is None or cover is None or req_hi < cover)
+            return (0 if owns else 1,
+                    known[be.id][1], int(known[be.id][2]) // 8)
 
         healthy.sort(key=score)
         best = score(healthy[0])
@@ -444,7 +477,7 @@ class FrontDoor:
             else self.config.submit_timeout_s
         self.metrics.incr("router.submitted")
         attempts = 0
-        for be in self._placement():
+        for be in self._placement(payload):
             if attempts >= self.config.max_attempts:
                 break
             if not self.breaker.allow(be.id):
@@ -500,8 +533,8 @@ class FrontDoor:
             backends = {}
             any_replica = False
             for be in self.replicas:
-                healthy, lag, load, t = snap.get(be.id,
-                                                 (False, 0, 0.0, 0.0))
+                healthy, lag, load, cover, t = snap.get(
+                    be.id, (False, 0, 0.0, None, 0.0))
                 state = self.breaker.state_of(be.id)
                 if healthy and state != OPEN:
                     any_replica = True
@@ -509,6 +542,7 @@ class FrontDoor:
                     "healthy": healthy,
                     "replication_lag": lag,
                     "load_score": load,
+                    "gid_capacity": cover,
                     "breaker": state,
                 }
             primary_ok = True

@@ -60,6 +60,7 @@ from hypergraphdb_tpu.serve.runtime import (
     ServeConfig,
     ServeRuntime,
 )
+from hypergraphdb_tpu.serve.sharded import ShardedExecutor
 
 __all__ = [
     "AdmissionGated",
@@ -80,6 +81,7 @@ __all__ = [
     "ServeResult",
     "ServeRuntime",
     "ServeStats",
+    "ShardedExecutor",
     "Unservable",
     "bucket_for",
 ]

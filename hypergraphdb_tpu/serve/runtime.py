@@ -124,6 +124,19 @@ class ServeConfig:
     #: deadline window after every compaction. Opt-in: BFS/pattern-only
     #: tiers should not pay it.
     prewarm_join_nbr: bool = False
+    # -- multi-chip serving (serve/sharded + ops/sharded_serving) ------------
+    #: True routes serve buckets through the mesh-sharded executor;
+    #: False pins single-chip; None = AUTO — sharded exactly when more
+    #: than one device is visible AND the pinned base's device footprint
+    #: exceeds ``hbm_budget_bytes`` (a snapshot one chip can hold serves
+    #: faster without collective hops)
+    sharded: Optional[bool] = None
+    #: per-chip HBM budget the AUTO pick compares the base snapshot's
+    #: estimated device bytes against; None disables the auto upgrade
+    #: (only ``sharded=True`` shards then)
+    hbm_budget_bytes: Optional[int] = None
+    #: cap on mesh devices (None = every visible device)
+    mesh_devices: Optional[int] = None
 
 
 @dataclass
@@ -288,14 +301,19 @@ class DeviceExecutor:
                                      kw["n_atoms"], kw["overlay"],
                                      **statics)
 
-    def _serve_pattern(self, view, ell, anchors_dev, type_vec_dev):
+    def _serve_pattern(self, view, ell, anchors, type_vec):
         """One pattern batch dispatch through the AOT cache when
         configured (the prewarmed (bucket, P) executables — ROADMAP 4d:
         join/pattern traffic in a fresh process must not pay
-        dispatch-thread compiles); plain jit otherwise."""
+        dispatch-thread compiles); plain jit otherwise. ``anchors`` and
+        ``type_vec`` arrive as host numpy (the launch loop builds them);
+        subclasses routing to other kernels reassemble from those."""
+        import jax.numpy as jnp
+
         from hypergraphdb_tpu.ops.serving import pattern_serve_batch
 
-        args = (view.device, ell, anchors_dev, type_vec_dev)
+        args = (view.device, ell, jnp.asarray(anchors),
+                jnp.asarray(type_vec))
         statics = {"pad_len": self.config.pattern_pad,
                    "top_r": self.config.top_r}
         compiled = self._aot_dispatch("ops.serving.pattern_serve_batch",
@@ -303,6 +321,32 @@ class DeviceExecutor:
         if compiled is not None:
             return compiled(*args)
         return pattern_serve_batch(*args, **statics)
+
+    def _pattern_gate(self, view):
+        """The pattern lanes' device-path gate: an opaque handle the
+        dispatch needs (the base's ELL targets here), or None → every
+        lane takes the exact host path."""
+        from hypergraphdb_tpu.ops.setops import ell_targets
+
+        return ell_targets(view.base)
+
+    def _pin_view(self, kind: str, host_only: bool = False):
+        """Pin the batch's consistent read unit — the ONE override point
+        for executors that read a different device layout (the sharded
+        executor pins mesh twins here)."""
+        return self.mgr.pinned_view(
+            self.config.max_lag_edges,
+            sync_delta=(kind == "bfs") and not host_only,
+        )
+
+    def _execute_join(self, view, plan, consts, n_real: int):
+        """One join batch through the single-chip lane executor
+        (subclass override point — the sharded executor routes the same
+        plan through the mesh's lane-sharded program)."""
+        from hypergraphdb_tpu.ops.join import execute_join
+
+        return execute_join(view.base, plan, consts,
+                            top_r=self.config.top_r, n_real=n_real)
 
     def prewarm(self, buckets, max_hops: Optional[int] = None) -> int:
         """Compile (or load from the AOT cache) the BFS serving
@@ -457,8 +501,7 @@ class DeviceExecutor:
         if getattr(batch, "force_host", False):
             # breaker-degraded mode: the WHOLE batch takes the exact host
             # path under the pinned epoch — no device work, no delta sync
-            view = self.mgr.pinned_view(self.config.max_lag_edges,
-                                        sync_delta=False)
+            view = self._pin_view(kind, host_only=True)
             out = LaunchedBatch(batch=batch, view=view)
             out.host_tickets = list(batch.tickets)
             return out
@@ -468,8 +511,7 @@ class DeviceExecutor:
             self.faults.check("serve.launch", kind=kind)
         # pattern batches read base + HOST corrections only — don't pay a
         # device-delta upload on their hot path
-        view = self.mgr.pinned_view(self.config.max_lag_edges,
-                                    sync_delta=(kind == "bfs"))
+        view = self._pin_view(kind)
         out = LaunchedBatch(batch=batch, view=view)
         if kind == "bfs":
             max_hops = batch.key[1]
@@ -500,11 +542,10 @@ class DeviceExecutor:
                         )
         elif kind == "pattern":
             from hypergraphdb_tpu.ops.serving import NO_TYPE
-            from hypergraphdb_tpu.ops.setops import ell_targets
 
             P = batch.key[1]
             n = view.base.num_atoms
-            ell = ell_targets(view.base)
+            ell = self._pattern_gate(view)
             off = view.base.inc_offsets
             anchors = np.full((batch.bucket, P), n, dtype=np.int32)
             type_vec = np.full(batch.bucket, NO_TYPE, dtype=np.int32)
@@ -529,8 +570,7 @@ class DeviceExecutor:
                 out.cand_records = self._capture_candidates(view)
                 with self._dispatch_cm("pattern", batch.bucket, P):
                     out.dev_out = self._serve_pattern(
-                        view, ell, jnp.asarray(anchors),
-                        jnp.asarray(type_vec),
+                        view, ell, anchors, type_vec,
                     )
         elif kind == "join":
             sig = batch.key[1]
@@ -559,17 +599,13 @@ class DeviceExecutor:
                     out.lane_tickets.append((lane, t))
                     lane += 1
                 if out.lane_tickets:
-                    from hypergraphdb_tpu.ops.join import execute_join
-
                     out.join_plan = plan
                     with self._dispatch_cm("join", batch.bucket,
                                            len(plan.steps)):
                         with self.tracer.span("join.execute",
                                               sig=str(sig.atoms)):
-                            ex = execute_join(
-                                view.base, plan, consts,
-                                top_r=self.config.top_r, n_real=lane,
-                            )
+                            ex = self._execute_join(view, plan, consts,
+                                                    n_real=lane)
                     out.dev_out = (ex.counts, ex.trunc, ex.tuples)
         else:  # pragma: no cover - batch keys come from our own requests
             raise Unservable(f"unknown batch kind {kind!r}")
@@ -871,6 +907,34 @@ class DeviceExecutor:
                            len(arr) > top_r, epoch, served_by="host")
 
 
+def _make_executor(graph, config: ServeConfig, stats):
+    """Pick the executor for one runtime: the mesh-sharded executor when
+    ``ServeConfig(sharded=True)``, or — AUTO mode (``sharded=None``) —
+    when more than one device is visible and the pinned base snapshot's
+    estimated device footprint exceeds ``hbm_budget_bytes`` (the
+    one-chip-cannot-hold-it trigger). Everything else stays on the
+    single-chip :class:`DeviceExecutor`."""
+    if config.sharded is False or graph is None:
+        return DeviceExecutor(graph, config, stats)
+    use = config.sharded is True
+    if not use and config.hbm_budget_bytes is not None:
+        import jax
+
+        n_dev = len(jax.devices())
+        if config.mesh_devices is not None:
+            n_dev = min(n_dev, int(config.mesh_devices))
+        if n_dev > 1:
+            from hypergraphdb_tpu.serve.sharded import snapshot_device_bytes
+
+            mgr = graph.incremental or graph.enable_incremental()
+            use = snapshot_device_bytes(mgr.base) > config.hbm_budget_bytes
+    if not use:
+        return DeviceExecutor(graph, config, stats)
+    from hypergraphdb_tpu.serve.sharded import ShardedExecutor
+
+    return ShardedExecutor(graph, config, stats)
+
+
 class ServeRuntime:
     """The serving front door. Threaded by default; ``manual=True`` for
     deterministic stepping (tests). Context manager: ``close(drain=True)``
@@ -907,7 +971,7 @@ class ServeRuntime:
                                self.config.max_linger_s)
         self.executor = (
             executor if executor is not None
-            else DeviceExecutor(graph, self.config, self.stats)
+            else _make_executor(graph, self.config, self.stats)
         )
         self.graph = graph
         # deploy-time compile: load-or-build the serving executables for

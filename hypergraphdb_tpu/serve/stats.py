@@ -36,6 +36,7 @@ LEGACY_TO_DOTTED = {
     "host_fallbacks": "serve.host_fallbacks",
     "batches": "serve.batches",
     "device_dispatches": "serve.device_dispatches",
+    "sharded_dispatches": "serve.sharded_dispatches",
     "retries": "serve.retries",
     "breaker_trips": "serve.breaker_trips",
     "breaker_state": "serve.breaker_state",
@@ -60,6 +61,7 @@ DOTTED_NAMES = (
     "serve.host_fallbacks",
     "serve.batches",
     "serve.device_dispatches",
+    "serve.sharded_dispatches",
     "serve.device_seconds",
     "serve.retries",
     "serve.breaker_trips",
@@ -107,6 +109,7 @@ class ServeStats:
         self._host_fallbacks = r.counter("serve.host_fallbacks")
         self._batches = r.counter("serve.batches")
         self._device_dispatches = r.counter("serve.device_dispatches")
+        self._sharded_dispatches = r.counter("serve.sharded_dispatches")
         self._retries = r.counter("serve.retries")
         self._breaker_trips = r.counter("serve.breaker_trips")
         self._breaker_state = r.gauge("serve.breaker_state")
@@ -124,7 +127,8 @@ class ServeStats:
         self._own = (
             self._submitted, self._completed, self._shed, self._rejected,
             self._gated, self._cancelled, self._errors, self._host_fallbacks,
-            self._batches, self._device_dispatches, self._device_seconds,
+            self._batches, self._device_dispatches,
+            self._sharded_dispatches, self._device_seconds,
             self._retries, self._breaker_trips, self._breaker_state,
             self._lanes_real, self._lanes_padded, self._latency,
             self._queue_depth,
@@ -254,6 +258,13 @@ class ServeStats:
         with self._lock:
             self._device_dispatches.inc()
 
+    def record_sharded_dispatch(self) -> None:
+        """One kernel dispatch routed through the mesh-sharded executor
+        (a subset of ``device_dispatches``-adjacent work: counted at the
+        kernel-call site, so an all-host batch counts neither)."""
+        with self._lock:
+            self._sharded_dispatches.inc()
+
     def record_device_time(self, seconds: float) -> None:
         """One batch's launch→ready device wall delta (only measured
         under ``ServeConfig(device_timing=True)`` — the histogram stays
@@ -320,6 +331,10 @@ class ServeStats:
     def device_dispatches(self) -> int:
         return self._device_dispatches.value
 
+    @property
+    def sharded_dispatches(self) -> int:
+        return self._sharded_dispatches.value
+
     # -- reading -------------------------------------------------------------
     def occupancy(self) -> Optional[float]:
         """Mean real-lane fraction over every dispatched bucket slot."""
@@ -359,6 +374,7 @@ class ServeStats:
                 "host_fallbacks": self._host_fallbacks.value,
                 "batches": self._batches.value,
                 "device_dispatches": self._device_dispatches.value,
+                "sharded_dispatches": self._sharded_dispatches.value,
                 "retries": self._retries.value,
                 "breaker_trips": self._breaker_trips.value,
                 "breaker_state": self._breaker_state.value,

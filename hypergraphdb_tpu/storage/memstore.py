@@ -235,6 +235,13 @@ class MemStorage(StorageBackend):
             flat[offsets[j] : offsets[j + 1]] = self._links[i]
         return ids, offsets, flat
 
+    def iter_record_handles(self) -> set:
+        """Every handle this backend holds ANY record for (link, payload,
+        or incidence set) — the enumeration a partition-map migration
+        (``partitioned.PartitionedStorage.repartition``) walks to decide
+        which records changed owners."""
+        return set(self._links) | set(self._data) | set(self._incidence)
+
     def max_handle(self) -> int:
         m = -1
         if self._links:

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import heapq
 import zlib
+from dataclasses import dataclass
 from typing import Callable, Iterator, Optional, Sequence
 
 import numpy as np
@@ -46,6 +47,88 @@ def _key_part(key: bytes, n: int) -> int:
     """Stable partition of an index key (content hash, not Python hash —
     must agree across processes)."""
     return zlib.crc32(bytes(key)) % n
+
+
+@dataclass(frozen=True)
+class PartitionMap:
+    """Contiguous gid-range ownership: the ONE partition map the storage
+    grid, the device mesh, and the serving tier all read.
+
+    The id space ``[0, capacity)`` splits into ``n_parts`` ranges of
+    ``part_size`` ids each (``part_size`` is ``align``-rounded so a
+    device shard's packed frontier words stay 128-lane aligned — the
+    same rounding :class:`parallel.sharded.ShardedSnapshot` applies to
+    its per-device row ranges, by construction: the sharded snapshot
+    derives its layout FROM this map). Ids at or beyond ``capacity``
+    (atoms minted after the map was cut) clamp into the LAST range, so
+    ownership is total at any moment; a :meth:`repartition` to a larger
+    capacity is how those ids move to their steady-state owner.
+
+    Frozen + hashable: the map rides jit static args and pytree aux data
+    unchanged."""
+
+    n_parts: int
+    part_size: int       # ids per range, align-rounded
+    capacity: int        # id space the map was cut for
+
+    #: alignment the device mesh needs (packed words: 128-lane rows)
+    ALIGN = 128
+
+    @staticmethod
+    def for_mesh(capacity: int, n_parts: int,
+                 align: int = ALIGN) -> "PartitionMap":
+        """The map for an ``n_parts``-way split of ``[0, capacity)``:
+        ranges sized ``ceil(capacity / (n_parts·align)) · align`` — the
+        exact per-device row-range formula of
+        ``ShardedSnapshot.from_host``, now owned here."""
+        if n_parts < 1:
+            raise ValueError("n_parts must be >= 1")
+        capacity = max(int(capacity), 1)
+        part_size = -(-capacity // (n_parts * align)) * align
+        return PartitionMap(n_parts=int(n_parts), part_size=part_size,
+                            capacity=capacity)
+
+    def owner_of(self, gid: int) -> int:
+        """The range owner of one gid (ids beyond the map's capacity
+        clamp into the last range — ownership is total)."""
+        if gid < 0:
+            raise ValueError(f"negative gid {gid}")
+        return min(int(gid) // self.part_size, self.n_parts - 1)
+
+    def owner_np(self, gids: np.ndarray) -> np.ndarray:
+        """Vectorized :meth:`owner_of` (the snapshot partitioner's hot
+        path: one integer divide + clip over the whole COO relation)."""
+        return np.minimum(
+            np.asarray(gids, dtype=np.int64) // self.part_size,
+            self.n_parts - 1,
+        )
+
+    def range_of(self, part: int) -> tuple[int, int]:
+        """[lo, hi) id range of one partition; the LAST range is
+        unbounded above (it owns every clamped overflow id)."""
+        lo = part * self.part_size
+        hi = (lo + self.part_size if part < self.n_parts - 1
+              else max(lo + self.part_size, self.capacity))
+        return lo, hi
+
+    def ranges(self) -> list:
+        return [self.range_of(p) for p in range(self.n_parts)]
+
+    def to_dict(self) -> dict:
+        """The wire shape ``/healthz`` advertises (mesh topology +
+        per-shard gid ranges — what shard-aware routing reads)."""
+        return {
+            "n_parts": self.n_parts,
+            "part_size": self.part_size,
+            "capacity": self.capacity,
+            "ranges": [[lo, hi] for lo, hi in self.ranges()],
+        }
+
+    def repartitioned(self, capacity: int) -> "PartitionMap":
+        """The same ``n_parts`` split re-cut for a grown id space —
+        ranges move; :meth:`PartitionedStorage.repartition` migrates the
+        records whose owner changed."""
+        return PartitionMap.for_mesh(capacity, self.n_parts)
 
 
 class PartitionedIndex(HGBidirectionalIndex):
@@ -140,7 +223,10 @@ class PartitionedStorage(StorageBackend):
         partitions: Sequence[StorageBackend] = (),
         n_partitions: int = 4,
         factory: Optional[Callable[[int], StorageBackend]] = None,
+        partition_map: Optional[PartitionMap] = None,
     ):
+        if partition_map is not None:
+            n_partitions = partition_map.n_parts
         if partitions:
             self._parts = list(partitions)
         else:
@@ -151,6 +237,16 @@ class PartitionedStorage(StorageBackend):
             self._parts = [factory(i) for i in range(n_partitions)]
         if not self._parts:
             raise ValueError("need at least one partition")
+        if (partition_map is not None
+                and partition_map.n_parts != len(self._parts)):
+            raise ValueError(
+                f"partition map covers {partition_map.n_parts} owners but "
+                f"{len(self._parts)} partitions were given"
+            )
+        #: gid-range routing (the device-mesh-aligned owner map). None
+        #: keeps the legacy modulo routing — the two never mix: a store
+        #: opened with a map routes by range for its whole life.
+        self.partition_map = partition_map
 
     # -- lifecycle --------------------------------------------------------------
     def startup(self) -> None:
@@ -179,7 +275,63 @@ class PartitionedStorage(StorageBackend):
 
     # -- record routing ---------------------------------------------------------
     def _own(self, h: HGHandle) -> StorageBackend:
+        if self.partition_map is not None:
+            return self._parts[self.partition_map.owner_of(int(h))]
         return self._parts[int(h) % len(self._parts)]
+
+    def repartition(self, new_map: PartitionMap) -> int:
+        """Adopt a re-cut partition map (gid ranges MOVE), migrating
+        every record whose owner changed: link records, data payloads,
+        and incidence sets each move to the handle's new range owner.
+        Index entries are key-hash routed and untouched — ``find`` /
+        ``count`` answers are identical before, during (per SPI op), and
+        after the move. Returns the number of handles migrated.
+
+        Same consistency stance as the commit-batch fan-out above: the
+        walk is sequential per partition, so a crash mid-migration can
+        leave a handle moved and its sibling not — re-running the same
+        repartition is idempotent and completes the move."""
+        if new_map.n_parts != len(self._parts):
+            raise ValueError(
+                "repartition cannot change the partition count "
+                f"({new_map.n_parts} != {len(self._parts)}): owners are "
+                "the fixed children, only their gid ranges move"
+            )
+        if self.partition_map is None:
+            raise ValueError(
+                "repartition needs gid-range routing; this store uses "
+                "legacy modulo routing"
+            )
+        moved = 0
+        for src_part, child in enumerate(self._parts):
+            enum = getattr(child, "iter_record_handles", None)
+            if enum is None:
+                raise TypeError(
+                    f"partition {src_part} ({type(child).__name__}) does "
+                    "not enumerate record handles; repartition needs "
+                    "iter_record_handles()"
+                )
+            for h in sorted(enum()):
+                dst_part = new_map.owner_of(int(h))
+                if dst_part == src_part:
+                    continue
+                dst = self._parts[dst_part]
+                rec = child.get_link(h)
+                if rec is not None:
+                    dst.store_link(h, rec)
+                    child.remove_link(h)
+                payload = child.get_data(h)
+                if payload is not None:
+                    dst.store_data(h, payload)
+                    child.remove_data(h)
+                inc = child.get_incidence_set(h)
+                if len(inc):
+                    for link in inc:
+                        dst.add_incidence_link(h, int(link))
+                    child.remove_incidence_set(h)
+                moved += 1
+        self.partition_map = new_map
+        return moved
 
     def store_link(self, h: HGHandle, targets: Sequence[HGHandle]) -> None:
         self._own(h).store_link(h, targets)

@@ -212,3 +212,24 @@ def sharded_snapshot_exemplar(n_loc: int = 128, e_loc: int = 64):
         value_rank_hi=sds((n_pad,), "uint32"),
         value_rank_lo=sds((n_pad,), "uint32"),
     )
+
+
+def sharded_delta_exemplar(n_loc: int = 128, d_loc: int = 16):
+    """A :class:`parallel.sharded.ShardedDelta` overlay exemplar matching
+    :func:`sharded_snapshot_exemplar`'s row layout (same n_loc, same
+    device count cap): per-device delta edge slices of ``d_loc`` entries
+    and the packed per-device tombstone words."""
+    import jax
+
+    from hypergraphdb_tpu.parallel.sharded import ShardedDelta
+
+    n_dev = len(jax.devices()[:8])
+    return ShardedDelta(
+        epoch=0,
+        edge_chunk=d_loc,
+        inc_src=sds((n_dev * d_loc,), "int32"),
+        inc_dst=sds((n_dev * d_loc,), "int32"),
+        tgt_src=sds((n_dev * d_loc,), "int32"),
+        tgt_dst=sds((n_dev * d_loc,), "int32"),
+        dead=sds((n_dev * (n_loc // 32),), "uint32"),
+    )

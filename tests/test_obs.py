@@ -340,8 +340,37 @@ def test_traces_jsonl_rejects_wrong_schema():
     with pytest.raises(ValueError, match="schema_version"):
         obs.parse_traces_jsonl(bumped)
     with pytest.raises(ValueError, match="missing"):
-        obs.parse_traces_jsonl('{"schema_version": 1}\n')
+        obs.parse_traces_jsonl(
+            '{"schema_version": %d}\n' % obs.TRACE_SCHEMA_VERSION)
     assert obs.parse_traces_jsonl("") == []
+
+
+def test_traces_jsonl_rejects_v1_records():
+    """Schema v2 (128-bit trace/span ids) must REJECT v1 files: the two
+    id spaces are not comparable, and silently mixing them would corrupt
+    cross-process joins in a multi-pod collector."""
+    v1 = ('{"schema_version": 1, "trace_id": 4611686018427387905, '
+          '"name": "serve.request", "t0": 0.0, "t1": 1.0, '
+          '"dropped_spans": 0, "attrs": {}, "spans": []}\n')
+    with pytest.raises(ValueError, match="schema_version 1"):
+        obs.parse_traces_jsonl(v1)
+
+
+def test_trace_ids_are_128_bit():
+    """The per-process id base carries 86 random high bits over the
+    42-bit counter — ids occupy the full 128-bit space (the schema-v2
+    collision-resistance contract for multi-process pods)."""
+    from hypergraphdb_tpu.obs import trace as trace_mod
+
+    base = trace_mod._TRACE_ID_BASE
+    assert base < (1 << 128)
+    assert base % (1 << 42) == 0       # counter bits stay clear
+    tracer, _ = make_tracer()
+    tr = tracer.start_trace("t")
+    sp = tr.start_span("s")
+    assert 0 < tr.trace_id < (1 << 128)
+    assert 0 < sp.span_id < (1 << 128)
+    tr.finish()
 
 
 def test_write_telemetry_files(tmp_path):

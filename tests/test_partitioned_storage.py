@@ -1,0 +1,157 @@
+"""Owner-map semantics of ``storage/partitioned.py``: the gid-range
+:class:`PartitionMap` (the ONE map the storage grid and the device mesh
+share) and record migration under repartitioning — gid ranges move,
+``find``/``count`` stay exact.
+"""
+
+import numpy as np
+import pytest
+
+from hypergraphdb_tpu.storage.memstore import MemStorage
+from hypergraphdb_tpu.storage.partitioned import (
+    PartitionedStorage,
+    PartitionMap,
+)
+
+
+# ---------------------------------------------------------------- the map
+
+
+def test_partition_map_ranges_cover_and_align():
+    pm = PartitionMap.for_mesh(1000, 4)
+    assert pm.part_size % PartitionMap.ALIGN == 0
+    ranges = pm.ranges()
+    assert ranges[0][0] == 0
+    for (lo, hi), (lo2, _) in zip(ranges, ranges[1:]):
+        assert hi == lo2          # contiguous, no gaps
+    assert ranges[-1][1] >= pm.capacity
+
+
+def test_partition_map_matches_sharded_snapshot_layout():
+    """The storage map IS the mesh's row split: for_mesh must reproduce
+    ShardedSnapshot.from_host's n_loc arithmetic exactly."""
+    for n_dev in (1, 2, 4, 8):
+        for n_atoms in (7, 127, 128, 1000, 99_999):
+            pm = PartitionMap.for_mesh(n_atoms + 1, n_dev)
+            n_loc = -(-(n_atoms + 1) // (n_dev * 128)) * 128
+            assert pm.part_size == n_loc, (n_dev, n_atoms)
+
+
+def test_partition_map_owner_total_and_clamped():
+    pm = PartitionMap.for_mesh(512, 4)
+    for gid in range(0, 2 * pm.n_parts * pm.part_size, 37):
+        own = pm.owner_of(gid)
+        assert 0 <= own < pm.n_parts
+        lo, hi = pm.range_of(own)
+        if own < pm.n_parts - 1:
+            assert lo <= gid < hi
+        else:
+            assert gid >= lo      # overflow ids clamp into the last range
+    with pytest.raises(ValueError):
+        pm.owner_of(-1)
+
+
+def test_partition_map_owner_np_agrees_with_scalar():
+    pm = PartitionMap.for_mesh(777, 3)
+    gids = np.arange(0, 3000, 13)
+    vec = pm.owner_np(gids)
+    assert list(vec) == [pm.owner_of(int(g)) for g in gids]
+
+
+def test_partition_map_to_dict_wire_shape():
+    d = PartitionMap.for_mesh(400, 2).to_dict()
+    assert set(d) == {"n_parts", "part_size", "capacity", "ranges"}
+    assert len(d["ranges"]) == 2
+    assert d["ranges"][0][0] == 0
+
+
+# ---------------------------------------------------------------- routing
+
+
+def _seed(store, n=300, cap=4096):
+    """Links with spread-out handles + a bidirectional index."""
+    rng = np.random.default_rng(7)
+    handles = sorted(rng.choice(cap, size=n, replace=False).tolist())
+    for h in handles:
+        store.store_link(int(h), (int(h) % 17, int(h) % 5))
+        store.store_data(int(h), f"payload-{h}".encode())
+        store.add_incidence_link(int(h), int(h) % 29)
+    idx = store.get_index("by-mod")
+    for h in handles:
+        idx.add_entry(str(int(h) % 13).encode(), int(h))
+    return handles
+
+
+def _snapshot(store, handles):
+    idx = store.get_index("by-mod", create=False)
+    return {
+        "links": {h: store.get_link(h) for h in handles},
+        "data": {h: store.get_data(h) for h in handles},
+        "inc": {h: list(store.get_incidence_set(h)) for h in handles},
+        "finds": {m: list(idx.find(str(m).encode()))
+                  for m in range(13)},
+        "counts": {m: idx.count(str(m).encode()) for m in range(13)},
+        "key_count": idx.key_count(),
+    }
+
+
+def test_gid_range_routing_places_by_owner():
+    pm = PartitionMap.for_mesh(4096, 4)
+    store = PartitionedStorage(partition_map=pm)
+    handles = _seed(store)
+    for h in handles:
+        part = pm.owner_of(h)
+        assert store._parts[part].get_link(h) is not None
+        for other in range(4):
+            if other != part:
+                assert store._parts[other].get_link(h) is None
+
+
+def test_repartition_moves_ranges_and_stays_exact():
+    """The satellite contract: re-cut the map for a grown id space —
+    records migrate to their new range owners, and every SPI read
+    (links, payloads, incidence, index find/count) answers identically
+    before and after."""
+    pm = PartitionMap.for_mesh(1024, 4)
+    store = PartitionedStorage(partition_map=pm)
+    handles = _seed(store, cap=4000)      # many ids clamp into range 3
+    before = _snapshot(store, handles)
+
+    new_map = pm.repartitioned(4096)      # the grown id space's cut
+    assert new_map.part_size != pm.part_size
+    moved = store.repartition(new_map)
+    assert moved > 0                      # ranges really moved
+
+    assert _snapshot(store, handles) == before
+    for h in handles:                     # and placement follows the NEW map
+        assert store._parts[new_map.owner_of(h)].get_link(h) is not None
+
+    # idempotent: re-running the same repartition moves nothing
+    assert store.repartition(new_map) == 0
+    assert _snapshot(store, handles) == before
+
+
+def test_repartition_requires_range_routing_and_same_owner_count():
+    legacy = PartitionedStorage(n_partitions=3)
+    with pytest.raises(ValueError, match="modulo"):
+        legacy.repartition(PartitionMap.for_mesh(100, 3))
+    pm = PartitionMap.for_mesh(100, 3)
+    ranged = PartitionedStorage(partition_map=pm)
+    with pytest.raises(ValueError, match="partition count"):
+        ranged.repartition(PartitionMap.for_mesh(100, 4))
+
+
+def test_partition_map_mismatched_children_rejected():
+    with pytest.raises(ValueError, match="owners"):
+        PartitionedStorage(
+            partitions=[MemStorage(), MemStorage()],
+            partition_map=PartitionMap.for_mesh(100, 3),
+        )
+
+
+def test_iter_record_handles_enumerates_every_record_kind():
+    m = MemStorage()
+    m.store_link(1, (2,))
+    m.store_data(9, b"x")
+    m.add_incidence_link(5, 1)
+    assert m.iter_record_handles() == {1, 9, 5}

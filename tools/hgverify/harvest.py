@@ -31,6 +31,7 @@ PRODUCT_MODULES = (
     "hypergraphdb_tpu.ops.incremental",
     "hypergraphdb_tpu.ops.serving",
     "hypergraphdb_tpu.ops.join",
+    "hypergraphdb_tpu.ops.sharded_serving",
     "hypergraphdb_tpu.parallel.sharded",
 )
 
