@@ -419,19 +419,71 @@ def host_cost_bytes(graph, fallback_plan) -> float:
 
 class DeviceJoinPlan:
     """``query/compiler.Plan`` for a single-variable conjunctive pattern
-    (``And(CoIncident+, Incident*, [AtomType])``) answered by the
-    multiway-intersection executor. Cost-based at run time, the
-    ``DeviceValueConjPlan`` discipline: small inputs and device-hostile
-    states (stale anchors, pending deletes) take the classic host
-    ``fallback``; fresh link ingest is corrected host-side over the
-    memtable, exact at any lag."""
+    (``And(CoIncident+, Incident*, [AtomType], [AtomValue{1,2}])``)
+    answered by the multiway-intersection executor. Cost-based at run
+    time, the ``DeviceValueConjPlan`` discipline: small inputs and
+    device-hostile states (stale anchors, pending deletes) take the
+    classic host ``fallback``; fresh link ingest is corrected host-side
+    over the memtable, exact at any lag. ``value_conds`` push down as
+    rank-window filters on the executor's intersection candidates
+    (``ops/join.execute_join`` ``value_windows`` — the hgindex hook);
+    variable-width value kinds decline to the host plan (rank ties)."""
 
-    def __init__(self, pattern: ConjunctivePattern, fallback):
+    def __init__(self, pattern: ConjunctivePattern, fallback,
+                 value_conds=()):
         self.pattern = pattern
         self.fallback = fallback
+        self.value_conds = tuple(value_conds)
         sig, consts = split_constants(pattern)
         self.sig = sig
         self.consts = consts
+
+    def _value_window(self, graph):
+        """The executor window for ``value_conds`` —
+        ``(kind, lo_rank, lo_op, hi_rank, hi_op)`` — or None for no
+        conditions; raises ``JoinUnsupported`` for shapes the rank
+        compare cannot serve exactly. The kind/rank/exactness rules are
+        NOT re-implemented here: the conds fold into bounds and
+        ``query/bridge.to_range_request`` (the one owner of those rules)
+        derives the window — so the join pushdown and the range serve
+        lane can never diverge on which predicates are device-exact."""
+        if not self.value_conds:
+            return None
+        from hypergraphdb_tpu.query.bridge import to_range_request
+        from hypergraphdb_tpu.serve.types import Unservable
+
+        lo = hi = None
+        lo_op, hi_op = "gte", "lte"
+        for vc in self.value_conds:
+            if vc.op == "eq":
+                if lo is not None or hi is not None:
+                    raise JoinUnsupported("eq beside another bound")
+                lo = hi = vc.value
+            elif vc.op in ("gt", "gte"):
+                if lo is not None:
+                    raise JoinUnsupported("two lower bounds")
+                lo, lo_op = vc.value, vc.op
+            elif vc.op in ("lt", "lte"):
+                if hi is not None:
+                    raise JoinUnsupported("two upper bounds")
+                hi, hi_op = vc.value, vc.op
+            else:
+                raise JoinUnsupported(f"value op {vc.op!r}")
+        try:
+            req = to_range_request(graph, lo, hi, lo_op=lo_op, hi_op=hi_op)
+        except Unservable as e:
+            raise JoinUnsupported(str(e)) from e
+        if not req.exact:
+            raise JoinUnsupported(
+                "variable-width value kind: rank windows tie"
+            )
+        return (
+            req.dim,
+            req.lo_rank,
+            req.lo_op if lo is not None else None,
+            req.hi_rank,
+            req.hi_op if hi is not None else None,
+        )
 
     def run(self, graph):
         import numpy as np
@@ -465,6 +517,7 @@ class DeviceJoinPlan:
             return self.fallback.run(graph)
         tracer = global_tracer()
         try:
+            vwin = self._value_window(graph)
             with tracer.span("join.plan"):
                 plan = plan_join(snap, self.pattern, self.sig, self.consts)
             from hypergraphdb_tpu.ops.join import (
@@ -491,6 +544,8 @@ class DeviceJoinPlan:
                     # honest prefix: exact pads and roomy caps (one
                     # lane — the slot budget still bounds peak memory)
                     var_pad_max=True, pad_cap=1 << 18, row_cap=1 << 20,
+                    value_windows=(None if vwin is None
+                                   else {plan.order[0]: vwin}),
                 )
                 if bool(np.asarray(out.trunc)[0]):
                     # a capped device run is a PREFIX; one-shot find_all
@@ -512,7 +567,11 @@ class DeviceJoinPlan:
         fresh = _memtable_candidates(graph, new_atoms, revalued, dead)
         if fresh:
             cond = _single_var_condition(self.pattern)
-            extra = [h for h in fresh if cond.satisfies(graph, h)]
+            extra = [
+                h for h in fresh
+                if cond.satisfies(graph, h)
+                and all(vc.satisfies(graph, h) for vc in self.value_conds)
+            ]
             if extra:
                 arr = np.union1d(arr, np.asarray(extra, dtype=np.int64))
         return arr
@@ -557,9 +616,12 @@ def _single_var_condition(pattern: ConjunctivePattern):
     return cond
 
 
-def try_single_var_join(graph, clauses, fallback):
+def try_single_var_join(graph, clauses, fallback, value_conds=()):
     """Build the single-variable pattern for ``translate()``'s
-    ``And(CoIncident+, ...)`` hook — None when extraction declines."""
+    ``And(CoIncident+, ...)`` hook — None when extraction declines.
+    ``value_conds`` (AtomValue clauses the caller split off) ride the
+    plan as executor rank-window filters; shapes the window cannot
+    serve exactly decline to the fallback at run time."""
     from hypergraphdb_tpu.join.ir import extract_pattern
     from hypergraphdb_tpu.query import conditions as c
 
@@ -575,4 +637,4 @@ def try_single_var_join(graph, clauses, fallback):
         return None
     if not any(not a.key_is_var for a in pattern.atoms):
         return None  # no constant anchor: nothing to seed from
-    return DeviceJoinPlan(pattern, fallback)
+    return DeviceJoinPlan(pattern, fallback, value_conds=value_conds)

@@ -245,6 +245,10 @@ class SnapshotManager:
         self._sharded_epoch = -1
         self._sharded_delta = None
         self._sharded_marker = (-1, -1, -1)
+        # hgindex delta columns (storage/value_index): kind -> the cached
+        # memtable column, refreshed under the same max_lag_edges drift
+        # discipline as the device delta (see value_delta)
+        self._value_delta: dict = {}
         graph.events.add_listener(ev.HGAtomAddedEvent, self._on_added)
         graph.events.add_listener(ev.HGAtomRemovedEvent, self._on_removed)
         graph.events.add_listener(ev.HGAtomReplacedEvent, self._on_replaced)
@@ -368,6 +372,7 @@ class SnapshotManager:
             self._revalued -= ext["revalued_at_extract"]
             self._delta_dirty = True
             self._uploaded_atoms = 0  # new epoch: nothing uploaded yet
+            self._value_delta.clear()  # stale epoch: columns rebuild lazily
             self.compactions += 1
 
     def _compact_sync(self) -> None:
@@ -632,6 +637,41 @@ class SnapshotManager:
                     sharded_base=sbase,
                     sharded_delta=sdelta,
                 )
+
+    def value_delta(self, view: "PinnedView", kind: int,
+                    max_lag_edges: int = 0):
+        """The hgindex DELTA column for one pinned view and value kind
+        (``storage/value_index.ValueIndexColumn``): memtable atoms of
+        that kind, sorted and device-resident, covering a PREFIX of the
+        view's ``new_atoms`` capture — never more (a column built from a
+        later memtable would leak post-pin atoms into the batch), so a
+        cached column is reused only while its coverage deficit against
+        THIS view stays within ``max_lag_edges`` (the same bounded-drift
+        dial as the BFS device delta). The residual
+        ``view.new_atoms[col.covered:]`` plus ``view.revalued`` is the
+        host correction the collect path owes.
+
+        Built OUTSIDE the manager lock (value-key extraction walks the
+        store, like ``_capture_candidates``); the cache swap re-checks
+        coverage so concurrent builders keep the widest column."""
+        from hypergraphdb_tpu.storage.value_index import build_delta_column
+
+        kind = int(kind)
+        n_view = len(view.new_atoms)
+        with self._lock:
+            cached = self._value_delta.get(kind)
+        if (cached is not None and cached.epoch == view.epoch
+                and cached.covered <= n_view
+                and n_view - cached.covered <= max_lag_edges):
+            return cached
+        col = build_delta_column(self.graph, view.new_atoms, kind,
+                                 epoch=view.epoch)
+        with self._lock:
+            prev = self._value_delta.get(kind)
+            if (prev is None or prev.epoch != view.epoch
+                    or prev.covered < col.covered):
+                self._value_delta[kind] = col
+        return col
 
     def wait_compacted(self, timeout: Optional[float] = None) -> bool:
         """Block until no compaction pass is in flight (bounded by

@@ -206,7 +206,7 @@ def _member_elementwise(flat, starts, ends, queries):
 )
 @partial(jax.jit, static_argnames=(
     "exp_sel", "filt_sel", "type_handle", "pad", "rows_out", "n_lanes",
-    "n_distinct_cols", "distinct_consts", "dedupe",
+    "n_distinct_cols", "distinct_consts", "dedupe", "value_ops",
 ))
 def join_expand_step(
     exp_offsets: jax.Array,   # (N+2,) int32 — expansion CSR offsets
@@ -218,6 +218,8 @@ def join_expand_step(
     filt_offsets: tuple,      # one (N+2,) per membership filter
     filt_flats: tuple,        # one (E',) per membership filter
     type_of: jax.Array,       # (N+1,) int32
+    value_cols: Optional[tuple] = None,  # (rank_hi, rank_lo, kind) (N+1,)
+    value_win: Optional[jax.Array] = None,  # (5,) uint32: kind + bound words
     *,
     exp_sel: tuple,           # ("col", j) | ("const", slot)
     filt_sel: tuple,          # ((rev, "col"|"const", idx), ...)
@@ -228,6 +230,10 @@ def join_expand_step(
     n_distinct_cols: int,     # earlier columns candidates must differ from
     distinct_consts: bool,    # candidates must differ from every constant
     dedupe: bool,             # expansion rows may repeat values (tgt)
+    value_ops: Optional[tuple] = None,  # (lo_op|None, hi_op|None) — a
+    # value-rank window on THIS step's candidates (the hgindex planner
+    # hook: a value predicate pruning the intersection instead of
+    # post-filtering the result); None keeps the trace unchanged
 ) -> tuple:
     """Bind ONE variable for every binding row of a K-request batch:
     expand candidates from the keyed CSR row, leapfrog-intersect against
@@ -293,6 +299,28 @@ def join_expand_step(
             )
     if type_handle >= 0:
         cmask = cmask & (type_of[safe] == type_handle)
+    if value_ops is not None:
+        # rank-window leapfrog: gather each candidate's order-preserving
+        # value rank words + kind byte and compare against the window —
+        # pure vector compute, applied BEFORE compaction so out-of-range
+        # candidates never occupy binding rows (``ops/setops``'s rank
+        # convention: 64-bit ranks as two uint32 words, hi then lo;
+        # cross-kind comparisons are always False)
+        vh = value_cols[0][safe]
+        vl = value_cols[1][safe]
+        vk = value_cols[2][safe].astype(jnp.uint32)
+        cmask = cmask & (vk == value_win[0])
+        lo_op, hi_op = value_ops
+        if lo_op is not None:
+            gt = (vh > value_win[1]) | ((vh == value_win[1])
+                                        & (vl > value_win[2]))
+            eq = (vh == value_win[1]) & (vl == value_win[2])
+            cmask = cmask & (gt | eq if lo_op == "gte" else gt)
+        if hi_op is not None:
+            gt = (vh > value_win[3]) | ((vh == value_win[3])
+                                        & (vl > value_win[4]))
+            eq = (vh == value_win[3]) & (vl == value_win[4])
+            cmask = cmask & (~gt if hi_op == "lte" else ~gt & ~eq)
     for j in range(n_distinct_cols):
         cmask = cmask & (cand != cols[:, j, None])
     if distinct_consts:
@@ -439,6 +467,7 @@ def execute_join(
     var_pad_max: bool = False,
     n_real: Optional[int] = None,
     slot_budget: int = DEFAULT_SLOT_BUDGET,
+    value_windows: Optional[dict] = None,
 ) -> JoinExecution:
     """Run ``plan`` for K same-signature requests in one batched pass —
     async (no host sync; every return field is a device handle).
@@ -454,7 +483,16 @@ def execute_join(
 
     ``seeds`` replaces the first step: the given ids become the var-0
     binding column of ONE request lane (the benchmark's global-counting
-    mode — chunk the id space, sum the counts)."""
+    mode — chunk the id space, sum the counts).
+
+    ``value_windows`` maps a plan variable to a value-rank window
+    ``(kind, lo_rank, lo_op, hi_rank, hi_op)`` (64-bit ranks, ops
+    gt/gte/lt/lte, None = open) applied as a candidate filter INSIDE the
+    step binding that variable — the hgindex planner hook: a value
+    predicate prunes the intersection instead of post-filtering, so
+    out-of-window candidates never cost binding rows. Callers own kind
+    exactness (fixed-width kinds only; rank ties on variable-width kinds
+    would silently drop true matches)."""
     dev = snap.device
     K, A = (int(consts.shape[0]), int(consts.shape[1]))
     consts = np.ascontiguousarray(consts, dtype=np.int32)
@@ -481,6 +519,7 @@ def execute_join(
     counts = (jnp.zeros(K, jnp.int32).at[lanes].add(valid.astype(jnp.int32))
               if seeds is not None and not steps
               else jnp.zeros(K, jnp.int32))
+    vwindows = value_windows or {}
     for s in steps:
         R = int(cols.shape[0])
         if s.source_key.kind == "const":
@@ -518,9 +557,24 @@ def execute_join(
             filt_offs.append(fo)
             filt_flats.append(ff)
         n_dist = int(cols.shape[1]) if plan.distinct else 0
+        win = vwindows.get(s.var)
+        vcols = vwin = None
+        vops = None
+        if win is not None:
+            kind, lo_r, lo_op, hi_r, hi_op = win
+            vcols = (dev.value_rank_hi, dev.value_rank_lo, dev.value_kind)
+            words = np.asarray(
+                [int(kind),
+                 (lo_r or 0) >> 32, (lo_r or 0) & 0xFFFFFFFF,
+                 (hi_r or 0) >> 32, (hi_r or 0) & 0xFFFFFFFF],
+                dtype=np.uint64,
+            ).astype(np.uint32)
+            vwin = jnp.asarray(words)
+            vops = (lo_op, hi_op)
         cols, lanes, valid, counts, step_trunc = join_expand_step(
             exp_off, exp_flat, cols, lanes, valid, consts_dev,
             tuple(filt_offs), tuple(filt_flats), dev.type_of,
+            vcols, vwin,
             exp_sel=(s.source_key.kind, s.source_key.index),
             filt_sel=tuple(filt_sel),
             type_handle=(-1 if s.type_handle is None
@@ -529,6 +583,7 @@ def execute_join(
             n_distinct_cols=n_dist,
             distinct_consts=plan.distinct and A > 0,
             dedupe=s.dedupe,
+            value_ops=vops,
         )
         trunc = trunc | step_trunc
     out = JoinExecution(order=plan.order, counts=counts, trunc=trunc)

@@ -1,0 +1,239 @@
+"""hgindex kernels: batched range / ordered / top-k over sorted value columns.
+
+The device replacement for the host value scan — the last query class the
+serve tier still answered by walking the by-value B-tree host-side.
+Against a ``storage/value_index.ValueIndexColumn`` (per-kind device
+columns sorted by ``(rank, gid)``) a range predicate is two vectorized
+binary searches and an ordered/top-k request is a bounded gather off the
+window's relevant end — the same sorted-row machinery ``ops/setops``
+exploits for intersections, pointed at the VALUE dimension (role-free
+indexing, PAPERS.md arXiv:0811.1083).
+
+Two entry points, both K-lane padded like ``ops/serving.bfs_serve_batch``
+(pad lanes carry empty windows — well-defined garbage the runtime drops
+by lane index):
+
+- :func:`range_probe_batch` — per-lane lexicographic ``searchsorted``
+  of the (hi, lo) rank-word bounds over one sorted column; returns the
+  ``[lo_idx, hi_idx)`` window per lane (``hi_idx - lo_idx`` is the exact
+  unfiltered count).
+- :func:`ordered_topk_batch` — range probe over the base AND delta
+  columns, bounded candidate gathers (window start for ascending lanes,
+  window end for descending), per-lane type and incident-anchor filters
+  (the anchor filter is ``setops.segment_member_mask`` against the
+  incidence CSR — a value predicate used as a join-atom filter), then an
+  on-device merge of the two sorted windows into the ``top_r``
+  smallest/largest gids per lane. Truncation-honest: ``covered`` flags
+  lanes whose whole window fit the gather pad (counts exact under
+  filters); an uncovered+filtered lane is re-served exactly on host.
+
+Rank-word convention throughout: 64-bit ranks ride as two uint32 words
+compared lexicographically hi-then-lo (``ops/snapshot.DeviceSnapshot``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from hypergraphdb_tpu import verify as hgverify
+from hypergraphdb_tpu.ops.setops import SENTINEL, segment_member_mask
+
+#: uint32 all-ones — the rank-word pad and the descending-order complement
+_U32_MAX = jnp.uint32(0xFFFFFFFF)
+
+
+def _searchsorted2(col_hi: jax.Array, col_lo: jax.Array, n_real: jax.Array,
+                   q_hi: jax.Array, q_lo: jax.Array,
+                   right: jax.Array) -> jax.Array:
+    """Branchless per-lane binary search of (hi, lo) rank-word queries
+    over one sorted 2-word column, bounded by the column's REAL length
+    (pad entries are never probed). ``right`` selects the insertion side
+    per lane: False = leftmost position (ties insert before), True =
+    rightmost (ties insert after) — how inclusive/exclusive bounds become
+    pure data instead of program variants. 32 rounds bound any
+    int32-indexed column (the ``setops.segment_member_mask``
+    discipline)."""
+    m_max = col_hi.shape[0] - 1
+    lo = jnp.zeros(q_hi.shape, dtype=jnp.int32)
+    hi = jnp.broadcast_to(n_real.astype(jnp.int32), q_hi.shape)
+
+    def body(_, state):
+        lo, hi = state
+        active = lo < hi
+        mid = (lo + hi) >> 1
+        m = jnp.minimum(mid, m_max)
+        vh = col_hi[m]
+        vl = col_lo[m]
+        less = (vh < q_hi) | ((vh == q_hi) & (vl < q_lo))
+        eq = (vh == q_hi) & (vl == q_lo)
+        go_right = less | (right & eq)
+        lo = jnp.where(active & go_right, mid + 1, lo)
+        hi = jnp.where(active & ~go_right, mid, hi)
+        return lo, hi
+
+    lo, hi = jax.lax.fori_loop(0, 32, body, (lo, hi))
+    return lo
+
+
+@hgverify.entry(
+    shapes=lambda: (hgverify.sds((64,), "uint32"),
+                    hgverify.sds((64,), "uint32"),
+                    hgverify.sds((), "int32"),
+                    hgverify.sds((8,), "uint32"), hgverify.sds((8,), "uint32"),
+                    hgverify.sds((8,), "bool"),
+                    hgverify.sds((8,), "uint32"), hgverify.sds((8,), "uint32"),
+                    hgverify.sds((8,), "bool")),
+)
+@jax.jit
+def range_probe_batch(
+    col_hi: jax.Array,    # (M,) uint32 — sorted column, rank high words
+    col_lo: jax.Array,    # (M,) uint32 — rank low words
+    n_real: jax.Array,    # scalar int32 — real (unpadded) entries
+    lo_hi: jax.Array,     # (K,) uint32 — per-lane lower-bound rank words
+    lo_lo: jax.Array,
+    lo_right: jax.Array,  # (K,) bool — True = exclusive lower (gt)
+    hi_hi: jax.Array,     # (K,) uint32 — per-lane upper-bound rank words
+    hi_lo: jax.Array,
+    hi_right: jax.Array,  # (K,) bool — True = inclusive upper (lte)
+) -> tuple[jax.Array, jax.Array]:
+    """K range windows over ONE sorted column in a single launch:
+    returns ``(lo_idx, hi_idx)`` (K,) int32 each, clamped so
+    ``hi_idx >= lo_idx`` — the exact unfiltered per-lane count is their
+    difference, and the pair addresses the gather the ordered kernel (or
+    a counting caller, which downloads 2·K int32 and nothing else)
+    performs. Pad lanes: pass equal bounds (empty window)."""
+    lo_idx = _searchsorted2(col_hi, col_lo, n_real, lo_hi, lo_lo, lo_right)
+    hi_idx = _searchsorted2(col_hi, col_lo, n_real, hi_hi, hi_lo, hi_right)
+    return lo_idx, jnp.maximum(hi_idx, lo_idx)
+
+
+def _window_gather(col_hi, col_lo, col_gid, lo_idx, hi_idx, desc, win_pad):
+    """Gather up to ``win_pad`` entries per lane off each window's
+    RELEVANT end (start for ascending lanes, end for descending) —
+    whichever end the top-k lives at. Returns (kh, kl, gid, valid)
+    of shape (K, win_pad)."""
+    m_max = col_hi.shape[0] - 1
+    width = hi_idx - lo_idx
+    take = jnp.minimum(width, win_pad)
+    start = jnp.where(desc, hi_idx - take, lo_idx)
+    lane_ix = jnp.arange(win_pad, dtype=jnp.int32)
+    idx = start[:, None] + lane_ix[None, :]
+    valid = lane_ix[None, :] < take[:, None]
+    idx = jnp.minimum(jnp.where(valid, idx, 0), m_max)
+    return col_hi[idx], col_lo[idx], col_gid[idx], valid
+
+
+@hgverify.entry(
+    shapes=lambda: (
+        (hgverify.sds((64,), "uint32"), hgverify.sds((64,), "uint32"),
+         hgverify.sds((64,), "int32"), hgverify.sds((), "int32"),
+         hgverify.sds((32,), "uint32"), hgverify.sds((32,), "uint32"),
+         hgverify.sds((32,), "int32"), hgverify.sds((), "int32"),
+         hgverify.sds((33,), "int32"),
+         hgverify.sds((33,), "int32"), hgverify.sds((64,), "int32"),
+         hgverify.sds((8,), "uint32"), hgverify.sds((8,), "uint32"),
+         hgverify.sds((8,), "bool"),
+         hgverify.sds((8,), "uint32"), hgverify.sds((8,), "uint32"),
+         hgverify.sds((8,), "bool"),
+         hgverify.sds((8,), "int32"), hgverify.sds((8,), "int32"),
+         hgverify.sds((8,), "bool")),
+        {},
+    ),
+    statics={"win_pad": 8, "top_r": 4},
+)
+@partial(jax.jit, static_argnames=("win_pad", "top_r"))
+def ordered_topk_batch(
+    col_hi: jax.Array,    # base column (storage/value_index layout)
+    col_lo: jax.Array,
+    col_gid: jax.Array,
+    n_base: jax.Array,    # scalar int32
+    d_hi: jax.Array,      # delta column (same layout, may be all-pad)
+    d_lo: jax.Array,
+    d_gid: jax.Array,
+    n_delta: jax.Array,   # scalar int32
+    type_of: jax.Array,   # (N+1,) int32 — per-atom type handles
+    inc_offsets: jax.Array,  # (N+2,) int32 — incidence CSR (anchor filter)
+    inc_links: jax.Array,    # (E,) int32
+    lo_hi: jax.Array,     # per-lane bounds, range_probe_batch conventions
+    lo_lo: jax.Array,
+    lo_right: jax.Array,
+    hi_hi: jax.Array,
+    hi_lo: jax.Array,
+    hi_right: jax.Array,
+    type_vec: jax.Array,  # (K,) int32 — per-lane type handle, <0 = any
+    anchor_vec: jax.Array,  # (K,) int32 — per-lane incident anchor, <0 = none
+    desc: jax.Array,      # (K,) bool — True = top-k LARGEST values
+    win_pad: int,         # candidate gather width per column (>= top_r)
+    top_r: int,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Range probe → filter → merged top-k, K lanes in one launch.
+
+    Returns ``(counts, first_r, covered, window_total)``:
+
+    - ``window_total`` (K,) int32 — the exact UNFILTERED window size
+      (base + delta), straight off the probes;
+    - ``covered`` (K,) bool — both windows fit the gather pad, so the
+      filtered ``counts`` are exact and ``first_r`` is the complete
+      filtered set's prefix; an UNcovered lane is exact only without
+      filters (then ``window_total`` is its count and ``first_r`` its
+      honest value-ordered prefix — valid because a merge of each
+      column's first/last ``win_pad`` dominates any global top-k of
+      ``top_r <= win_pad``);
+    - ``counts`` (K,) int32 — filtered survivors among gathered
+      candidates;
+    - ``first_r`` (K, top_r) int32 — gids in the REQUESTED value order
+      (ascending rank for ``desc=False`` lanes, descending for
+      ``desc=True``; rank ties break toward the smaller gid either way),
+      ``SENTINEL``-padded past the count.
+    """
+    if win_pad < top_r:
+        raise ValueError(f"win_pad {win_pad} < top_r {top_r}: the merged "
+                         "prefix could miss global top-k entries")
+    lo_b, hi_b = range_probe_batch(col_hi, col_lo, n_base,
+                                   lo_hi, lo_lo, lo_right,
+                                   hi_hi, hi_lo, hi_right)
+    lo_d, hi_d = range_probe_batch(d_hi, d_lo, n_delta,
+                                   lo_hi, lo_lo, lo_right,
+                                   hi_hi, hi_lo, hi_right)
+    window_total = (hi_b - lo_b) + (hi_d - lo_d)
+    covered = ((hi_b - lo_b) <= win_pad) & ((hi_d - lo_d) <= win_pad)
+
+    bh, bl, bg, bv = _window_gather(col_hi, col_lo, col_gid,
+                                    lo_b, hi_b, desc, win_pad)
+    dh, dl, dg, dv = _window_gather(d_hi, d_lo, d_gid,
+                                    lo_d, hi_d, desc, win_pad)
+    kh = jnp.concatenate([bh, dh], axis=1)
+    kl = jnp.concatenate([bl, dl], axis=1)
+    gid = jnp.concatenate([bg, dg], axis=1)
+    valid = jnp.concatenate([bv, dv], axis=1)
+
+    n1 = type_of.shape[0]
+    safe = jnp.clip(gid, 0, n1 - 1)
+    want = type_vec[:, None]
+    valid = valid & ((want < 0) | (type_of[safe] == want))
+    # incident-anchor filter: candidate ∈ inc_row(anchor), the in-place
+    # segment search of the pattern lanes — a value window acting as a
+    # filter on join-atom candidates (and vice versa)
+    anchor = jnp.where(anchor_vec < 0, n1 - 1, anchor_vec)  # dummy row
+    probe = jnp.where(valid, gid, SENTINEL)
+    member = segment_member_mask(
+        inc_links, inc_offsets[anchor], inc_offsets[anchor + 1], probe
+    )
+    valid = valid & ((anchor_vec < 0)[:, None] | member)
+
+    counts = valid.sum(axis=1).astype(jnp.int32)
+    # requested order as a pure key transform: complement the rank words
+    # on descending lanes (uint32 bitwise not reverses order); gids stay
+    # ascending so rank ties break identically either way. Invalid slots
+    # get max keys AFTER the transform so they sort last everywhere.
+    flip = desc[:, None]
+    kh = jnp.where(flip, ~kh, kh)
+    kl = jnp.where(flip, ~kl, kl)
+    kh = jnp.where(valid, kh, _U32_MAX)
+    kl = jnp.where(valid, kl, _U32_MAX)
+    gid = jnp.where(valid, gid, SENTINEL)
+    _, _, sorted_gid = jax.lax.sort((kh, kl, gid), num_keys=3, dimension=1)
+    return counts, sorted_gid[:, :top_r], covered, window_total

@@ -437,8 +437,12 @@ class IntersectPlan(Plan):
 
 
 #: value kinds whose key payload is fixed-width ≤ 8 bytes — their 64-bit
-#: payload rank IS the value order (device compares are exact, no ties)
-_FIXED_WIDTH_KINDS = frozenset(b"ifbt")
+#: payload rank IS the value order (device compares are exact, no ties);
+#: the ONE definition lives at the storage layer beside the sorted
+#: columns it governs (``storage/value_index``)
+from hypergraphdb_tpu.storage.value_index import (  # noqa: E402
+    FIXED_WIDTH_KINDS as _FIXED_WIDTH_KINDS,
+)
 
 
 @dataclass
@@ -1241,20 +1245,29 @@ def _try_value_pushdown(graph, clauses: Sequence[c.HGQueryCondition]
 
 def _try_join_pushdown(graph, clauses: Sequence[c.HGQueryCondition]
                        ) -> Optional[Plan]:
-    """Recognize ``And(CoIncident+, [Incident*], [AtomType])`` — a
-    single-variable conjunctive PATTERN (common neighbours, anchored
-    adjacency) — and hand it to the join planner's cost-based device
-    plan (``join/planner.DeviceJoinPlan``). The join plan carries the
-    classic host translation as its fallback and compares costs at run
-    time, so ``translate()`` stays the one arbiter between the
-    ``IntersectPlan``/``PipePlan`` host family and the multiway-
-    intersection executor. Any clause outside the pattern vocabulary →
-    None (generic planning)."""
+    """Recognize ``And(CoIncident+, [Incident*], [AtomType],
+    [AtomValue{1,2}])`` — a single-variable conjunctive PATTERN (common
+    neighbours, anchored adjacency), optionally VALUE-constrained — and
+    hand it to the join planner's cost-based device plan
+    (``join/planner.DeviceJoinPlan``). Value predicates ride the
+    executor as rank-window filters on the intersection candidates
+    (``ops/join.execute_join``'s ``value_windows`` — the hgindex planner
+    hook), pruning binding rows instead of post-filtering. The join plan
+    carries the classic host translation as its fallback and compares
+    costs at run time, so ``translate()`` stays the one arbiter between
+    the ``IntersectPlan``/``PipePlan`` host family and the multiway-
+    intersection executor. Any clause outside the vocabulary → None
+    (generic planning)."""
     if not graph.config.query.prefer_device:
         return None
     if not any(isinstance(cl, c.CoIncident) for cl in clauses):
         return None
+    structural: list[c.HGQueryCondition] = []
+    value_conds: list[c.AtomValue] = []
     for cl in clauses:
+        if isinstance(cl, c.AtomValue):
+            value_conds.append(cl)
+            continue
         if not isinstance(cl, (c.CoIncident, c.Incident, c.AtomType)):
             return None
         if isinstance(cl, (c.CoIncident, c.Incident)):
@@ -1264,10 +1277,14 @@ def _try_join_pushdown(graph, clauses: Sequence[c.HGQueryCondition]
             except (TypeError, ValueError):
                 return None  # unbound Var: multi-variable specs go
                              # through join.extract_pattern, not here
+        structural.append(cl)
+    if len(value_conds) > 2:
+        return None
     from hypergraphdb_tpu.join.planner import try_single_var_join
 
     return try_single_var_join(
-        graph, clauses, fallback=_translate_and(graph, clauses)
+        graph, structural, fallback=_translate_and(graph, clauses),
+        value_conds=value_conds,
     )
 
 

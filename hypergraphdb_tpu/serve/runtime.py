@@ -70,6 +70,7 @@ from hypergraphdb_tpu.serve.types import (
     JoinRequest,
     JoinResult,
     PatternRequest,
+    RangeRequest,
     ServeResult,
     Ticket,
     Unservable,
@@ -124,6 +125,15 @@ class ServeConfig:
     #: deadline window after every compaction. Opt-in: BFS/pattern-only
     #: tiers should not pay it.
     prewarm_join_nbr: bool = False
+    #: value DIMENSIONS (kind bytes, e.g. ``(ord("i"),)``) whose sorted
+    #: index columns build + upload at startup, with the range-lane
+    #: executables warmed per bucket when an AOT cache is configured —
+    #: the hgindex half of the cold-start story (done lazily, the
+    #: O(N log N) column sort + compile land on the dispatch thread
+    #: inside the first range batch's deadline window; they still do
+    #: after each compaction epoch, the same accepted cost class as the
+    #: sharded base re-shard). Opt-in like ``prewarm_join_nbr``.
+    prewarm_range_dims: tuple = ()
     # -- multi-chip serving (serve/sharded + ops/sharded_serving) ------------
     #: True routes serve buckets through the mesh-sharded executor;
     #: False pins single-chip; None = AUTO — sharded exactly when more
@@ -137,6 +147,14 @@ class ServeConfig:
     hbm_budget_bytes: Optional[int] = None
     #: cap on mesh devices (None = every visible device)
     mesh_devices: Optional[int] = None
+
+
+def _dummy_inc_csr():
+    """The anchor-free range dispatch's stand-in incidence CSR: empty
+    segments whatever index the (masked-off) probe clamps to."""
+    import jax.numpy as jnp
+
+    return jnp.zeros((2,), jnp.int32), jnp.zeros((8,), jnp.int32)
 
 
 @dataclass
@@ -161,6 +179,10 @@ class LaunchedBatch:
     #: collect needs its column order to permute tuples back into the
     #: request's variable order
     join_plan: object = None
+    #: range batches: how many leading entries of the view's
+    #: ``new_atoms`` the dispatched delta column covered — the collect
+    #: residual (``new_atoms[covered:]``) the host correction owes
+    range_covered: int = 0
     #: double-buffer slot of this dispatch (dispatch sequence mod 2) —
     #: rides the ``device`` span and the profiler annotation so device
     #: time is attributable per pipeline slot
@@ -322,6 +344,54 @@ class DeviceExecutor:
             return compiled(*args)
         return pattern_serve_batch(*args, **statics)
 
+    def _serve_range(self, view, bcol, dcol, bounds: dict):
+        """One range batch dispatch (``ops/value_index.ordered_topk_batch``
+        over the base + delta value columns), through the AOT cache when
+        configured. ``bounds`` carries the per-lane host numpy arrays the
+        launch loop assembled."""
+        import jax.numpy as jnp
+
+        from hypergraphdb_tpu.ops.value_index import ordered_topk_batch
+        from hypergraphdb_tpu.storage.value_index import (
+            inc_csr_device,
+            type_of_device,
+        )
+
+        if (bounds["anchor"] >= 0).any():
+            inc_off, inc_links = inc_csr_device(view.base)
+        else:
+            # anchor-free batch (the steady shape): never materialize the
+            # O(E) incidence CSR on device just to satisfy the kernel
+            # signature — a tiny dummy CSR yields empty segments, and
+            # every anchor_vec<0 lane masks the probe out anyway (a
+            # second shape-keyed program, warmed as THE range program)
+            inc_off, inc_links = _dummy_inc_csr()
+        args = (
+            bcol.rank_hi, bcol.rank_lo, bcol.gids, jnp.int32(bcol.n),
+            dcol.rank_hi, dcol.rank_lo, dcol.gids, jnp.int32(dcol.n),
+            type_of_device(view.base), inc_off, inc_links,
+            jnp.asarray(bounds["lo_hi"]), jnp.asarray(bounds["lo_lo"]),
+            jnp.asarray(bounds["lo_right"]),
+            jnp.asarray(bounds["hi_hi"]), jnp.asarray(bounds["hi_lo"]),
+            jnp.asarray(bounds["hi_right"]),
+            jnp.asarray(bounds["type_vec"]), jnp.asarray(bounds["anchor"]),
+            jnp.asarray(bounds["desc"]),
+        )
+        statics = {"win_pad": self._range_win_pad(),
+                   "top_r": self.config.top_r}
+        compiled = self._aot_dispatch("ops.value_index.ordered_topk_batch",
+                                      ordered_topk_batch, args, statics)
+        if compiled is not None:
+            return compiled(*args)
+        return ordered_topk_batch(*args, **statics)
+
+    def _range_win_pad(self) -> int:
+        """Candidate gather width per column: the smallest power-of-two
+        bucket holding ``top_r`` (the kernel's prefix-dominance floor)."""
+        from hypergraphdb_tpu.ops.setops import _bucket
+
+        return _bucket(self.config.top_r, minimum=8)
+
     def _pattern_gate(self, view):
         """The pattern lanes' device-path gate: an opaque handle the
         dispatch needs (the base's ELL targets here), or None → every
@@ -378,6 +448,20 @@ class DeviceExecutor:
                 neighbor_csr_device(self.mgr.base)
             except Exception:  # noqa: BLE001 - never block startup
                 pass
+        range_dims = tuple(self.config.prewarm_range_dims or ())
+        if range_dims:
+            # the range lane's sorted columns (+ per-bucket executables
+            # below): first dispatch must not pay the O(N log N) column
+            # sort on the dispatch thread
+            from hypergraphdb_tpu.storage.value_index import (
+                value_index_column,
+            )
+
+            for dim in range_dims:
+                try:
+                    value_index_column(self.mgr.base, int(dim))
+                except Exception:  # noqa: BLE001 - never block startup
+                    pass
         if self.aot is None and not (self.config.use_pallas_bfs
                                      and _pbfs.pallas_bfs_ok()):
             # nothing to warm: no cache to load, and the fused path (the
@@ -406,6 +490,16 @@ class DeviceExecutor:
             from hypergraphdb_tpu.ops.setops import ell_targets
 
             ell = ell_targets(view.base)
+        warm_dims = range_dims if self.aot is not None else ()
+        if warm_dims:
+            from hypergraphdb_tpu.storage.value_index import (
+                build_delta_column,
+                type_of_device,
+            )
+
+            # one empty delta column serves every warmed (dim, bucket):
+            # the executable depends on shapes, not contents
+            empty_delta = build_delta_column(self.graph, [], 0, epoch=-1)
         warm = 0
         for b in buckets:
             seeds = jnp.full((int(b),), n, dtype=jnp.int32)
@@ -433,6 +527,37 @@ class DeviceExecutor:
                         )
                     except Exception:  # noqa: BLE001 - never block startup
                         continue
+            for dim in warm_dims:
+                from hypergraphdb_tpu.ops.value_index import (
+                    ordered_topk_batch,
+                )
+                from hypergraphdb_tpu.storage.value_index import (
+                    value_index_column,
+                )
+
+                try:
+                    bcol = value_index_column(view.base, int(dim))
+                    # warm the ANCHOR-FREE program — the steady shape
+                    # (anchored batches carry the real incidence CSR and
+                    # compile on first use, like overlay BFS batches)
+                    inc_off, inc_links = _dummy_inc_csr()
+                    zu = jnp.zeros((int(b),), jnp.uint32)
+                    zb = jnp.zeros((int(b),), bool)
+                    neg = jnp.full((int(b),), -1, jnp.int32)
+                    warm += self.aot.warm(
+                        "ops.value_index.ordered_topk_batch",
+                        ordered_topk_batch,
+                        (bcol.rank_hi, bcol.rank_lo, bcol.gids,
+                         jnp.int32(bcol.n),
+                         empty_delta.rank_hi, empty_delta.rank_lo,
+                         empty_delta.gids, jnp.int32(0),
+                         type_of_device(view.base), inc_off, inc_links,
+                         zu, zu, zb, zu, zu, zb, neg, neg, zb),
+                        {"win_pad": self._range_win_pad(),
+                         "top_r": self.config.top_r},
+                    )
+                except Exception:  # noqa: BLE001 - never block startup
+                    continue
             for hops in hops_list:
                 # independent try blocks: a bucket whose unfused lowering
                 # fails must not forfeit the fused warm (or vice versa) —
@@ -572,6 +697,73 @@ class DeviceExecutor:
                     out.dev_out = self._serve_pattern(
                         view, ell, anchors, type_vec,
                     )
+        elif kind == "range":
+            from hypergraphdb_tpu.storage.value_index import (
+                value_index_column,
+            )
+
+            dim = batch.key[1]
+            n = view.base.num_atoms
+            K = batch.bucket
+            U32 = np.uint32(0xFFFFFFFF)
+            bounds = {
+                # pad-lane default: lo and hi both leftmost of rank 0 —
+                # an empty window, well-defined garbage by construction
+                "lo_hi": np.zeros(K, np.uint32),
+                "lo_lo": np.zeros(K, np.uint32),
+                "lo_right": np.zeros(K, bool),
+                "hi_hi": np.zeros(K, np.uint32),
+                "hi_lo": np.zeros(K, np.uint32),
+                "hi_right": np.zeros(K, bool),
+                "type_vec": np.full(K, -1, np.int32),
+                "anchor": np.full(K, -1, np.int32),
+                "desc": np.zeros(K, bool),
+            }
+            lane = 0
+            for t in batch.tickets:
+                req = t.request
+                if (not req.exact
+                        or (req.limit is not None
+                            and req.limit > self.config.top_r)
+                        or (req.anchor is not None
+                            and (req.anchor < 0 or req.anchor >= n
+                                 or view.new_atoms))):
+                    # variable-width kinds (rank ties), over-window
+                    # limits, stale/oversized anchors, and anchored
+                    # lanes under fresh ingest (a memtable link incident
+                    # to the anchor is invisible to the BASE incidence
+                    # rows the filter probes) all serve exactly on host
+                    out.host_tickets.append(t)
+                    continue
+                lo, hi = req.lo_rank, req.hi_rank
+                if lo is not None:
+                    bounds["lo_hi"][lane] = np.uint32(lo >> 32)
+                    bounds["lo_lo"][lane] = np.uint32(lo & 0xFFFFFFFF)
+                    bounds["lo_right"][lane] = req.lo_op == "gt"
+                if hi is not None:
+                    bounds["hi_hi"][lane] = np.uint32(hi >> 32)
+                    bounds["hi_lo"][lane] = np.uint32(hi & 0xFFFFFFFF)
+                    bounds["hi_right"][lane] = req.hi_op == "lte"
+                else:
+                    bounds["hi_hi"][lane] = U32
+                    bounds["hi_lo"][lane] = U32
+                    bounds["hi_right"][lane] = True
+                if req.type_handle is not None:
+                    bounds["type_vec"][lane] = int(req.type_handle)
+                if req.anchor is not None:
+                    bounds["anchor"][lane] = int(req.anchor)
+                bounds["desc"][lane] = bool(req.desc)
+                out.lane_tickets.append((lane, t))
+                lane += 1
+            if out.lane_tickets:
+                bcol = value_index_column(view.base, dim)
+                dcol = self.mgr.value_delta(view, dim,
+                                            self.config.max_lag_edges)
+                out.range_covered = dcol.covered
+                self.stats.record_range_dispatch()
+                with self._dispatch_cm("range", batch.bucket, dim):
+                    out.dev_out = self._serve_range(view, bcol, dcol,
+                                                    bounds)
         elif kind == "join":
             sig = batch.key[1]
             n = view.base.num_atoms
@@ -659,6 +851,8 @@ class DeviceExecutor:
             kind = launched.batch.key[0]
             if kind == "join":
                 return self._collect_join(launched)
+            if kind == "range":
+                return self._collect_range(launched)
             counts, first_r = (np.asarray(x) for x in launched.dev_out)
             if kind == "pattern":
                 # batch-invariant memtable views, hoisted off the
@@ -714,6 +908,215 @@ class DeviceExecutor:
         out.extend(self._serve_host(launched.host_tickets, view.epoch))
         return out
 
+    def _collect_range(self, launched: LaunchedBatch) -> list:
+        """Range-batch result assembly: download the compact per-lane
+        windows and apply the LSM memtable correction — drop
+        dead/revalued gids, host-evaluate the residual memtable
+        candidates (atoms past the delta column's coverage, plus every
+        revalued atom), merge in VALUE order. Prefix lanes (count beyond
+        the compact window) with a non-empty correction set re-serve
+        exactly on host — a prefix cannot absorb corrections, the
+        pattern lane's rule."""
+        from hypergraphdb_tpu.ops.setops import SENTINEL
+
+        view = launched.view
+        counts_f, first_r, covered, total = (
+            np.asarray(x) for x in launched.dev_out
+        )
+        residual = view.new_atoms[launched.range_covered:]
+        drop = view.dead | view.revalued
+        # batch-invariant drop array, hoisted off the per-lane path (the
+        # pattern collect's discipline: a 1024-lane batch over a deep
+        # memtable must not rebuild this conversion 1024×)
+        drop_arr = (np.fromiter(drop, dtype=np.int64)
+                    if drop else np.empty(0, dtype=np.int64))
+        cands = (set(residual) | view.revalued) - view.dead
+        # type-filtered lanes need the FULL memtable candidate set: the
+        # kernel's type filter reads the BASE type_of column, where a
+        # delta-column (memtable) gid is -1 — such atoms are masked out
+        # on device (never falsely in), so the host merge must re-offer
+        # every fresh atom, not just the uncovered residual. Built only
+        # when some lane actually carries a type filter (an untyped
+        # range-heavy batch must not pay O(|memtable|) per collect).
+        cands_typed = (
+            (set(view.new_atoms) | view.revalued) - view.dead
+            if any(t.request.type_handle is not None
+                   for _, t in launched.lane_tickets)
+            else cands
+        )
+        out = []
+        for lane, ticket in launched.lane_tickets:
+            try:
+                req = ticket.request
+                out.append((ticket, self._range_result(
+                    req, int(counts_f[lane]),
+                    first_r[lane][first_r[lane] != SENTINEL],
+                    bool(covered[lane]), int(total[lane]), view,
+                    drop_arr,
+                    cands_typed if req.type_handle is not None else cands,
+                )))
+            except Exception as e:  # surface, don't kill the batch
+                out.append((ticket, e))
+        out.extend(self._serve_host(launched.host_tickets, view.epoch))
+        return out
+
+    def _range_result(self, req: RangeRequest, count_f: int,
+                      matches: np.ndarray, covered: bool, total: int,
+                      view, drop_arr: np.ndarray, cands: set):
+        filtered = req.type_handle is not None or req.anchor is not None
+        if filtered and not covered:
+            # the window outran the gather pad under a filter: neither
+            # count nor prefix is reconstructible on device
+            self.stats.record_host_fallback()
+            return self._host_range(req, view.epoch)
+        count = count_f if filtered else total
+        top_r = self.config.top_r
+        upto = min(req.limit if req.limit is not None else top_r, top_r)
+        if count <= len(matches):
+            # the complete filtered set is in hand: corrections merge
+            # exactly (the LSM read-merge, value edition)
+            matches = matches.astype(np.int64)
+            if len(drop_arr) and len(matches):
+                matches = matches[~np.isin(matches, drop_arr)]
+            keys = self._range_keys(req) if cands else None
+            fresh = [h for h in cands
+                     if self._range_matches_host(req, h, keys)]
+            if fresh:
+                matches = self._range_order(
+                    req, np.union1d(matches,
+                                    np.asarray(fresh, dtype=np.int64))
+                )
+            count = len(matches)
+            matches = matches[:upto]
+            return ServeResult("range", count, matches,
+                               count > len(matches), view.epoch)
+        # prefix shape: count exact, matches an honest value-ordered
+        # prefix — but only while the memtable is quiet for this view
+        if len(drop_arr) or cands:
+            self.stats.record_host_fallback()
+            return self._host_range(req, view.epoch)
+        return ServeResult("range", count,
+                           matches[:upto].astype(np.int64),
+                           count > upto, view.epoch)
+
+    # -- range lane helpers ---------------------------------------------------
+    def _range_keys(self, req: RangeRequest) -> tuple:
+        """(lo_key, hi_key) order-preserving byte bounds of one request —
+        the host comparison unit (exact for every kind, unlike the
+        64-bit ranks). None = open."""
+        ts = self.graph.typesystem
+
+        def key_of(v):
+            if v is None:
+                return None
+            vt = ts.infer(v)
+            if vt is None:
+                raise Unservable(f"value {v!r} has no registered type")
+            return vt.to_key(v)
+
+        return key_of(req.values[0]), key_of(req.values[1])
+
+    def _range_matches_host(self, req: RangeRequest, h: int,
+                            keys: Optional[tuple] = None) -> bool:
+        """Does live atom ``h`` satisfy the FULL request predicate —
+        kind, bounds, type, anchor? The memtable-correction evaluator.
+        ``keys`` lets per-candidate loops pass the request's bound keys
+        computed ONCE (``_range_keys`` runs the typesystem) instead of
+        re-deriving them per atom."""
+        from hypergraphdb_tpu.storage.value_index import value_key_of
+
+        g = self.graph
+        if not g.contains(h):
+            return False
+        key = value_key_of(g, h)
+        if key is None or key[0] != req.dim:
+            return False
+        lo_key, hi_key = keys if keys is not None else self._range_keys(req)
+        payload = key[1:]
+        if lo_key is not None:
+            lo = lo_key[1:]
+            if payload < lo or (payload == lo and req.lo_op == "gt"):
+                return False
+        if hi_key is not None:
+            hi = hi_key[1:]
+            if payload > hi or (payload == hi and req.hi_op == "lt"):
+                return False
+        if req.type_handle is not None and int(
+            g.get_type_handle_of(h)
+        ) != int(req.type_handle):
+            return False
+        if req.anchor is not None:
+            try:
+                if int(req.anchor) not in {
+                    int(t) for t in g.get_targets(h)
+                }:
+                    return False
+            except Exception:  # noqa: BLE001 - node candidate: no targets
+                return False
+        return True
+
+    def _range_order(self, req: RangeRequest, gids: np.ndarray
+                     ) -> np.ndarray:
+        """Sort gids into the request's value order via their live keys
+        (bounded work: only complete—≤ top_r—windows are ever merged)."""
+        from hypergraphdb_tpu.storage.value_index import value_key_of
+
+        g = self.graph
+        keyed = []
+        for h in gids.tolist():
+            key = value_key_of(g, int(h))
+            if key is not None:
+                keyed.append((key[1:], int(h)))
+        keyed.sort(key=lambda kv: (kv[0], kv[1]))
+        if req.desc:
+            # descending by value, gid-ascending within ties (the
+            # kernel's complemented-rank order)
+            keyed.sort(key=lambda kv: kv[1])
+            keyed.sort(key=lambda kv: kv[0], reverse=True)
+        return np.asarray([h for _, h in keyed], dtype=np.int64)
+
+    def _host_range(self, req: RangeRequest, epoch: int) -> ServeResult:
+        """Exact host oracle: walk the by-value system index in key
+        order (the scan the device lane replaces), filter, and shape the
+        result under the same order/limit/truncation contract."""
+        from hypergraphdb_tpu.core.graph import IDX_BY_VALUE
+
+        g = self.graph
+        idx = g.store.get_index(IDX_BY_VALUE)
+        kb = bytes([req.dim])
+        lo_key, hi_key = self._range_keys(req)
+        start = lo_key if lo_key is not None else kb
+        matched: list[int] = []
+        for key, handles in idx.bulk_items(lo=start):
+            if key[:1] != kb:
+                break  # past the dimension's key family
+            if lo_key is not None and key == lo_key and req.lo_op == "gt":
+                continue
+            if hi_key is not None:
+                if key > hi_key or (key == hi_key and req.hi_op == "lt"):
+                    break
+            for h in np.asarray(handles).tolist():
+                h = int(h)
+                if req.type_handle is not None and (
+                    not g.contains(h)
+                    or int(g.get_type_handle_of(h)) != int(req.type_handle)
+                ):
+                    continue
+                if req.anchor is not None:
+                    try:
+                        if int(req.anchor) not in {
+                            int(t) for t in g.get_targets(h)
+                        }:
+                            continue
+                    except Exception:  # noqa: BLE001 - node candidate
+                        continue
+                matched.append(h)
+        arr = self._range_order(req, np.asarray(matched, dtype=np.int64))
+        top_r = self.config.top_r
+        upto = min(req.limit if req.limit is not None else top_r, top_r)
+        return ServeResult("range", len(arr), arr[:upto],
+                           len(arr) > upto, epoch, served_by="host")
+
     def collect_host(self, launched: LaunchedBatch) -> list:
         """Exact host re-serve of the WHOLE batch — the collect-failure
         recovery path: the device handles are poisoned but the pinned
@@ -739,6 +1142,9 @@ class DeviceExecutor:
                 elif kind == "join":
                     out.append((ticket, self._host_join(ticket.request,
                                                         epoch)))
+                elif kind == "range":
+                    out.append((ticket, self._host_range(ticket.request,
+                                                         epoch)))
                 else:
                     out.append((ticket, self._host_pattern(ticket.request,
                                                            epoch)))
@@ -1104,6 +1510,28 @@ class ServeRuntime:
 
             spec = to_join_request(self.graph, spec, distinct=distinct)
         return self.submit(spec, deadline_s, priority)
+
+    def submit_range(self, lo=None, hi=None, *, lo_op: str = "gte",
+                     hi_op: str = "lte", type_handle: Optional[int] = None,
+                     anchor: Optional[int] = None, desc: bool = False,
+                     limit: Optional[int] = None,
+                     deadline_s: Optional[float] = None,
+                     priority: int = 0) -> Future:
+        """Admit a value RANGE / ordered / top-k request (the hgindex
+        lane): atoms whose value lies in the ``[lo, hi]`` window of the
+        bounds' kind, in value order (``desc=True`` flips it),
+        optionally type-filtered / ``anchor``-incident / ``limit``-ed.
+        Resolves to a :class:`~.types.ServeResult` with kind
+        ``"range"``. Raises :class:`~.types.Unservable` for unbounded or
+        mixed-kind windows."""
+        from hypergraphdb_tpu.query.bridge import to_range_request
+
+        return self.submit(
+            to_range_request(self.graph, lo, hi, lo_op=lo_op, hi_op=hi_op,
+                             type_handle=type_handle, anchor=anchor,
+                             desc=desc, limit=limit),
+            deadline_s, priority,
+        )
 
     def submit_query(self, condition,
                      deadline_s: Optional[float] = None,

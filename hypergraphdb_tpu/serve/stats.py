@@ -37,6 +37,7 @@ LEGACY_TO_DOTTED = {
     "batches": "serve.batches",
     "device_dispatches": "serve.device_dispatches",
     "sharded_dispatches": "serve.sharded_dispatches",
+    "range_dispatches": "serve.range_dispatches",
     "retries": "serve.retries",
     "breaker_trips": "serve.breaker_trips",
     "breaker_state": "serve.breaker_state",
@@ -62,6 +63,7 @@ DOTTED_NAMES = (
     "serve.batches",
     "serve.device_dispatches",
     "serve.sharded_dispatches",
+    "serve.range_dispatches",
     "serve.device_seconds",
     "serve.retries",
     "serve.breaker_trips",
@@ -110,6 +112,7 @@ class ServeStats:
         self._batches = r.counter("serve.batches")
         self._device_dispatches = r.counter("serve.device_dispatches")
         self._sharded_dispatches = r.counter("serve.sharded_dispatches")
+        self._range_dispatches = r.counter("serve.range_dispatches")
         self._retries = r.counter("serve.retries")
         self._breaker_trips = r.counter("serve.breaker_trips")
         self._breaker_state = r.gauge("serve.breaker_state")
@@ -128,7 +131,8 @@ class ServeStats:
             self._submitted, self._completed, self._shed, self._rejected,
             self._gated, self._cancelled, self._errors, self._host_fallbacks,
             self._batches, self._device_dispatches,
-            self._sharded_dispatches, self._device_seconds,
+            self._sharded_dispatches, self._range_dispatches,
+            self._device_seconds,
             self._retries, self._breaker_trips, self._breaker_state,
             self._lanes_real, self._lanes_padded, self._latency,
             self._queue_depth,
@@ -265,6 +269,14 @@ class ServeStats:
         with self._lock:
             self._sharded_dispatches.inc()
 
+    def record_range_dispatch(self) -> None:
+        """One kernel dispatch of the hgindex range lane (a subset of
+        ``device_dispatches``-adjacent work, counted at the kernel-call
+        site like ``sharded_dispatches`` — an all-host range batch
+        counts neither)."""
+        with self._lock:
+            self._range_dispatches.inc()
+
     def record_device_time(self, seconds: float) -> None:
         """One batch's launch→ready device wall delta (only measured
         under ``ServeConfig(device_timing=True)`` — the histogram stays
@@ -335,6 +347,10 @@ class ServeStats:
     def sharded_dispatches(self) -> int:
         return self._sharded_dispatches.value
 
+    @property
+    def range_dispatches(self) -> int:
+        return self._range_dispatches.value
+
     # -- reading -------------------------------------------------------------
     def occupancy(self) -> Optional[float]:
         """Mean real-lane fraction over every dispatched bucket slot."""
@@ -375,6 +391,7 @@ class ServeStats:
                 "batches": self._batches.value,
                 "device_dispatches": self._device_dispatches.value,
                 "sharded_dispatches": self._sharded_dispatches.value,
+                "range_dispatches": self._range_dispatches.value,
                 "retries": self._retries.value,
                 "breaker_trips": self._breaker_trips.value,
                 "breaker_state": self._breaker_state.value,

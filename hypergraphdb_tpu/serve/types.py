@@ -145,6 +145,60 @@ class JoinRequest:
         return ("join", self.sig)
 
 
+@dataclass(frozen=True)
+class RangeRequest:
+    """A value range / ordered / top-k query over one indexed dimension
+    (the hgindex serve lane): atoms whose value of ``kind`` falls in the
+    ``[lo, hi]`` rank window, optionally type-filtered, optionally
+    constrained incident to ``anchor``, returned in value order
+    (``desc`` flips it) with an optional ``limit`` (top-k).
+
+    ``dim`` is the value kind byte (the indexed DIMENSION — requests of
+    one dimension share a sorted device column and a batch); ``lo_rank``
+    / ``hi_rank`` are 64-bit order-preserving payload ranks
+    (``utils/ordered_bytes.rank64``), ``None`` = open bound. ``lo_op`` ∈
+    {"gt", "gte"}, ``hi_op`` ∈ {"lt", "lte"}. ``exact`` records whether
+    the kind is fixed-width (rank order == value order, tie-free): lanes
+    with ``exact=False`` (str/bytes) are served on the exact host path —
+    honest scoping, the device window cannot see rank ties. ``values``
+    keeps the ORIGINAL (lo, hi) python values so host execution and
+    memtable correction compare real keys, never coarse ranks.
+
+    Build via ``query.bridge.to_range_request`` (which derives the
+    dimension and ranks through the typesystem) rather than by hand."""
+
+    dim: int
+    lo_rank: Optional[int]
+    hi_rank: Optional[int]
+    lo_op: str = "gte"
+    hi_op: str = "lte"
+    values: tuple = (None, None)
+    type_handle: Optional[int] = None
+    anchor: Optional[int] = None
+    desc: bool = False
+    limit: Optional[int] = None
+    exact: bool = True
+
+    def __post_init__(self):
+        if self.lo_op not in ("gt", "gte") or self.hi_op not in ("lt", "lte"):
+            raise Unservable(
+                f"bad range ops ({self.lo_op}, {self.hi_op}); lower must "
+                "be gt/gte, upper lt/lte"
+            )
+        if self.limit is not None and self.limit < 1:
+            raise Unservable("range limit must be >= 1")
+
+    @property
+    def kind(self) -> str:
+        return "range"
+
+    @property
+    def batch_key(self) -> tuple:
+        # one sorted device column (and one compiled program) per value
+        # dimension: the dimension IS the statics key
+        return ("range", int(self.dim))
+
+
 # ---------------------------------------------------------------- results
 
 
@@ -152,7 +206,11 @@ class JoinRequest:
 class ServeResult:                 # raise on >1-element comparisons
     """One request's answer.
 
-    ``matches`` holds the first ``top_r`` matching atom ids ascending;
+    ``matches`` holds the first ``top_r`` matching atom ids ascending —
+    except for ``kind == "range"`` results, where they come in the
+    request's VALUE order (ascending rank, or descending under
+    ``desc=True``; rank ties break toward the smaller gid) and the
+    window is additionally capped by the request's ``limit``;
     ``truncated`` flags a result set larger than the compact window (then
     ``count`` is exact but ``matches`` is a prefix). ``epoch`` is the
     compaction epoch of the pinned view that served the request;
@@ -160,7 +218,7 @@ class ServeResult:                 # raise on >1-element comparisons
     the exact fallback (oversized rows / anchors beyond the base's id
     space)."""
 
-    kind: str               # "bfs" | "pattern"
+    kind: str               # "bfs" | "pattern" | "range"
     count: int
     matches: np.ndarray     # int64, ascending
     truncated: bool

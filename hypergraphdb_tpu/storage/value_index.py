@@ -1,0 +1,197 @@
+"""hgindex storage layer: device-resident secondary value indexes.
+
+The reference promises ordered/range lookups over atom values through
+``HGSortIndex`` (B-tree cursors repositioned per probe); the TPU-native
+twin is a **sorted device column pair per indexed dimension**: for each
+value KIND byte (int / float / bool / time — the order-preserving key
+families of ``utils/ordered_bytes``), the base snapshot's live atoms
+sorted ascending by ``(value_rank, gid)``. Range and ordered queries then
+run as batched ``searchsorted`` over the rank words plus bounded gathers
+(``ops/value_index.py``) — the role-free indexing move (PAPERS.md,
+arXiv:0811.1083): one sorted column serves every predicate shape over
+that dimension, no per-predicate index.
+
+Consistency follows the pinned-view LSM discipline everywhere else in
+the serve tier: the base column is immutable per compaction epoch
+(cached on the snapshot, rebuilt when compaction swaps the base), a
+small **delta column** covers memtable atoms under the same
+``max_lag_edges`` drift-marker refresh as the BFS device delta
+(``ops/incremental.SnapshotManager.value_delta``), and the host
+correction sets (dead / revalued / the uncovered memtable residual)
+compensate at collect time — exact at any lag.
+
+Rank semantics: ``value_rank`` is the order-preserving 64-bit payload
+rank of ``ops/snapshot.py``. For fixed-width kinds the rank order IS the
+value order (tie-free); variable-width kinds (str/bytes) tie on rank
+equality, so the serve lane routes them to the exact host path instead
+of shipping maybe-wrong windows.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+#: value kinds whose 64-bit payload rank is the exact value order (the
+#: compiler's ``_FIXED_WIDTH_KINDS``, re-exported at the storage layer so
+#: serve/bridge need not import the query compiler for it)
+FIXED_WIDTH_KINDS = frozenset(b"ifbt")
+
+#: gid padding for column tails (int32 max — sorts last, never a real id)
+GID_PAD = np.int32(np.iinfo(np.int32).max)
+
+#: rank-word padding (uint32 max pair — sorts after every real rank)
+RANK_PAD = np.uint32(0xFFFFFFFF)
+
+
+@dataclass
+class ValueIndexColumn:
+    """One indexed dimension's sorted column pair, device-resident.
+
+    ``rank_hi``/``rank_lo`` are the 64-bit ranks split into uint32 words
+    (compare lexicographically hi-then-lo — the
+    ``ops/snapshot.DeviceSnapshot`` convention; jnp would truncate
+    uint64), ``gids`` the owning atom ids; all three sorted ascending by
+    ``(rank, gid)`` and padded to a power-of-two bucket with
+    ``RANK_PAD``/``GID_PAD``. ``n`` is the real (unpadded) entry count;
+    kernels bound their binary searches by it, so pad entries are never
+    probed. ``covered`` is meaningful for DELTA columns only: how many
+    leading entries of the memtable's ``new_atoms`` list the column
+    accounts for (the residual past it is host-corrected at collect)."""
+
+    kind: int             # value kind byte this column indexes
+    n: int                # real entries
+    rank_hi: object       # (M,) uint32 jax array
+    rank_lo: object       # (M,) uint32
+    gids: object          # (M,) int32
+    epoch: int = -1       # compaction epoch (delta columns)
+    covered: int = 0      # new_atoms prefix length scanned (delta columns)
+
+
+def _sorted_device_column(kind: int, ranks: np.ndarray, gids: np.ndarray,
+                          epoch: int = -1, covered: int = 0,
+                          minimum: int = 128) -> ValueIndexColumn:
+    """Sort host ``(rank uint64, gid)`` pairs, split rank words, pad to a
+    bucket, and upload. The ONE constructor both the base and delta
+    builders go through, so the two can never disagree on layout. The
+    bucket rule is ``ops/setops._bucket`` — the same rule that sizes the
+    kernels' gather pads (deferred import, like jnp: every caller is
+    already on a device path)."""
+    import jax.numpy as jnp
+
+    from hypergraphdb_tpu.ops.setops import _bucket
+
+    order = np.lexsort((gids, ranks))
+    ranks = ranks[order]
+    gids = gids[order].astype(np.int32)
+    n = len(gids)
+    m = _bucket(max(n, 1), minimum=minimum)
+    hi = np.full(m, RANK_PAD, dtype=np.uint32)
+    lo = np.full(m, RANK_PAD, dtype=np.uint32)
+    gp = np.full(m, GID_PAD, dtype=np.int32)
+    hi[:n] = (ranks >> np.uint64(32)).astype(np.uint32)
+    lo[:n] = (ranks & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    gp[:n] = gids
+    return ValueIndexColumn(
+        kind=int(kind), n=n,
+        rank_hi=jnp.asarray(hi), rank_lo=jnp.asarray(lo),
+        gids=jnp.asarray(gp), epoch=epoch, covered=covered,
+    )
+
+
+def value_index_column(snap, kind: int) -> ValueIndexColumn:
+    """The BASE column of one kind for a packed snapshot — built from the
+    snapshot's ``value_rank``/``value_kind`` columns (live atoms only)
+    and cached on the snapshot like ``ell_targets``: one build + upload
+    per (compaction epoch, kind), shared by every batch that epoch."""
+    cache = getattr(snap, "_value_index_cols", None)
+    if cache is None:
+        cache = {}
+        object.__setattr__(snap, "_value_index_cols", cache)
+    kind = int(kind)
+    col = cache.get(kind)
+    if col is not None:
+        return col
+    N = snap.num_atoms
+    sel = np.flatnonzero(
+        (snap.value_kind[:N] == np.uint8(kind)) & (snap.type_of[:N] >= 0)
+    )
+    col = _sorted_device_column(
+        kind, snap.value_rank[sel].astype(np.uint64), sel
+    )
+    cache[kind] = col
+    return col
+
+
+def type_of_device(snap):
+    """The snapshot's ``type_of`` column alone on device, cached — the
+    range lane's per-candidate type filter must not force the FULL
+    ``DeviceSnapshot`` upload under executors (the sharded one) that
+    deliberately never materialize it."""
+    cached = getattr(snap, "_type_of_dev", None)
+    if cached is not None:
+        return cached
+    import jax.numpy as jnp
+
+    dev = snap.__dict__.get("device")
+    out = dev.type_of if dev is not None else jnp.asarray(snap.type_of)
+    object.__setattr__(snap, "_type_of_dev", out)
+    return out
+
+
+def inc_csr_device(snap):
+    """The incidence CSR (offsets, links) on device, cached under the
+    same rule as :func:`type_of_device` — the anchored range lane's
+    membership filter reads just these two arrays."""
+    cached = getattr(snap, "_inc_csr_dev", None)
+    if cached is not None:
+        return cached
+    import jax.numpy as jnp
+
+    dev = snap.__dict__.get("device")
+    out = ((dev.inc_offsets, dev.inc_links) if dev is not None
+           else (jnp.asarray(snap.inc_offsets), jnp.asarray(snap.inc_links)))
+    object.__setattr__(snap, "_inc_csr_dev", out)
+    return out
+
+
+def value_key_of(graph, h: int):
+    """One atom's order-preserving value key bytes, or None when the
+    atom is gone / its value has no key encoding. The shared probe of
+    the delta-column builder and the host correction path."""
+    from hypergraphdb_tpu.core.graph import HGLink
+
+    try:
+        v = graph.get(h)
+        if isinstance(v, HGLink):
+            v = v.value
+        at = graph.typesystem.get_type(graph.get_type_handle_of(h))
+        return at.to_key(v)
+    except Exception:  # noqa: BLE001 - racing delete / keyless value
+        return None
+
+
+def build_delta_column(graph, new_atoms, kind: int,
+                       epoch: int) -> ValueIndexColumn:
+    """Delta column: memtable atoms (a captured ``new_atoms`` prefix) of
+    one kind, sorted and uploaded. ``covered`` records the FULL scanned
+    length — atoms of other kinds, dead atoms, and keyless values are
+    accounted as scanned (they can contribute nothing), so the collect
+    residual is exactly ``new_atoms[covered:]``."""
+    from hypergraphdb_tpu.utils.ordered_bytes import rank64
+
+    ranks: list[int] = []
+    gids: list[int] = []
+    kb = bytes([int(kind)])
+    for h in new_atoms:
+        key = value_key_of(graph, int(h))
+        if key is not None and key[:1] == kb:
+            ranks.append(rank64(key[1:]))
+            gids.append(int(h))
+    return _sorted_device_column(
+        int(kind),
+        np.asarray(ranks, dtype=np.uint64),
+        np.asarray(gids, dtype=np.int64),
+        epoch=epoch, covered=len(new_atoms), minimum=32,
+    )

@@ -401,3 +401,53 @@ def test_pinned_view_is_one_epoch(graph):
     assert int(nodes[5]) in pv.dead
     # the delta in the view is the one uploaded for THIS marker
     assert pv.delta is mgr._device_delta
+
+
+def test_sharded_base_reshard_retries_on_mid_shard_compaction(graph,
+                                                              monkeypatch):
+    """The sharded-base epoch re-shard swap loop: a compaction landing
+    WHILE the (lock-free) base repartition runs must discard the stale
+    shard and retry against the new epoch — the epoch re-check in
+    ``_ensure_sharded_base`` plus ``pinned_view``'s re-shard loop. The
+    retry branch converges: the returned view's sharded base belongs to
+    the epoch the view is pinned at."""
+    from hypergraphdb_tpu.parallel import sharded as psh
+
+    nodes = [graph.add(f"n{i}") for i in range(8)]
+    for i in range(16):
+        graph.add_link((nodes[i % 8], nodes[(i + 1) % 8]), value=i)
+    mgr = graph.enable_incremental(background=False, compact_ratio=100.0)
+    mgr.attach_mesh(psh.make_mesh(), edge_chunk=64, delta_edge_chunk=32)
+
+    real_from_host = psh.ShardedSnapshot.from_host
+    calls = {"n": 0}
+
+    def racing_from_host(base, mesh, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            # a compaction lands mid-shard: the epoch this shard was
+            # captured against is stale by the time it would swap in
+            graph.add_link((nodes[0], nodes[3]), value="mid-shard")
+            mgr._compact_sync()
+        return real_from_host(base, mesh, **kw)
+
+    monkeypatch.setattr(psh.ShardedSnapshot, "from_host",
+                        staticmethod(racing_from_host))
+    epoch_before = mgr.compactions
+    view = mgr.pinned_view(sharded=True)
+    # the first shard was discarded (epoch moved), the retry converged
+    assert calls["n"] >= 2
+    assert mgr.compactions == epoch_before + 1
+    assert view.epoch == mgr.compactions
+    assert mgr._sharded_epoch == view.epoch
+    assert view.sharded_base is mgr._sharded_base
+    # and the swapped-in shard really is the NEW base's partition (the
+    # mid-shard edge is in it)
+    assert view.sharded_base.num_atoms == mgr.base.num_atoms
+
+    # a second pin with a quiet epoch re-shards nothing
+    monkeypatch.setattr(psh.ShardedSnapshot, "from_host", real_from_host)
+    n_after = calls["n"]
+    view2 = mgr.pinned_view(sharded=True)
+    assert calls["n"] == n_after
+    assert view2.sharded_base is view.sharded_base

@@ -238,10 +238,15 @@ def test_unservable_conditions_raise(graph):
     with pytest.raises(Unservable):
         rt.submit_query(dsl.bfs(1))  # unbounded hops
     with pytest.raises(Unservable):
-        rt.submit_query(dsl.value("x"))
+        rt.submit_query(dsl.value_regex("x.*"))  # predicates stay host
     with pytest.raises(Unservable):
         rt.submit_query(dsl.or_(dsl.incident(1), dsl.incident(2)))
+    # value predicates are SERVABLE since hgindex (the range lane) —
+    # the old "value predicates raise Unservable" scoping is retired
+    fut = rt.submit_query(dsl.value(3, op="lte"))
+    _drain(rt)
     rt.close()
+    assert fut.result(timeout=0).count >= 0
 
 
 @pytest.mark.slow

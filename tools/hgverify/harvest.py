@@ -32,6 +32,7 @@ PRODUCT_MODULES = (
     "hypergraphdb_tpu.ops.serving",
     "hypergraphdb_tpu.ops.join",
     "hypergraphdb_tpu.ops.sharded_serving",
+    "hypergraphdb_tpu.ops.value_index",
     "hypergraphdb_tpu.parallel.sharded",
 )
 

@@ -1,0 +1,73 @@
+#!/usr/bin/env bash
+# hgindex gate: the device-side secondary value-index subsystem — the
+# value-index differential suite (batched range/ordered/top-k == exact
+# host oracle across pad-adjacent lanes, duplicate bounds, empty
+# windows, mid-ingest delta/tombstone visibility, truncation prefixes,
+# and the join value-window candidate filter), the query suites that own
+# the bridge + compiler pushdown, and the serve differentials the range
+# lane must not regress — then a LIVE smoke: the c9_value_index bench at
+# toy scale asserting the device lane really dispatched, answered
+# identically to the host oracle (differential_equal), ran at least as
+# fast as the host value scan it replaces, and recorded its numbers to
+# BENCH_C9_smoke.json (schema_version 1).
+#
+# Sits beside lint.sh, verify.sh (the two ops/value_index entries gate
+# there), chaos.sh, obs.sh, perf.sh, replica.sh, join.sh, and shard.sh:
+# this one gates the value-index subsystem.
+#
+# Usage: tools/index.sh [extra pytest args]
+#   tools/index.sh -k topk            # one area, fast local run
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
+    tests/test_value_index.py \
+    tests/test_query.py \
+    tests/test_value_pushdown.py \
+    tests/test_serve_differential.py \
+    -q -m 'not slow' -p no:cacheprovider "$@"
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "tools/index.sh: value-index tests failed (exit $rc)" >&2
+    exit "$rc"
+fi
+
+# -- c9 smoke: the value-index serving pipeline end to end at toy scale ------
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+BENCH_C9_ENTITIES="${BENCH_C9_ENTITIES:-20000}" \
+BENCH_C9_LINKS="${BENCH_C9_LINKS:-40000}" \
+BENCH_C9_REQUESTS="${BENCH_C9_REQUESTS:-512}" \
+BENCH_C9_BASELINE_N="${BENCH_C9_BASELINE_N:-64}" \
+BENCH_C9_TAG="${BENCH_C9_TAG:-smoke}" \
+python - <<'PY'
+import json
+
+import bench
+
+r = bench.bench_c9()
+assert r["differential_equal"], r
+assert r["recorded_to"], r
+# the device lane must have REALLY dispatched: a regression that routed
+# every lane to the host fallback would be trivially differential-equal
+assert r["range_dispatches"] > 0, r
+ratio = r["device_vs_host_scan"]
+assert ratio is not None, r
+print("tools/index.sh c9 smoke:", json.dumps({
+    k: r[k] for k in ("served_qps", "host_scan_qps",
+                      "device_vs_host_scan", "range_dispatches",
+                      "host_fallbacks", "differential_equal")
+}))
+if ratio < 1.0:
+    # the acceptance target: the batched device lane >= the host value
+    # scan it replaces, even on the CPU smoke (real chips only do
+    # better)
+    raise SystemExit(
+        f"tools/index.sh: device/host-scan ratio {ratio} < 1.0")
+PY
+smoke_rc=$?
+if [ "$smoke_rc" -ne 0 ]; then
+    echo "tools/index.sh: c9 smoke failed (exit $smoke_rc)" >&2
+    exit "$smoke_rc"
+fi
+echo "tools/index.sh: value-index gate green"
+exit 0
