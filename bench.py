@@ -1251,6 +1251,10 @@ def bench_c7(snap, info):
             ]
     telemetry = _telemetry_dump("c7")
     if telemetry:
+        # the SAME sampling snapshot the telemetry sidecar carries also
+        # rides the recorded result (c6's discipline: one capture, the
+        # two can't disagree; telemetry paths stay excluded)
+        result["tracing"] = telemetry["sampling"]
         result["telemetry"] = telemetry
     result["recorded_to"] = _record_c7(result)
     return result
@@ -1443,6 +1447,8 @@ def bench_c8():
         }
     telemetry = _telemetry_dump("c8")
     if telemetry:
+        # sampling snapshot rides the recorded result (c6's discipline)
+        out["tracing"] = telemetry["sampling"]
         out["telemetry"] = telemetry
     out["recorded_to"] = _record_c8(out)
     return out
@@ -1604,6 +1610,8 @@ def bench_c9():
         out["differential_diff"] = diffs
     telemetry = _telemetry_dump("c9")
     if telemetry:
+        # sampling snapshot rides the recorded result (c6's discipline)
+        out["tracing"] = telemetry["sampling"]
         out["telemetry"] = telemetry
     out["recorded_to"] = _record_c9(out)
     return out

@@ -25,7 +25,15 @@ this repro had faithfully reproduced as ``utils.metrics.Metrics`` vs
   dumps its window to JSONL on incident;
 - **HTTP endpoint** (:mod:`~hypergraphdb_tpu.obs.http`): ``/metrics``
   (Prometheus scrape), ``/healthz`` (per-key breaker states + queue
-  depth + staleness), ``/debug/traces``, ``/debug/flight``.
+  depth + staleness), ``/debug/traces``, ``/debug/flight``;
+- **fleet plane** (:mod:`~hypergraphdb_tpu.obs.fleet`): one collector
+  over every process behind the front door — per-node-labelled metric
+  merges, cross-process span-tree assembly on the 128-bit trace ids,
+  remote incident-window retention, and per-request EXPLAIN cost
+  attribution;
+- **SLOs** (:mod:`~hypergraphdb_tpu.obs.slo`): declarative objectives
+  over sliding windows with multi-window error-budget burn-rate alerts
+  that fire as flight-recorder incidents.
 
 Cross-process tracing: trace contexts propagate over peer messages
 (``peer/messages.attach_trace``), so a replication push or snapshot
@@ -51,15 +59,24 @@ Usage::
         ...
 """
 
-from hypergraphdb_tpu.obs import device, export, flight, http
+from hypergraphdb_tpu.obs import device, export, fleet, flight, http, slo
 from hypergraphdb_tpu.obs.device import annotate, block_timed, profile
 from hypergraphdb_tpu.obs.export import (
     TRACE_SCHEMA_VERSION,
+    merge_expositions,
     parse_traces_jsonl,
     prometheus_text,
+    relabel_exposition,
+    sample_value,
     trace_to_dict,
     traces_to_jsonl,
     write_telemetry,
+)
+from hypergraphdb_tpu.obs.fleet import (
+    FleetCollector,
+    HTTPNodeSource,
+    LocalNodeSource,
+    explain_record,
 )
 from hypergraphdb_tpu.obs.flight import (
     FlightRecorder,
@@ -80,6 +97,7 @@ from hypergraphdb_tpu.obs.registry import (
     Registry,
     default_registry,
 )
+from hypergraphdb_tpu.obs.slo import Objective, SLOMonitor, fleet_objectives
 from hypergraphdb_tpu.obs.trace import Clock, Span, Trace, Tracer, global_tracer
 
 
@@ -101,10 +119,15 @@ def disable() -> Tracer:
 __all__ = [
     "Clock",
     "Counter",
+    "FleetCollector",
     "FlightRecorder",
     "Gauge",
+    "HTTPNodeSource",
     "Histogram",
+    "LocalNodeSource",
+    "Objective",
     "Registry",
+    "SLOMonitor",
     "Span",
     "TRACE_SCHEMA_VERSION",
     "TelemetryServer",
@@ -118,17 +141,24 @@ __all__ = [
     "device",
     "disable",
     "enable",
+    "explain_record",
     "export",
+    "fleet",
+    "fleet_objectives",
     "flight",
     "global_flight",
     "global_tracer",
     "http",
     "install_sigterm_dump",
+    "merge_expositions",
     "parse_flight_jsonl",
     "parse_traces_jsonl",
     "profile",
     "prometheus_text",
+    "relabel_exposition",
     "runtime_health",
+    "sample_value",
+    "slo",
     "trace_to_dict",
     "tracer",
     "traces_to_jsonl",

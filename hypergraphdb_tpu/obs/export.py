@@ -7,6 +7,15 @@ Two committed wire formats:
   names become underscores; counters get the conventional ``_total``
   suffix; histograms emit cumulative ``_bucket{le=...}`` series plus
   ``_sum``/``_count``). Line-parseable — covered by a format test.
+  ``labels={"node": "r1"}`` stamps every sample line with a constant
+  label set, which is what keeps a FLEET-merged scrape's per-node
+  series distinct instead of dedupe-colliding on identical names;
+  :func:`relabel_exposition` applies the same stamping to exposition
+  TEXT scraped from a remote node, and :func:`merge_expositions` joins
+  several nodes' pages into one valid page (``# TYPE`` emitted once per
+  metric, first node wins — the multi-registry dedupe rule, fleet
+  edition). :func:`sample_value` is the matching tiny reader (the SLO
+  monitor's counter source pulls totals out of scraped pages with it).
 - :func:`traces_to_jsonl` serializes finished traces one-per-line with an
   explicit ``schema_version`` (:data:`TRACE_SCHEMA_VERSION`); span
   attributes are restricted to scalars at record time (``Span.set``), so
@@ -53,12 +62,35 @@ def _fmt(v: float) -> str:
     return repr(float(v)) if isinstance(v, float) else str(v)
 
 
-def prometheus_text(*registries: Registry) -> str:
+def _label_value(v) -> str:
+    """Escape one label value per the exposition format."""
+    return (str(v).replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
+def _label_body(labels: Optional[dict]) -> str:
+    """The inside of a ``{...}`` label set (no braces), sorted for a
+    stable wire format; empty string for no labels."""
+    if not labels:
+        return ""
+    return ",".join(
+        f'{_NAME_RE.sub("_", str(k))}="{_label_value(v)}"'
+        for k, v in sorted(labels.items())
+    )
+
+
+def prometheus_text(*registries: Registry,
+                    labels: Optional[dict] = None) -> str:
     """Render registries as Prometheus exposition text. Duplicate names
     across registries render once (first registry wins) — merged dumps of
-    per-graph + per-runtime registries stay valid exposition."""
+    per-graph + per-runtime registries stay valid exposition. ``labels``
+    stamps a constant label set onto every sample line (``node="r1"`` is
+    the fleet collector's per-node tag), merged before ``le`` on
+    histogram buckets."""
     lines: list[str] = []
     seen: set[str] = set()
+    lb = _label_body(labels)
+    sfx = "{" + lb + "}" if lb else ""
     for reg in registries:
         for m in reg.instruments():
             pname = _prom_name(m.name)
@@ -67,22 +99,103 @@ def prometheus_text(*registries: Registry) -> str:
             seen.add(pname)
             if m.kind == "counter":
                 lines.append(f"# TYPE {pname}_total counter")
-                lines.append(f"{pname}_total {m.value}")
+                lines.append(f"{pname}_total{sfx} {m.value}")
             elif m.kind == "gauge":
                 lines.append(f"# TYPE {pname} gauge")
-                lines.append(f"{pname} {_fmt(m.value)}")
+                lines.append(f"{pname}{sfx} {_fmt(m.value)}")
             else:  # histogram
                 lines.append(f"# TYPE {pname} histogram")
                 # one locked read: _bucket/_sum/_count stay mutually
                 # consistent within a scrape
                 buckets, total, count = m.export_state()
+                pre = lb + "," if lb else ""
                 for edge, cum in buckets:
                     lines.append(
-                        f'{pname}_bucket{{le="{_fmt(edge)}"}} {cum}'
+                        f'{pname}_bucket{{{pre}le="{_fmt(edge)}"}} {cum}'
                     )
-                lines.append(f"{pname}_sum {_fmt(total)}")
-                lines.append(f"{pname}_count {count}")
+                lines.append(f"{pname}_sum{sfx} {_fmt(total)}")
+                lines.append(f"{pname}_count{sfx} {count}")
     return "\n".join(lines) + ("\n" if lines else "")
+
+
+#: one exposition sample line: name, optional {labels}, value
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)\s*$"
+)
+
+
+def relabel_exposition(text: str, labels: dict) -> str:
+    """Stamp ``labels`` onto every sample line of exposition TEXT (what a
+    remote node's ``/metrics`` scrape returns) — the collector-side twin
+    of ``prometheus_text(labels=...)``. Comment/blank lines pass through;
+    existing labels (``le``) are preserved after the stamped ones."""
+    lb = _label_body(labels)
+    if not lb:
+        return text
+    out = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            out.append(line)
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            out.append(line)  # foreign line: never corrupt it
+            continue
+        name, existing, value = m.groups()
+        inner = existing[1:-1] if existing else ""
+        merged = lb + ("," + inner if inner else "")
+        out.append(f"{name}{{{merged}}} {value}")
+    return "\n".join(out) + ("\n" if out else "")
+
+
+def merge_expositions(pages: Iterable[tuple]) -> str:
+    """Join several nodes' exposition pages into ONE valid page:
+    ``pages`` is an iterable of ``(labels, text)``; every sample line is
+    stamped with its page's labels and ``# TYPE`` comments are emitted
+    once per metric (first page wins — conflicting redeclarations from a
+    skewed node are dropped, not duplicated)."""
+    lines: list[str] = []
+    typed: set[str] = set()
+    for labels, text in pages:
+        for line in relabel_exposition(text, labels).splitlines():
+            if line.startswith("# TYPE "):
+                metric = line.split()[2] if len(line.split()) > 2 else ""
+                if metric in typed:
+                    continue
+                typed.add(metric)
+            lines.append(line)
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def sample_value(text: str, name: str,
+                 labels: Optional[dict] = None) -> Optional[float]:
+    """The first sample of ``name`` in exposition text whose label set
+    CONTAINS ``labels`` (subset match; None matches any) — the tiny
+    reader the SLO monitor's counter sources pull scraped totals with.
+    None when absent."""
+    want = None if labels is None else {
+        (str(k), str(v)) for k, v in labels.items()
+    }
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None or m.group(1) != name:
+            continue
+        if want is not None:
+            inner = (m.group(2) or "{}")[1:-1]
+            have = set()
+            for part in inner.split(","):
+                if "=" in part:
+                    k, _, v = part.partition("=")
+                    have.add((k.strip(), v.strip().strip('"')))
+            if not want <= have:
+                continue
+        try:
+            return float(m.group(3))
+        except ValueError:
+            return None
+    return None
 
 
 # ------------------------------------------------------------------ traces

@@ -26,6 +26,15 @@ string-matching prose. ``/metrics`` stays the
 beside this per process; ``/healthz`` is duplicated here because the
 front door and load balancers need it ON the submit port.
 
+**Fleet views** (``SubmitServer(fleet=FleetCollector(...))`` — the
+front door wears them): ``GET /fleet/metrics`` (per-node-labelled
+merged exposition), ``/fleet/healthz`` (worst-of + per-node detail),
+``/fleet/slo`` (error-budget burn state), and ``/fleet/traces/<tid>``
+(one cross-process span tree stitched from every node's half) ride the
+same port as ``/submit``, so the fleet is observed through the URL
+callers already use. ``POST /submit {"explain": true}`` adds the
+answering node's per-request cost-attribution record to the response.
+
 No jax imports; handlers hold no runtime locks (``submit`` blocks on
 the request's future only), so a slow request never stalls a scrape.
 """
@@ -70,8 +79,11 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _respond(self, status: int, payload: dict) -> None:
         body = (json.dumps(payload, sort_keys=True) + "\n").encode("utf-8")
+        self._respond_raw(status, body, "application/json")
+
+    def _respond_raw(self, status: int, body: bytes, ctype: str) -> None:
         self.send_response(status)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -79,6 +91,13 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
         srv: "SubmitServer" = self.server.submit_server  # type: ignore[attr-defined]
         path = self.path.split("?", 1)[0]
+        if srv.fleet is not None and path.startswith("/fleet/"):
+            try:
+                self._do_fleet(srv.fleet, path)
+            except Exception as e:  # noqa: BLE001 - a broken view ≠ dead door
+                self._respond(500, {"error": type(e).__name__,
+                                    "message": str(e)})
+            return
         if path != "/healthz":
             self._respond(404, {"error": "NotFound", "message": path})
             return
@@ -90,6 +109,43 @@ class _Handler(BaseHTTPRequestHandler):
                                 "message": str(e)})
             return
         self._respond(200 if healthy else 503, payload)
+
+    def _do_fleet(self, fleet, path: str) -> None:
+        """The fleet views ON the door's port: the operator asks the one
+        URL callers already use. ``fleet`` is a
+        :class:`~hypergraphdb_tpu.obs.fleet.FleetCollector`."""
+        if path == "/fleet/metrics":
+            self._respond_raw(
+                200, fleet.fleet_metrics().encode("utf-8"),
+                "text/plain; version=0.0.4; charset=utf-8",
+            )
+        elif path == "/fleet/healthz":
+            healthy, payload = fleet.fleet_healthz()
+            self._respond(200 if healthy else 503, payload)
+        elif path == "/fleet/slo":
+            if fleet.slo is None:
+                self._respond(404, {"error": "NotFound",
+                                    "message": "no SLO monitor attached"})
+            else:
+                self._respond(200, fleet.slo.snapshot())
+        elif path == "/fleet/traces":
+            self._respond(200, {"traces": fleet.fleet_traces()})
+        elif path.startswith("/fleet/traces/"):
+            tail = path[len("/fleet/traces/"):]
+            try:
+                tid = int(tail)
+            except ValueError:
+                self._respond(400, {"error": "ValueError",
+                                    "message": f"bad trace id {tail!r}"})
+                return
+            joined = fleet.fleet_trace(tid)
+            if joined is None:
+                self._respond(404, {"error": "NotFound",
+                                    "message": f"unknown trace {tid}"})
+            else:
+                self._respond(200, joined)
+        else:
+            self._respond(404, {"error": "NotFound", "message": path})
 
     def do_POST(self) -> None:  # noqa: N802
         srv: "SubmitServer" = self.server.submit_server  # type: ignore[attr-defined]
@@ -131,9 +187,15 @@ class SubmitServer:
 
     def __init__(self, submit_fn: Callable[[dict], dict],
                  health: Optional[HealthProbe] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 fleet=None):
         self.submit_fn = submit_fn
         self.health = health
+        #: optional hgobs FleetCollector: serves /fleet/metrics,
+        #: /fleet/healthz, /fleet/slo, /fleet/traces[/<tid>] ON this
+        #: port — the front door wears it so the fleet is operated
+        #: through the same URL callers submit to
+        self.fleet = fleet
         self._server = ThreadingHTTPServer((host, port), _Handler)
         self._server.daemon_threads = True
         self._server.submit_server = self  # type: ignore[attr-defined]
@@ -185,18 +247,23 @@ def node_server(node, timeout_s: float = 30.0,
                 authoritative: bool = False) -> SubmitServer:
     """A replica node's submit endpoint: runtime + health in one call.
     ``authoritative=True`` marks a PRIMARY's endpoint: an unknown gid
-    answers 400 (the gid is wrong) instead of 503 (merely not here yet)."""
+    answers 400 (the gid is wrong) instead of 503 (merely not here yet).
+    Explain responses are stamped with the node's peer identity."""
     from hypergraphdb_tpu.replica.router import submit_payload
 
+    ident = getattr(getattr(node, "peer", None), "identity", None)
     return SubmitServer(
         lambda p: submit_payload(node.runtime, p, timeout_s,
-                                 authoritative=authoritative),
+                                 authoritative=authoritative,
+                                 node_id=ident),
         health=node.health_probe(), host=host, port=port,
     )
 
 
 def frontdoor_server(frontdoor, host: str = "127.0.0.1",
-                     port: int = 0) -> SubmitServer:
-    """The front door's public endpoint."""
+                     port: int = 0, fleet=None) -> SubmitServer:
+    """The front door's public endpoint; pass a
+    :class:`~hypergraphdb_tpu.obs.fleet.FleetCollector` as ``fleet`` to
+    serve the ``/fleet/*`` views beside ``/submit``."""
     return SubmitServer(frontdoor.submit, health=frontdoor.health_probe(),
-                        host=host, port=port)
+                        host=host, port=port, fleet=fleet)

@@ -299,6 +299,31 @@ class ReplicaNode:
             return replica_probe
         return composite_health(runtime_health(self.runtime), replica_probe)
 
+    # -- fleet observability ---------------------------------------------------
+    def fleet_source(self, node_id: Optional[str] = None):
+        """This replica as a
+        :class:`~hypergraphdb_tpu.obs.fleet.LocalNodeSource` (same-
+        process fleets / tests; a real deployment registers the node's
+        TelemetryServer URL as an
+        :class:`~hypergraphdb_tpu.obs.fleet.HTTPNodeSource` instead):
+        serve + graph registries, the runtime's tracer, the process
+        flight recorder, and the composite health probe the front door
+        already reads."""
+        from hypergraphdb_tpu.obs.fleet import LocalNodeSource
+        from hypergraphdb_tpu.obs.flight import global_flight
+
+        rt = self.runtime
+        regs = [] if rt is None else [rt.stats.registry]
+        gm = getattr(self.graph, "metrics", None)
+        if gm is not None:
+            regs.append(gm.registry)
+        return LocalNodeSource(
+            node_id or self.peer.identity, registries=regs,
+            tracer=None if rt is None else rt.tracer,
+            flight=global_flight(), health=self.health_probe(),
+            role="replica",
+        )
+
     # -- follow ---------------------------------------------------------------
     def _anti_entropy_loop(self) -> None:
         """The backstop convergence prod: a digest probe every interval.

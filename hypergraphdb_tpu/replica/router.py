@@ -82,7 +82,8 @@ def _request_ids(payload: dict) -> list:
 
 
 def submit_payload(runtime, payload: dict, timeout: float,
-                   authoritative: bool = False) -> dict:
+                   authoritative: bool = False,
+                   node_id: Optional[str] = None) -> dict:
     """One wire-shaped request → the runtime → a wire-shaped response.
     The single serve-payload schema, shared by the local backend and the
     HTTP handler so both paths answer byte-identically::
@@ -93,9 +94,18 @@ def submit_payload(runtime, payload: dict, timeout: float,
     Response: ``{"kind", "count", "matches", "truncated", "epoch",
     "served_by"}``. ``authoritative`` marks the PRIMARY's source-of-truth
     view: a gid it doesn't know exists nowhere, which is the caller's
-    error — on a replica the same miss is a replication race."""
+    error — on a replica the same miss is a replication race.
+
+    ``{"explain": true}`` requests per-request cost attribution: the
+    response carries the runtime's EXPLAIN record (serving lane,
+    occupancy, device seconds, retries, breaker state, trace id —
+    assembled from the request's own span tree) under ``"explain"``,
+    stamped with ``node_id`` when the endpoint knows who it is. Needs
+    tracing enabled on the answering node (400 otherwise, the
+    :class:`~hypergraphdb_tpu.serve.Unservable` mapping)."""
     kind = payload.get("kind")
     deadline = payload.get("deadline_s")
+    explain = bool(payload.get("explain"))
 
     def _resolve(gid: str) -> int:
         # gid-addressed requests are location-transparent: the SAME
@@ -129,6 +139,7 @@ def submit_payload(runtime, payload: dict, timeout: float,
                       else int(payload["max_hops"])),
             deadline_s=deadline,
             include_seed=bool(payload.get("include_seed", True)),
+            explain=explain,
         )
     elif kind == "pattern":
         anchors = ([_resolve(a) for a in payload["anchor_gids"]]
@@ -139,6 +150,7 @@ def submit_payload(runtime, payload: dict, timeout: float,
             type_handle=(None if payload.get("type_handle") is None
                          else int(payload["type_handle"])),
             deadline_s=deadline,
+            explain=explain,
         )
     else:
         raise Unservable(f"unknown request kind {kind!r}")
@@ -151,6 +163,12 @@ def submit_payload(runtime, payload: dict, timeout: float,
         "epoch": int(res.epoch),
         "served_by": res.served_by,
     }
+    if explain:
+        rec = getattr(fut, "explain", None)
+        if rec is not None:
+            if node_id is not None:
+                rec = dict(rec, node=str(node_id))
+            out["explain"] = rec
     if payload.get("gids"):
         # matches are LOCAL handles of the answering node; a caller
         # comparing answers across backends (or following up against a
@@ -180,7 +198,8 @@ class LocalBackend:
 
     def submit(self, payload: dict, timeout: float) -> dict:
         return submit_payload(self.runtime, payload, timeout,
-                              authoritative=self.role == "primary")
+                              authoritative=self.role == "primary",
+                              node_id=self.id)
 
     def health(self):
         if self._health is None:
@@ -519,6 +538,19 @@ class FrontDoor:
             raise
         res["routed_to"] = self.primary.id
         return res
+
+    # -- fleet observability ---------------------------------------------------
+    def fleet_source(self, node_id: str = "router"):
+        """The router's OWN node source for a
+        :class:`~hypergraphdb_tpu.obs.fleet.FleetCollector`: routing
+        counters + the router health probe — the door reads itself the
+        same way it reads its backends."""
+        from hypergraphdb_tpu.obs.fleet import LocalNodeSource
+
+        return LocalNodeSource(
+            node_id, registries=[self.metrics.registry],
+            health=self.health_probe(), role="router",
+        )
 
     # -- health surface --------------------------------------------------------
     def health_probe(self):

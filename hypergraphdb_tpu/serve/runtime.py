@@ -198,6 +198,10 @@ class DeviceExecutor:
     targets — fall back to exact host execution at collect time, counted
     in ``stats.host_fallbacks``."""
 
+    #: which lane family a device-served result counts under (the
+    #: sharded executor overrides with "sharded") — see stats.LANE_PATHS
+    device_lane = "device"
+
     def __init__(self, graph, config: ServeConfig,
                  stats: Optional[ServeStats] = None):
         if graph is None:
@@ -1413,7 +1417,7 @@ class ServeRuntime:
 
     # -- submit --------------------------------------------------------------
     def submit(self, request, deadline_s: Optional[float] = None,
-               priority: int = 0) -> Future:
+               priority: int = 0, explain: bool = False) -> Future:
         """Admit one request; returns its future. Raises
         :class:`~.types.QueueFull` under fail-fast backpressure,
         :class:`~.types.RuntimeClosed` after close; a deadline that expires
@@ -1423,7 +1427,17 @@ class ServeRuntime:
         ``admission_gate`` refusal raises
         :class:`~.types.AdmissionGated` BEFORE any queue state is
         touched (routers re-route; the request costs this node
-        nothing)."""
+        nothing).
+
+        ``explain=True`` requests per-request COST ATTRIBUTION: the
+        request's trace is force-sampled and, at resolve time, an
+        ``obs.fleet.explain_record`` (serving lane, bucket/pad
+        occupancy, device seconds, retries, breaker state, trace id —
+        assembled from the ticket's own span tree) is attached to the
+        returned future as ``future.explain`` BEFORE the result is
+        delivered. Requires tracing (the span tree IS the record's
+        source): raises :class:`~.types.Unservable` when the runtime's
+        tracer is disabled."""
         gate = self.config.admission_gate
         if gate is not None:
             reason = gate()
@@ -1432,16 +1446,25 @@ class ServeRuntime:
                 from hypergraphdb_tpu.serve.types import AdmissionGated
 
                 raise AdmissionGated(str(reason))
+        if explain and not self.tracer.enabled:
+            raise Unservable(
+                "explain=True needs tracing: enable the runtime's tracer "
+                "(obs.enable(), or ServeConfig(tracer=Tracer().enable()))"
+            )
         now = self.clock()
         dl = (deadline_s if deadline_s is not None
               else self.config.default_deadline_s)
         ticket = Ticket(
             request=request, submit_t=now,
             deadline_t=None if dl is None else now + dl,
-            priority=int(priority),
+            priority=int(priority), explain=bool(explain),
         )
         if self.tracer.enabled:  # the ONE gate read on the disabled path
             self._trace_submit(ticket)
+            if explain and ticket.trace is not None:
+                # the record is built from the FINISHED trace — an
+                # explain request must survive any head sampling rate
+                ticket.trace.force_sample()
         try:
             self.queue.submit(ticket)
         except Exception as e:
@@ -1476,29 +1499,30 @@ class ServeRuntime:
 
     def submit_bfs(self, seed: int, max_hops: Optional[int] = None,
                    deadline_s: Optional[float] = None,
-                   include_seed: bool = True, priority: int = 0) -> Future:
+                   include_seed: bool = True, priority: int = 0,
+                   explain: bool = False) -> Future:
         return self.submit(
             BFSRequest(int(seed),
                        max_hops if max_hops is not None
                        else self.config.default_max_hops,
                        include_seed),
-            deadline_s, priority,
+            deadline_s, priority, explain,
         )
 
     def submit_pattern(self, anchors: Sequence[int],
                        type_handle: Optional[int] = None,
                        deadline_s: Optional[float] = None,
-                       priority: int = 0) -> Future:
+                       priority: int = 0, explain: bool = False) -> Future:
         return self.submit(
             PatternRequest(tuple(int(a) for a in anchors),
                            None if type_handle is None
                            else int(type_handle)),
-            deadline_s, priority,
+            deadline_s, priority, explain,
         )
 
     def submit_join(self, spec, distinct: bool = True,
                     deadline_s: Optional[float] = None,
-                    priority: int = 0) -> Future:
+                    priority: int = 0, explain: bool = False) -> Future:
         """Admit a conjunctive-pattern JOIN: ``spec`` is either a
         prebuilt :class:`~.types.JoinRequest` or a ``{var: condition}``
         mapping with ``query.variables.Var`` cross-references
@@ -1509,14 +1533,14 @@ class ServeRuntime:
             from hypergraphdb_tpu.query.bridge import to_join_request
 
             spec = to_join_request(self.graph, spec, distinct=distinct)
-        return self.submit(spec, deadline_s, priority)
+        return self.submit(spec, deadline_s, priority, explain)
 
     def submit_range(self, lo=None, hi=None, *, lo_op: str = "gte",
                      hi_op: str = "lte", type_handle: Optional[int] = None,
                      anchor: Optional[int] = None, desc: bool = False,
                      limit: Optional[int] = None,
                      deadline_s: Optional[float] = None,
-                     priority: int = 0) -> Future:
+                     priority: int = 0, explain: bool = False) -> Future:
         """Admit a value RANGE / ordered / top-k request (the hgindex
         lane): atoms whose value lies in the ``[lo, hi]`` window of the
         bounds' kind, in value order (``desc=True`` flips it),
@@ -1530,7 +1554,7 @@ class ServeRuntime:
             to_range_request(self.graph, lo, hi, lo_op=lo_op, hi_op=hi_op,
                              type_handle=type_handle, anchor=anchor,
                              desc=desc, limit=limit),
-            deadline_s, priority,
+            deadline_s, priority, explain,
         )
 
     def submit_query(self, condition,
@@ -1671,8 +1695,12 @@ class ServeRuntime:
             for t in batch.tickets:
                 tr = t.trace
                 if tr is not None and not tr.finished:
+                    # retries = transient re-attempts this batch paid
+                    # (0 on the clean path) — the EXPLAIN record's
+                    # retry attribution reads it off this span
                     tr.add_span("launch", t_l0, t_l1,
-                                parent=tr.marks.get("root"))
+                                parent=tr.marks.get("root"),
+                                retries=attempt)
         return batch.tickets, launched, key, device
 
     def _backoff(self, batch, attempt: int) -> bool:
@@ -1755,14 +1783,69 @@ class ServeRuntime:
                 if served_by == "host":
                     tr.add_span("host_fallback", t_c0, t_c1, parent=root)
         now = self.clock()
+        device_lane = getattr(self.executor, "device_lane", "device")
         for ticket, res in results:
             if isinstance(res, BaseException):
                 if ticket.fail(res):
                     self.stats.record_error()
-            elif ticket.resolve(res):
-                # a cancel()ed future neither raises out of the dispatch
-                # thread nor counts as a completion
-                self.stats.record_complete(now - ticket.submit_t)
+            else:
+                path = ("host"
+                        if getattr(res, "served_by", None) == "host"
+                        else device_lane)
+                if ticket.explain:
+                    self._attach_explain(ticket, res, key, path)
+                if ticket.resolve(res):
+                    # a cancel()ed future neither raises out of the
+                    # dispatch thread nor counts as a completion
+                    self.stats.record_complete(now - ticket.submit_t)
+                    self.stats.record_lane(res.kind, path)
+
+    def _attach_explain(self, ticket, res, key, path: str) -> None:
+        """The EXPLAIN resolve path: finish the ticket's trace EARLY
+        (terminal ``resolve`` — ``Ticket.resolve``'s own close then
+        no-ops, first-end-wins) and attach the cost-attribution record
+        to the future BEFORE the result is delivered, so a caller
+        reading ``fut.result()`` then ``fut.explain`` never races this
+        thread. The record is assembled FROM the finished span tree
+        (``obs.fleet.explain_record``) — the one source of truth the
+        fleet trace view also serves."""
+        tr = ticket.trace
+        if tr is None:
+            return
+        tr.finish_terminal("resolve", parent=tr.marks.get("root"))
+        from hypergraphdb_tpu.obs.fleet import explain_record
+
+        try:
+            ticket.future.explain = explain_record(
+                tr, result=res, lane_path=path,
+                breaker_state=(None if key is None
+                               else self.breaker.state_of(key)),
+                shard_owner=self._shard_owner(ticket.request),
+            )
+        except Exception:  # noqa: BLE001 - never fail a resolve over EXPLAIN
+            ticket.future.explain = None
+
+    def _shard_owner(self, request):
+        """The mesh partition that owns this request's primary id (the
+        EXPLAIN record's placement attribution), or None off the sharded
+        executor / for gid-addressed shapes with no raw ids."""
+        ex = self.executor
+        if getattr(ex, "mesh", None) is None:
+            return None
+        sbase = getattr(getattr(ex, "mgr", None), "_sharded_base", None)
+        pmap = getattr(sbase, "partition_map", None)
+        if pmap is None:
+            return None
+        rid = getattr(request, "seed", None)
+        if rid is None:
+            anchors = getattr(request, "anchors", None)
+            if not anchors:
+                return None
+            rid = max(anchors)
+        try:
+            return int(pmap.owner_of(int(rid)))
+        except Exception:  # noqa: BLE001 - ids beyond the map: unowned
+            return None
 
     def _recover_collect(self, tickets, token, key, device,
                          exc: BaseException):

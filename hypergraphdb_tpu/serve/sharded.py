@@ -51,6 +51,10 @@ class ShardedExecutor(DeviceExecutor):
     :meth:`prewarm` does, at deploy time). ``mesh=None`` meshes every
     visible device (capped by ``ServeConfig.mesh_devices``)."""
 
+    #: device-served results count under the mesh lane family
+    #: (``serve.lane.<kind>.sharded``)
+    device_lane = "sharded"
+
     def __init__(self, graph, config: ServeConfig,
                  stats: Optional[ServeStats] = None, mesh=None):
         super().__init__(graph, config, stats)

@@ -46,12 +46,32 @@ LEGACY_TO_DOTTED = {
     "queue_depth": "serve.queue_depth",
 }
 
+#: every request KIND the runtime serves — grows with each new lane
+#: (PR 10 join, PR 12 range); the lane drift gate in tests/test_obs.py
+#: holds this against the executors' dispatch vocabulary
+LANE_KINDS = ("bfs", "pattern", "join", "range")
+
+#: every executor PATH a request can resolve through: the single-chip
+#: device lane, the mesh-sharded device lane, the exact host lane
+LANE_PATHS = ("device", "sharded", "host")
+
+#: the per-lane served-request counter family, registered EAGERLY (the
+#: full kind × path cross product, so a scrape — and the drift gate —
+#: sees every lane's counter even before its first request; lanes a
+#: deployment never routes legitimately sit at 0). Attribution is by
+#: the ANSWERING executor: a device-served result under the sharded
+#: executor counts ``sharded`` whatever kernel shape it rode.
+LANE_NAMES = tuple(
+    f"serve.lane.{kind}.{path}" for kind in LANE_KINDS
+    for path in LANE_PATHS
+)
+
 #: every FIXED ``serve.*`` name this façade registers (drift-tested: the
 #: registry holds exactly these — no orphans, no duplicates). Per-key
 #: breaker instruments are the one DYNAMIC family on top:
 #: ``serve.breaker.state.<key>`` / ``serve.breaker.trips.<key>``
 #: (:data:`BREAKER_KEY_PREFIX`), created on a key's first transition.
-DOTTED_NAMES = (
+DOTTED_NAMES = LANE_NAMES + (
     "serve.submitted",
     "serve.completed",
     "serve.shed_deadline",
@@ -122,12 +142,19 @@ class ServeStats:
                                     window=latency_window)
         self._device_seconds = r.histogram("serve.device_seconds")
         self._queue_depth = r.gauge("serve.queue_depth")
+        # the per-lane served-request family, EAGER over the full
+        # kind × path cross product (the drift gate's contract): which
+        # lane answered each completed request, the EXPLAIN aggregate
+        self._lanes = {
+            (kind, path): r.counter(f"serve.lane.{kind}.{path}")
+            for kind in LANE_KINDS for path in LANE_PATHS
+        }
         # per-batch-key breaker family, lazily registered on a key's
         # first transition (label -> instrument; _key_instruments makes
         # reset() cover them too)
         self._key_states: dict = {}
         self._key_trips: dict = {}
-        self._own = (
+        self._own = tuple(self._lanes.values()) + (
             self._submitted, self._completed, self._shed, self._rejected,
             self._gated, self._cancelled, self._errors, self._host_fallbacks,
             self._batches, self._device_dispatches,
@@ -276,6 +303,20 @@ class ServeStats:
         counts neither)."""
         with self._lock:
             self._range_dispatches.inc()
+
+    def record_lane(self, kind: str, path: str) -> None:
+        """One request RESOLVED through lane ``(kind, path)`` — counted
+        at completion (beside ``record_complete``), so the family's sum
+        over paths equals ``completed``. Unknown combinations (a future
+        lane this façade predates) are dropped rather than raised: a
+        metrics façade must never fail a serving thread."""
+        c = self._lanes.get((kind, path))
+        if c is not None:
+            c.inc()
+
+    def lane_counts(self) -> dict:
+        """{(kind, path): served count} for every registered lane."""
+        return {k: c.value for k, c in self._lanes.items()}
 
     def record_device_time(self, seconds: float) -> None:
         """One batch's launch→ready device wall delta (only measured

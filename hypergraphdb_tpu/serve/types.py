@@ -268,6 +268,12 @@ class Ticket:
     deadline_t: Optional[float] = None
     priority: int = 0
     trace: object = None
+    #: per-request cost attribution: the runtime finishes the trace
+    #: EARLY at resolve time and attaches an ``obs.fleet.explain_record``
+    #: to the future (``future.explain``) BEFORE the result is delivered,
+    #: so a caller reading ``fut.result()`` then ``fut.explain`` never
+    #: races the dispatch thread
+    explain: bool = False
 
     def expired(self, now: float) -> bool:
         return self.deadline_t is not None and now >= self.deadline_t

@@ -1,0 +1,585 @@
+"""hgfleet: the fleet collector — per-node-labelled metric merges,
+cross-process trace assembly, worst-of health, incident visibility
+through the door, and per-request EXPLAIN cost attribution.
+
+The acceptance contracts:
+
+- a single fleet trace contains spans from ≥ 2 distinct processes
+  (sender + receiver halves joined on one 128-bit trace id);
+- an incident on a replica-side flight recorder is visible through the
+  door's fleet view (the collector pulls the remote window on incident);
+- an ``explain=True`` response's lane/occupancy/device_seconds agree
+  EXACTLY with the ticket's drained span tree.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from hypergraphdb_tpu import obs
+from hypergraphdb_tpu.obs.fleet import (
+    FleetCollector,
+    HTTPNodeSource,
+    LocalNodeSource,
+    explain_record,
+)
+from hypergraphdb_tpu.obs.flight import FlightRecorder
+from hypergraphdb_tpu.obs.http import TelemetryServer
+from hypergraphdb_tpu.obs.registry import Registry
+from hypergraphdb_tpu.obs.trace import Tracer
+from hypergraphdb_tpu.replica.httpd import SubmitServer
+from hypergraphdb_tpu.replica.router import submit_payload
+from hypergraphdb_tpu.serve import ServeConfig, ServeRuntime, Unservable
+from tests.test_serve_runtime import FakeClock, FakeExecutor
+
+
+def get(url):
+    """(status, body) — urllib raises on >=400, we want both."""
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def make_node(node_id, healthy=True, role="node"):
+    """One fake fleet node: registry + tracer + flight + health."""
+    reg = Registry(node_id)
+    tracer = Tracer(clock=FakeClock()).enable()
+    flight = FlightRecorder(clock=FakeClock())
+    payload = {"role": role, "queue_depth": 0}
+
+    def health():
+        return healthy, dict(payload)
+
+    return LocalNodeSource(node_id, registries=[reg], tracer=tracer,
+                           flight=flight, health=health, role=role), \
+        reg, tracer, flight
+
+
+# ---------------------------------------------------------------- metrics
+
+
+def test_fleet_metrics_keeps_per_node_series_distinct():
+    src_a, reg_a, _, _ = make_node("a")
+    src_b, reg_b, _, _ = make_node("b")
+    reg_a.counter("serve.submitted").inc(3)
+    reg_b.counter("serve.submitted").inc(7)
+    col = FleetCollector([src_a, src_b], clock=FakeClock(),
+                         flight=FlightRecorder(), poll_interval_s=0)
+    col.poll()
+    text = col.fleet_metrics()
+    assert 'serve_submitted_total{node="a"} 3' in text
+    assert 'serve_submitted_total{node="b"} 7' in text
+    # one TYPE line per metric, however many nodes export it
+    assert text.count("# TYPE serve_submitted_total counter") == 1
+    # the collector's own counters ride the same page
+    assert 'fleet_polls_total{node="fleet"} 1' in text
+    # and the fleet-wide total is readable back off the merged page
+    assert col.metric_total("serve_submitted_total") == 10.0
+
+
+def test_fleet_healthz_worst_of_with_per_node_detail():
+    src_a, *_ = make_node("a", healthy=True)
+    src_b, *_ = make_node("b", healthy=False, role="replica")
+    col = FleetCollector([src_a, src_b], clock=FakeClock(),
+                         flight=FlightRecorder(), poll_interval_s=0)
+    col.poll()
+    ok, payload = col.fleet_healthz()
+    assert ok is False                       # worst-of: b is unhealthy
+    assert payload["healthy_nodes"] == 1 and payload["nodes_total"] == 2
+    assert payload["nodes"]["a"]["healthy"] is True
+    assert payload["nodes"]["b"]["healthy"] is False
+    assert payload["nodes"]["b"]["role"] == "replica"
+    assert payload["nodes"]["b"]["detail"]["role"] == "replica"
+
+
+def test_unreachable_node_counts_unhealthy_not_fatal():
+    src_a, *_ = make_node("a")
+    dead = HTTPNodeSource("dead", "http://127.0.0.1:1", timeout_s=0.2)
+    col = FleetCollector([src_a, dead], clock=FakeClock(),
+                         flight=FlightRecorder(), poll_interval_s=0)
+    verdicts = col.poll()
+    assert verdicts == {"a": True, "dead": False}
+    ok, payload = col.fleet_healthz()
+    assert ok is False
+    assert payload["nodes"]["dead"]["scraped"] is False
+    assert "error" in payload["nodes"]["dead"]
+    assert col.registry.get("fleet.scrape_errors").value == 1
+
+
+# ---------------------------------------------------- trace assembly
+
+
+def joined_pair():
+    """A sender trace on tracer A and its remote half on tracer B —
+    the peer-plane propagation shape, two 'processes'."""
+    src_a, reg_a, ta, _ = make_node("a")
+    src_b, reg_b, tb, _ = make_node("b", role="replica")
+    reg_a.counter("serve.submitted").inc(1)
+    reg_b.counter("serve.submitted").inc(1)
+    tr = ta.start_trace("peer.push")
+    root = tr.start_span("push")
+    tr.marks["root"] = root
+    remote = tb.start_remote_trace("peer.apply", tr.context())
+    rs = remote.start_span("apply")
+    rs.end()
+    remote.finish()
+    root.end()
+    tr.finish()
+    return src_a, src_b, tr, root
+
+
+def test_fleet_trace_joins_spans_from_two_processes():
+    src_a, src_b, tr, root = joined_pair()
+    col = FleetCollector([src_a, src_b], clock=FakeClock(),
+                         flight=FlightRecorder(), poll_interval_s=0)
+    col.poll()
+    joined = col.fleet_trace(tr.trace_id)
+    assert joined is not None
+    assert joined["n_processes"] == 2
+    assert joined["processes"] == ["a", "b"]
+    assert {s["node"] for s in joined["spans"]} == {"a", "b"}
+    # the receiver's span hangs under the sender's propagated span id:
+    # ONE tree, no heuristics
+    apply_span = next(s for s in joined["spans"] if s["name"] == "apply")
+    assert apply_span["parent_id"] == root.span_id
+    push = next(n for n in joined["tree"] if n["name"] == "push")
+    assert any(c["name"] == "apply" and c["node"] == "b"
+               for c in push.get("children", ()))
+    # summaries agree
+    summary = next(s for s in col.fleet_traces()
+                   if s["trace_id"] == tr.trace_id)
+    assert summary["n_processes"] == 2
+
+
+def test_fleet_trace_dedupes_repeated_polls():
+    src_a, src_b, tr, _ = joined_pair()
+    col = FleetCollector([src_a, src_b], clock=FakeClock(),
+                         flight=FlightRecorder(), poll_interval_s=0)
+    col.poll()
+    n1 = col.fleet_trace(tr.trace_id)["n_spans"]
+    col.poll()   # /debug/traces is a peek: same records arrive again
+    assert col.fleet_trace(tr.trace_id)["n_spans"] == n1
+
+
+def test_fleet_trace_store_is_bounded():
+    src_a, _, ta, _ = make_node("a")
+    col = FleetCollector([src_a], clock=FakeClock(),
+                         flight=FlightRecorder(), poll_interval_s=0,
+                         max_traces=4, traces_limit=64)
+    for _ in range(10):
+        t = ta.start_trace("serve.request")
+        t.start_span("request").end()
+        t.finish()
+    col.poll()
+    assert len(col.fleet_traces()) == 4
+    assert col.registry.get("fleet.traces_assembled").value == 4
+
+
+def test_failed_scrape_keeps_last_good_metrics_totals():
+    """A down node must not make the fleet's cumulative counter totals
+    regress: the SLO sources read totals off the latest pages, and a
+    drop would clamp the burn windows empty exactly mid-incident."""
+    src, reg, _, _ = make_node("a")
+    reg.counter("serve.completed").inc(40)
+    reg.counter("serve.shed_deadline").inc(10)
+    col = FleetCollector([src], clock=FakeClock(),
+                         flight=FlightRecorder(), poll_interval_s=0)
+    col.poll()
+    assert col.metric_total("serve_shed_deadline_total") == 10.0
+
+    def boom(traces_limit=64):
+        raise OSError("telemetry port died")
+
+    src.scrape = boom
+    col.poll()
+    ok, payload = col.fleet_healthz()
+    assert ok is False                            # health stays honest
+    assert payload["nodes"]["a"]["scraped"] is False
+    # ...but the totals hold at the last-good page
+    assert col.metric_total("serve_shed_deadline_total") == 10.0
+    assert col.metric_total("serve_completed_total") == 40.0
+
+
+def test_http_source_rejects_non_200_telemetry_bodies():
+    """A node whose /metrics errors must fail the scrape — its error
+    body kept as metrics_text would corrupt the merged exposition page
+    and silently zero the node's SLO contributions."""
+    # a SubmitServer answers /metrics with a 404 JSON error body
+    srv = SubmitServer(_NullDoor()).start()
+    try:
+        scrape = HTTPNodeSource("bad", srv.url).scrape()
+    finally:
+        srv.stop()
+    assert scrape.ok is False
+    assert scrape.metrics_text == ""
+    assert "404" in scrape.error
+
+
+def test_http_source_scrapes_a_real_telemetry_server():
+    _, reg, tracer, flight = make_node("n")
+    reg.counter("serve.submitted").inc(5)
+    t = tracer.start_trace("serve.request")
+    t.start_span("request").end()
+    t.finish()
+    flight.record("serve.retry", attempt=1)
+    srv = TelemetryServer(registries=[reg], tracer=tracer, flight=flight,
+                          health=lambda: (True, {"role": "replica"})).start()
+    try:
+        scrape = HTTPNodeSource("n", srv.url, role="replica").scrape()
+    finally:
+        srv.stop()
+    assert scrape.ok and scrape.healthy
+    assert "serve_submitted_total 5" in scrape.metrics_text
+    assert len(scrape.traces) == 1
+    assert scrape.flight[-1]["kind"] == "serve.retry"
+    assert scrape.health["role"] == "replica"
+
+
+# ------------------------------------------- incidents through the door
+
+
+def test_replica_incident_visible_through_fleet_view():
+    src_a, *_ = make_node("a")
+    src_b, _, _, flight_b = make_node("b", role="replica")
+    col = FleetCollector([src_a, src_b], clock=FakeClock(),
+                         flight=FlightRecorder(), poll_interval_s=0)
+    col.poll()
+    assert col.incidents() == {}
+    # an incident fires ON THE REPLICA (breaker trip / typed error / SLO
+    # burn all land here) — the collector pulls the remote window
+    flight_b.record("serve.retry", key="bfs_2", attempt=1)
+    flight_b.incident("serve_error", error="InjectedFault", tickets=3)
+    col.poll()
+    snap = col.incidents()
+    assert "b" in snap and snap["b"]["reason"] == "serve_error"
+    # the PULLED window holds the remote history leading into it
+    kinds = [r["kind"] for r in snap["b"]["window"]]
+    assert "serve.retry" in kinds and "incident" in kinds
+    ok, payload = col.fleet_healthz()
+    assert payload["incidents"]["b"]["reason"] == "serve_error"
+    assert "window" not in payload["incidents"]["b"]  # summary, not bulk
+    assert col.registry.get("fleet.incidents_seen").value == 1
+    # re-polling the same window does not recount
+    col.poll()
+    assert col.registry.get("fleet.incidents_seen").value == 1
+
+
+# ----------------------------------------------------- door HTTP wiring
+
+
+class _NullDoor:
+    """A minimal submit_fn stand-in: the fleet routes don't need it."""
+
+    def __call__(self, payload):  # pragma: no cover - not exercised
+        raise Unservable("no backends in this test")
+
+
+@pytest.fixture
+def door():
+    src_a, src_b, tr, _ = joined_pair()
+    col = FleetCollector([src_a, src_b], clock=FakeClock(),
+                         flight=FlightRecorder(), poll_interval_s=0)
+    col.slo = obs.SLOMonitor(clock=col.clock, flight=col.flight)
+    col.slo.add(obs.Objective("availability", 0.999))
+    col.poll()
+    srv = SubmitServer(_NullDoor(), fleet=col).start()
+    try:
+        yield srv, col, tr
+    finally:
+        srv.stop()
+
+
+def test_door_serves_fleet_metrics_and_healthz(door):
+    srv, col, tr = door
+    status, body = get(srv.url + "/fleet/metrics")
+    assert status == 200
+    assert 'node="a"' in body and 'node="b"' in body
+    status, body = get(srv.url + "/fleet/healthz")
+    assert status == 200
+    payload = json.loads(body)
+    assert payload["nodes_total"] == 2 and payload["role"] == "fleet"
+
+
+def test_door_serves_one_joined_fleet_trace(door):
+    srv, col, tr = door
+    status, body = get(srv.url + f"/fleet/traces/{tr.trace_id}")
+    assert status == 200
+    joined = json.loads(body)
+    assert joined["trace_id"] == tr.trace_id
+    assert joined["n_processes"] == 2          # the acceptance bar
+    assert {s["node"] for s in joined["spans"]} == {"a", "b"}
+    status, body = get(srv.url + "/fleet/traces")
+    assert status == 200
+    assert any(s["trace_id"] == tr.trace_id
+               for s in json.loads(body)["traces"])
+    status, _ = get(srv.url + "/fleet/traces/12345")
+    assert status == 404
+    status, _ = get(srv.url + "/fleet/traces/not-an-id")
+    assert status == 400
+
+
+def test_door_serves_slo_snapshot(door):
+    srv, col, tr = door
+    status, body = get(srv.url + "/fleet/slo")
+    assert status == 200
+    snap = json.loads(body)
+    assert "availability" in snap
+    assert snap["availability"]["target"] == 0.999
+
+
+def test_door_without_fleet_404s_fleet_routes():
+    srv = SubmitServer(_NullDoor()).start()
+    try:
+        status, _ = get(srv.url + "/fleet/metrics")
+        assert status == 404
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------------------- EXPLAIN
+
+
+def make_traced_runtime():
+    tracer = Tracer(clock=FakeClock()).enable()
+    clock = FakeClock()
+    cfg = ServeConfig(buckets=(4,), max_linger_s=0.0, clock=clock,
+                      manual=True, tracer=tracer)
+    rt = ServeRuntime(graph=None, config=cfg, executor=FakeExecutor())
+    return rt, tracer, clock
+
+
+def test_explain_requires_tracing():
+    cfg = ServeConfig(buckets=(4,), max_linger_s=0.0, clock=FakeClock(),
+                      manual=True, tracer=Tracer())  # NOT enabled
+    rt = ServeRuntime(graph=None, config=cfg, executor=FakeExecutor())
+    with pytest.raises(Unservable):
+        rt.submit_bfs(1, explain=True)
+    rt.close()
+
+
+def test_explain_agrees_exactly_with_drained_span_tree():
+    rt, tracer, clock = make_traced_runtime()
+    fut = rt.submit_bfs(1, explain=True)
+    rt.step(drain=True)
+    res = fut.result(timeout=0)
+    rec = fut.explain
+    assert rec is not None
+    rt.close()
+    # the independently drained trace is the record's source of truth
+    drained = [t for t in tracer.drain() if t.name == "serve.request"
+               and t.trace_id == rec["trace_id"]]
+    assert len(drained) == 1
+    again = explain_record(drained[0], result=res, lane_path="device",
+                           breaker_state=rec["breaker"])
+    for k in ("lane", "occupancy", "bucket", "lanes_real", "device_s",
+              "queue_wait_s", "retries", "total_s", "count",
+              "trace_id"):
+        assert again[k] == rec[k], k
+    assert rec["lane"] == "bfs/device"
+    assert rec["occupancy"] == pytest.approx(0.25)   # 1 real / bucket 4
+    assert rec["retries"] == 0
+    assert rec["breaker"] == "closed"
+
+
+def test_explain_record_is_attached_before_result_delivery():
+    rt, tracer, clock = make_traced_runtime()
+    futs = [rt.submit_bfs(i, explain=True) for i in range(3)]
+    rt.step(drain=True)
+    for fut in futs:
+        fut.result(timeout=0)
+        # no settling window: the record must already be there
+        assert fut.explain["kind"] == "bfs"
+    rt.close()
+
+
+def test_explain_survives_any_sampling_rate():
+    rt, tracer, clock = make_traced_runtime()
+    tracer.set_sample_rate("serve.request", 0.0)   # drop everything...
+    fut = rt.submit_bfs(1, explain=True)
+    rt.step(drain=True)
+    fut.result(timeout=0)
+    assert fut.explain is not None                 # ...except explained
+    assert any(t.trace_id == fut.explain["trace_id"]
+               for t in tracer.drain())            # retained for the fleet
+    rt.close()
+
+
+def test_explain_rides_the_submit_payload_schema():
+    tracer = Tracer(clock=FakeClock()).enable()
+    cfg = ServeConfig(buckets=(4,), max_linger_s=0.0, tracer=tracer)
+    rt = ServeRuntime(graph=None, config=cfg, executor=FakeExecutor())
+    try:
+        out = submit_payload(
+            rt, {"kind": "bfs", "seed": 1, "explain": True}, 10.0,
+            node_id="replica-1",
+        )
+        assert out["explain"]["lane"] == "bfs/device"
+        assert out["explain"]["node"] == "replica-1"
+        assert out["explain"]["trace_id"] > 0
+        # without the flag the response carries no explain key
+        out2 = submit_payload(rt, {"kind": "bfs", "seed": 1}, 10.0)
+        assert "explain" not in out2
+    finally:
+        rt.close()
+
+
+def test_explain_over_http_submit():
+    tracer = Tracer(clock=FakeClock()).enable()
+    cfg = ServeConfig(buckets=(4,), max_linger_s=0.0, tracer=tracer)
+    rt = ServeRuntime(graph=None, config=cfg, executor=FakeExecutor())
+    srv = SubmitServer(
+        lambda p: submit_payload(rt, p, 10.0, node_id="n1")
+    ).start()
+    try:
+        body = json.dumps({"kind": "bfs", "seed": 2, "explain": True})
+        req = urllib.request.Request(
+            srv.url + "/submit", data=body.encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            out = json.loads(r.read().decode())
+        assert out["explain"]["node"] == "n1"
+        assert out["explain"]["lane"] == "bfs/device"
+        assert out["explain"]["occupancy"] is not None
+    finally:
+        srv.stop()
+        rt.close()
+
+
+# ------------------------------------- the replicated tier, end to end
+
+
+def wait_for(cond, timeout=15.0):
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_replicated_tier_fleet_view_end_to_end():
+    """The tier PRs 9–12 built, observed as ONE system: a primary and a
+    replica with their own tracers, a front door with the fleet
+    collector, and — through the door's HTTP port — a merged metrics
+    page, a cross-PROCESS trace joined from both peers' halves, and a
+    replica-side flight incident surfaced in the fleet health view."""
+    import hypergraphdb_tpu as hg
+    from hypergraphdb_tpu.peer.peer import HyperGraphPeer
+    from hypergraphdb_tpu.peer.transport import LoopbackNetwork
+    from hypergraphdb_tpu.replica import (
+        FrontDoor,
+        LocalBackend,
+        ReplicaConfig,
+        ReplicaNode,
+        RouterConfig,
+        frontdoor_server,
+    )
+    from hypergraphdb_tpu.obs.http import runtime_health
+
+    net = LoopbackNetwork()
+    gp = hg.HyperGraph()
+    pp = HyperGraphPeer.loopback(gp, net, identity="primary")
+    pp.replication.debounce_s = 0.005
+    pp.tracer = Tracer(max_finished=256).enable()
+    pp.start()
+    hs = [int(gp.add(f"n{i}")) for i in range(4)]
+    gr = hg.HyperGraph()
+    pr = HyperGraphPeer.loopback(gr, net, identity="replica-1")
+    pr.replication.debounce_s = 0.005
+    pr.tracer = Tracer(max_finished=256).enable()
+    node = ReplicaNode(gr, pr, ReplicaConfig(
+        primary="primary",
+        serve=ServeConfig(max_linger_s=0.001, prewarm_aot=False,
+                          tracer=pr.tracer),
+    ))
+    prt = fd = fsrv = col = None
+    try:
+        node.start()
+        assert node.wait_converged(timeout=30)
+        gp.add("traced")                  # a push both tracers record
+        assert pp.replication.flush()
+        prt = ServeRuntime(gp, ServeConfig(max_linger_s=0.001,
+                                           prewarm_aot=False))
+        fd = FrontDoor(
+            LocalBackend("primary", prt, runtime_health(prt),
+                         role="primary"),
+            [LocalBackend("replica-1", node.runtime,
+                          node.health_probe())],
+            RouterConfig(poll_interval_s=0),
+        )
+        replica_flight = FlightRecorder()
+        replica_src = node.fleet_source()
+        replica_src.flight = replica_flight   # per-node recorder
+        col = FleetCollector(
+            [LocalNodeSource("primary", registries=[prt.stats.registry],
+                             tracer=pp.tracer,
+                             health=runtime_health(prt), role="primary"),
+             replica_src, fd.fleet_source()],
+            poll_interval_s=0, flight=FlightRecorder(),
+        )
+        fsrv = frontdoor_server(fd, fleet=col).start()
+
+        def joined():
+            col.poll()
+            return [s for s in col.fleet_traces()
+                    if s["n_processes"] >= 2]
+        assert wait_for(lambda: bool(joined())), col.fleet_traces()
+        tid = joined()[0]["trace_id"]
+        status, body = get(fsrv.url + f"/fleet/traces/{tid}")
+        assert status == 200
+        trace = json.loads(body)
+        assert trace["n_processes"] >= 2           # the acceptance bar
+        assert {"primary", "replica-1"} <= set(trace["processes"])
+        # one request through the door mints the router's counters
+        res = fd.submit({"kind": "bfs", "seed": hs[0], "max_hops": 1,
+                         "deadline_s": 10.0})
+        assert res["routed_to"] in ("primary", "replica-1")
+        col.poll()
+        status, body = get(fsrv.url + "/fleet/metrics")
+        assert status == 200
+        assert 'node="primary"' in body
+        assert 'node="replica-1"' in body
+        assert 'router_submitted_total{node="router"} 1' in body
+        # a replica-side incident reaches the door's fleet health view
+        replica_flight.incident("breaker_trip", key="bfs_2")
+        col.poll()
+        status, body = get(fsrv.url + "/fleet/healthz")
+        payload = json.loads(body)
+        assert payload["incidents"]["replica-1"]["reason"] == \
+            "breaker_trip"
+    finally:
+        if fsrv is not None:
+            fsrv.stop()
+        if col is not None:
+            col.stop()
+        if prt is not None:
+            prt.close()
+        node.stop()
+        pp.stop()
+        gp.close()
+        gr.close()
+
+
+# ------------------------------------------------------- lane counters
+
+
+def test_lane_counters_follow_served_path():
+    rt, tracer, clock = make_traced_runtime()
+    rt.submit_bfs(1)
+    rt.submit_pattern([2])
+    rt.step(drain=True)
+    rt.step(drain=True)
+    counts = rt.stats.lane_counts()
+    assert counts[("bfs", "device")] == 1
+    assert counts[("pattern", "device")] == 1
+    assert counts[("bfs", "host")] == 0
+    rt.close()

@@ -448,6 +448,56 @@ def test_serve_stats_namespace_no_drift():
     assert s.registry.get("serve.queue_depth").value == 3.0
 
 
+def test_serve_lane_counter_family_no_drift():
+    """The lane drift gate: every serve lane (request kind × executor
+    path) has its dispatch counter registered the moment a ServeStats
+    exists, LANE_KINDS matches the runtime's actual request vocabulary,
+    and the executors' path labels stay inside LANE_PATHS — a PR adding
+    a lane (PR 10 join, PR 12 range, PR 11 sharded) that forgets the
+    counter family fails here, not in a dashboard."""
+    from types import SimpleNamespace
+
+    from hypergraphdb_tpu.serve.runtime import DeviceExecutor
+    from hypergraphdb_tpu.serve.sharded import ShardedExecutor
+    from hypergraphdb_tpu.serve.stats import (
+        LANE_KINDS,
+        LANE_PATHS,
+        ServeStats,
+    )
+    from hypergraphdb_tpu.serve.types import (
+        BFSRequest,
+        JoinRequest,
+        PatternRequest,
+        RangeRequest,
+    )
+
+    # the registered vocabulary IS the request vocabulary
+    kinds = {
+        BFSRequest(1, 1).kind,
+        PatternRequest((1,)).kind,
+        JoinRequest(SimpleNamespace(n_consts=0, vars=()), ()).kind,
+        RangeRequest(105, None, None).kind,
+    }
+    assert kinds == set(LANE_KINDS)
+    assert set(LANE_PATHS) == {"device", "sharded", "host"}
+    # every executor's device-lane label is a registered path
+    assert DeviceExecutor.device_lane in LANE_PATHS
+    assert ShardedExecutor.device_lane in LANE_PATHS
+    s = ServeStats(latency_window=8)
+    for kind in LANE_KINDS:
+        for path in LANE_PATHS:
+            m = s.registry.get(f"serve.lane.{kind}.{path}")
+            assert m is not None, (kind, path)
+            assert m.kind == "counter"
+    # recording drops unknown combinations instead of raising
+    s.record_lane("bfs", "device")
+    s.record_lane("future-kind", "device")
+    assert s.lane_counts()[("bfs", "device")] == 1
+    # reset covers the family (the bench's post-warmup cut)
+    s.reset()
+    assert all(v == 0 for v in s.lane_counts().values())
+
+
 def test_serve_stats_shared_namespace_with_graph_metrics():
     """ServeStats and Metrics can share ONE process registry without
     name collisions — the unified-surface claim."""

@@ -1,0 +1,259 @@
+"""hgslo: sliding-window error budgets + multi-window burn-rate alerts.
+
+Everything runs on fake clocks; the acceptance contract is the chaos
+smoke at the bottom: a serving runtime shedding past its deadline SLO
+fires a burn-rate incident THROUGH the flight recorder, window dump on
+disk.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from hypergraphdb_tpu.obs.flight import FlightRecorder, parse_flight_jsonl
+from hypergraphdb_tpu.obs.fleet import FleetCollector, LocalNodeSource
+from hypergraphdb_tpu.obs.slo import (
+    Objective,
+    SLOMonitor,
+    fleet_objectives,
+)
+from tests.test_serve_runtime import FakeClock, FakeExecutor, make_runtime
+
+
+def make_monitor(windows=((10.0, 2.0), (60.0, 1.0)), target=0.99,
+                 incident_dir=None):
+    clock = FakeClock()
+    flight = FlightRecorder(clock=clock, incident_dir=incident_dir,
+                            min_dump_interval_s=0.0)
+    mon = SLOMonitor(clock=clock, flight=flight)
+    mon.add(Objective("obj", target, windows=windows))
+    return mon, clock, flight
+
+
+# ---------------------------------------------------------------- windows
+
+
+def test_objective_validation():
+    with pytest.raises(ValueError):
+        Objective("x", 1.5)
+    with pytest.raises(ValueError):
+        Objective("x", 0.99, windows=())
+    with pytest.raises(ValueError):
+        Objective("x", 0.99, windows=((60.0, 1.0), (10.0, 2.0)))  # order
+
+
+def test_burn_rate_math_over_sliding_window():
+    mon, clock, _ = make_monitor()
+    good = bad = 0
+    for _ in range(60):
+        clock.advance(1.0)
+        good += 95
+        bad += 5                     # 5% errors against a 1% budget
+        mon.record("obj", good, bad)
+    snap = mon.tick()["obj"]
+    fast = snap["windows"][0]
+    assert fast["error_ratio"] == pytest.approx(0.05)
+    assert fast["burn_rate"] == pytest.approx(5.0)
+    assert snap["budget_remaining"] == pytest.approx(1.0 - 5.0, rel=1e-3)
+
+
+def test_alert_needs_every_window_burning():
+    # fast window burns, the long window has already recovered: no alert
+    mon, clock, flight = make_monitor(windows=((10.0, 2.0), (60.0, 4.0)))
+    good = bad = 0
+    for i in range(60):
+        clock.advance(1.0)
+        good += 99
+        bad += 3 if i >= 50 else 0   # errors only in the last 10 s
+        mon.record("obj", good, bad)
+        snap = mon.tick()["obj"]
+    fast, slow = snap["windows"]
+    assert fast["burning"] is True
+    assert slow["burning"] is False
+    assert snap["alerting"] is False
+    assert flight.incidents == 0
+
+
+def test_idle_windows_never_alert():
+    mon, clock, flight = make_monitor()
+    for _ in range(100):
+        clock.advance(1.0)
+        mon.record("obj", 0, 0)      # an idle fleet must not page
+        snap = mon.tick()["obj"]
+    assert snap["alerting"] is False
+    assert all(w["burn_rate"] is None for w in snap["windows"])
+    assert flight.incidents == 0
+
+
+def test_alert_fires_once_and_rearms_after_recovery():
+    mon, clock, flight = make_monitor()
+    good = bad = 0
+    for _ in range(100):             # sustained 50% errors
+        clock.advance(1.0)
+        good += 5
+        bad += 5
+        mon.record("obj", good, bad)
+        mon.tick()
+    assert flight.incidents == 1     # edge-triggered, not per-eval
+    snap = mon.snapshot()["obj"]
+    assert snap["alerting"] is True and snap["alerts_total"] == 1
+    for _ in range(200):             # clean recovery
+        clock.advance(1.0)
+        good += 10
+        mon.record("obj", good, bad)
+        mon.tick()
+    assert mon.snapshot()["obj"]["alerting"] is False
+    good += 0
+    for _ in range(100):             # burn again → second incident
+        clock.advance(1.0)
+        bad += 5
+        good += 5
+        mon.record("obj", good, bad)
+        mon.tick()
+    assert flight.incidents == 2
+
+
+def test_flapping_short_window_stays_one_alert():
+    """Hysteresis re-arms only once EVERY window recovers: a sustained
+    long-window burn whose short window dips clean for a tick must not
+    fire one incident per oscillation."""
+    mon, clock, flight = make_monitor(windows=((10.0, 2.0), (60.0, 1.0)))
+    good = bad = 0
+    for _ in range(60):              # sustained burn: both windows hot
+        clock.advance(1.0)
+        good += 5
+        bad += 5
+        mon.record("obj", good, bad)
+        mon.tick()
+    assert flight.incidents == 1
+    for i in range(60):              # short window flaps, long stays hot
+        clock.advance(1.0)
+        if i % 12 < 6:
+            good += 10               # clean burst: fast window recovers
+        else:
+            good += 5
+            bad += 5                 # ...then burns again
+        mon.record("obj", good, bad)
+        snap = mon.tick()["obj"]
+    assert snap["windows"][1]["burning"] is True   # the outage persists
+    assert flight.incidents == 1                   # still ONE alert
+    assert snap["alerts_total"] == 1
+
+
+def test_snapshot_is_a_pure_read():
+    mon, clock, flight = make_monitor()
+    good = bad = 0
+    for _ in range(100):
+        clock.advance(1.0)
+        good += 5
+        bad += 5
+        mon.record("obj", good, bad)
+        snap = mon.snapshot()["obj"]     # reads only: no alert edges
+    assert snap["alerting"] is False
+    assert flight.incidents == 0
+    mon.tick()                            # the tick owns the edge
+    assert flight.incidents == 1
+
+
+def test_incident_dump_written_with_window(tmp_path):
+    mon, clock, flight = make_monitor(incident_dir=str(tmp_path))
+    flight.record("serve.retry", attempt=1)   # context BEFORE the burn
+    good = bad = 0
+    for _ in range(100):
+        clock.advance(1.0)
+        good += 1
+        bad += 9
+        mon.record("obj", good, bad)
+        mon.tick()
+    snap = mon.snapshot()["obj"]
+    path = snap["last_incident"]
+    assert path is not None and os.path.exists(path)
+    assert os.path.basename(path).startswith("flight_")
+    recs = parse_flight_jsonl(open(path).read())
+    kinds = [r["kind"] for r in recs]
+    assert "serve.retry" in kinds             # the window leading in
+    incident = next(r for r in recs if r["kind"] == "incident")
+    assert incident["reason"] == "slo_burn_obj"
+    assert incident["objective"] == "obj"
+
+
+def test_unknown_objective_records_are_ignored():
+    mon, clock, _ = make_monitor()
+    mon.record("nope", 1, 1)          # a foreign node's objective
+    assert "nope" not in mon.snapshot()
+
+
+# --------------------------------------------------- fleet standard trio
+
+
+def replica_source(node_id, lag, bound=4, healthy=True):
+    def health():
+        return healthy, {"role": "replica", "replication_lag": lag,
+                         "lag_bound": bound, "breaker_worst": 0}
+
+    return LocalNodeSource(node_id, health=health, role="replica")
+
+
+def test_fleet_objectives_lag_and_availability_sources():
+    clock = FakeClock()
+    col = FleetCollector(
+        [replica_source("fresh", lag=0),
+         replica_source("stale", lag=9),        # past its bound
+         replica_source("down", lag=0, healthy=False)],
+        clock=clock, flight=FlightRecorder(clock=clock),
+        poll_interval_s=0,
+    )
+    mon = fleet_objectives(col, windows=((10.0, 1.5), (30.0, 1.0)))
+    col.slo = mon
+    for _ in range(40):
+        clock.advance(1.0)
+        col.poll()
+    snap = mon.snapshot()
+    # replication_lag: 1 of 3 replicas over bound → ratio 1/3
+    lag = snap["replication_lag"]["windows"][-1]
+    assert lag["error_ratio"] == pytest.approx(1 / 3, rel=1e-3)
+    # availability: the unhealthy node is the bad third
+    avail = snap["availability"]["windows"][-1]
+    assert avail["error_ratio"] == pytest.approx(1 / 3, rel=1e-3)
+    assert snap["replication_lag"]["alerting"] is True
+
+
+# ------------------------------------------------------- chaos smoke
+
+
+def test_chaos_shed_past_deadline_slo_fires_burn_incident(tmp_path):
+    """The acceptance smoke: a runtime shedding past its deadline SLO
+    fires a burn-rate incident WITH a flight dump — serve terminals →
+    collector scrape → SLO windows → flight incident machinery,
+    end to end on fake clocks."""
+    clock = FakeClock()
+    rt, ex, _ = make_runtime(clock=clock)
+    flight = FlightRecorder(clock=clock, incident_dir=str(tmp_path),
+                            min_dump_interval_s=0.0)
+    col = FleetCollector(
+        [LocalNodeSource("primary", registries=[rt.stats.registry],
+                         health=lambda: (True, {"role": "primary"}))],
+        clock=clock, flight=flight, poll_interval_s=0,
+    )
+    col.slo = fleet_objectives(col, deadline_target=0.9,
+                               windows=((10.0, 2.0), (30.0, 1.5)))
+    # chaos: every request's deadline expires in the queue — 100% shed
+    # against a 10% error budget
+    for _ in range(40):
+        clock.advance(1.0)
+        fut = rt.submit_bfs(1, deadline_s=0.25)
+        clock.advance(0.5)            # expire before dispatch
+        rt.step(drain=True)           # shed in the admission queue
+        assert fut.done()
+        col.poll()                    # scrape + SLO tick
+    assert rt.stats.shed_deadline == 40
+    snap = col.slo.snapshot()["serve_deadline"]
+    assert snap["alerting"] is True
+    path = snap["last_incident"]
+    assert path is not None and os.path.exists(path)
+    recs = parse_flight_jsonl(open(path).read())
+    incident = next(r for r in recs if r["kind"] == "incident")
+    assert incident["reason"] == "slo_burn_serve_deadline"
+    rt.close()

@@ -2,10 +2,15 @@
 # hgobs telemetry gate: the observability suite — tracing/sampling units,
 # the serving span-chain + overhead differential, cross-process peer
 # tracing (replication push / catch-up / snapshot transfer span trees),
-# the flight recorder, and the HTTP endpoint tests — followed by a live
-# end-to-end smoke: start a real ServeRuntime + TelemetryServer and
-# scrape /metrics and /healthz over actual HTTP (curl when present,
-# stdlib urllib otherwise — CI images without curl still smoke).
+# the flight recorder, the HTTP endpoint tests, and the FLEET plane
+# (collector merges, cross-process trace assembly, SLO burn alerts,
+# EXPLAIN) — followed by two live smokes over actual HTTP (curl when
+# present, stdlib urllib otherwise — CI images without curl still
+# smoke): (1) a real ServeRuntime + TelemetryServer scraped at /metrics
+# and /healthz; (2) a primary + 2 replicas + front door, the fleet
+# collector scraping every node's telemetry port, and /fleet/metrics,
+# /fleet/slo, and one joined /fleet/traces/<tid> spanning two processes
+# fetched from the door.
 #
 # Sits beside lint.sh (AST hazards), verify.sh (jaxpr ground truth), and
 # chaos.sh (fault injection): this one gates the telemetry plane.
@@ -21,6 +26,8 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
     tests/test_peer_tracing.py \
     tests/test_flight.py \
     tests/test_obs_http.py \
+    tests/test_fleet.py \
+    tests/test_slo.py \
     -q -m 'not slow' -p no:cacheprovider "$@"
 rc=$?
 if [ "$rc" -ne 0 ]; then
@@ -79,6 +86,144 @@ smoke_rc=$?
 if [ "$smoke_rc" -ne 0 ]; then
     echo "tools/obs.sh: live endpoint smoke failed (exit $smoke_rc)" >&2
     exit "$smoke_rc"
+fi
+
+# -- live smoke 2: the FLEET behind the front door ---------------------------
+# primary + 2 serving replicas + front door over real HTTP sockets, each
+# node's TelemetryServer scraped by the fleet collector via
+# HTTPNodeSource; /fleet/metrics, /fleet/slo, and one joined
+# /fleet/traces/<tid> spanning two processes fetched from the DOOR.
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - <<'PY'
+import json
+import shutil
+import subprocess
+import time
+import urllib.request
+
+import hypergraphdb_tpu as hg
+from hypergraphdb_tpu import obs
+from hypergraphdb_tpu.obs.fleet import FleetCollector, HTTPNodeSource
+from hypergraphdb_tpu.obs.http import TelemetryServer, runtime_health
+from hypergraphdb_tpu.obs.slo import fleet_objectives
+from hypergraphdb_tpu.obs.trace import Tracer
+from hypergraphdb_tpu.peer.peer import HyperGraphPeer
+from hypergraphdb_tpu.peer.transport import LoopbackNetwork
+from hypergraphdb_tpu.replica import (
+    FrontDoor,
+    LocalBackend,
+    ReplicaConfig,
+    ReplicaNode,
+    RouterConfig,
+    frontdoor_server,
+)
+from hypergraphdb_tpu.serve import ServeConfig, ServeRuntime
+
+curl = shutil.which("curl")
+
+
+def scrape(url):
+    if curl:
+        out = subprocess.run([curl, "-fsS", "--max-time", "10", url],
+                             check=True, capture_output=True, text=True)
+        return out.stdout
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.read().decode()
+
+
+net = LoopbackNetwork()
+gp = hg.HyperGraph()
+pp = HyperGraphPeer.loopback(gp, net, identity="primary")
+pp.replication.debounce_s = 0.005
+pp.tracer = Tracer(max_finished=256).enable()
+pp.start()
+hs = [int(gp.add(f"s{i}")) for i in range(8)]
+for i in range(7):
+    gp.add_link([hs[i], hs[i + 1]], value=f"e{i}")
+
+nodes, tsrvs = [], []
+for ident in ("r1", "r2"):
+    gr = hg.HyperGraph()
+    pr = HyperGraphPeer.loopback(gr, net, identity=ident)
+    pr.replication.debounce_s = 0.005
+    pr.tracer = Tracer(max_finished=256).enable()
+    node = ReplicaNode(gr, pr, ReplicaConfig(
+        primary="primary",
+        serve=ServeConfig(max_linger_s=0.001, top_r=8, prewarm_aot=False,
+                          tracer=pr.tracer),
+    ))
+    node.start()
+    assert node.wait_converged(timeout=60), f"{ident} never converged"
+    nodes.append(node)
+    tsrvs.append(TelemetryServer(
+        registries=[node.runtime.stats.registry, gr.metrics.registry],
+        tracer=pr.tracer, health=node.health_probe(),
+    ).start())
+gp.add("traced-tail")  # a push every replica records under one trace id
+
+prt = ServeRuntime(gp, ServeConfig(max_linger_s=0.001, top_r=8,
+                                   prewarm_aot=False))
+tsrvs.append(TelemetryServer(
+    registries=[prt.stats.registry, gp.metrics.registry],
+    tracer=pp.tracer, health=runtime_health(prt),
+).start())
+fd = FrontDoor(
+    LocalBackend("primary", prt, runtime_health(prt), role="primary"),
+    [LocalBackend("r1", nodes[0].runtime, nodes[0].health_probe()),
+     LocalBackend("r2", nodes[1].runtime, nodes[1].health_probe())],
+    RouterConfig(poll_interval_s=0.1),
+).start()
+col = FleetCollector(
+    [HTTPNodeSource("r1", tsrvs[0].url, role="replica"),
+     HTTPNodeSource("r2", tsrvs[1].url, role="replica"),
+     HTTPNodeSource("primary", tsrvs[2].url, role="primary"),
+     fd.fleet_source()],
+    poll_interval_s=0.1,
+)
+col.slo = fleet_objectives(col, windows=((5.0, 14.4), (30.0, 6.0)))
+col.start()
+fsrv = frontdoor_server(fd, fleet=col).start()
+try:
+    res = fd.submit({"kind": "bfs", "seed": hs[0], "max_hops": 2,
+                     "deadline_s": 10.0})
+    assert res["routed_to"], res
+    metrics = scrape(fsrv.url + "/fleet/metrics")
+    assert 'serve_submitted_total{node="r1"}' in metrics, metrics[:300]
+    assert 'node="primary"' in metrics
+    slo = json.loads(scrape(fsrv.url + "/fleet/slo"))
+    assert "serve_deadline" in slo and "availability" in slo, slo
+    joined = None
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and joined is None:
+        col.poll()
+        for s in col.fleet_traces():
+            if s["n_processes"] >= 2:
+                joined = s
+                break
+        time.sleep(0.05)
+    assert joined is not None, "no cross-process trace assembled"
+    trace = json.loads(scrape(fsrv.url + f"/fleet/traces/{joined['trace_id']}"))
+    assert trace["n_processes"] >= 2, trace["processes"]
+    print(f"tools/obs.sh fleet smoke: {fsrv.url} — /fleet/metrics + "
+          f"/fleet/slo OK; trace {trace['trace_id']} spans "
+          f"{trace['processes']} ({'curl' if curl else 'urllib'})")
+finally:
+    fsrv.stop()
+    col.stop()
+    fd.stop()
+    prt.close()
+    for t in tsrvs:
+        t.stop()
+    for node in nodes:
+        node.stop()
+    pp.stop()
+    gp.close()
+    for node in nodes:
+        node.graph.close()
+PY
+fleet_rc=$?
+if [ "$fleet_rc" -ne 0 ]; then
+    echo "tools/obs.sh: fleet smoke failed (exit $fleet_rc)" >&2
+    exit "$fleet_rc"
 fi
 echo "tools/obs.sh: observability gate green"
 exit 0
