@@ -1076,11 +1076,26 @@ def bench_c7(snap, info):
     lane the caps still truncate is excluded from the differential and
     reported.
 
+    Join engine v2 adds the HUB-HEAVY configuration (``hub_heavy`` in
+    the recorded result): a mixed batch of hub anchors (co-degree past
+    ``BENCH_C7_HUB_THRESHOLD`` — the lanes PR 10's padded executor
+    truncated onto the host path) and tail anchors, run three ways —
+    the degree-split executor, the PR-10 flat executor
+    (``hub_split=False``), and the degree-split executor over the
+    factorized trie relations — recording the tail-vs-hub lane ratio,
+    ``split_vs_pr10`` and ``factorized_vs_flat`` throughput ratios, and
+    both differential verdicts.
+
     Env knobs: BENCH_SEEDS (anchors per window), BENCH_C7_MAX_DEG
-    (anchor co-degree bound — hubs route to the serving tier's host
-    lane in production, same honesty here), BENCH_C7_ROW_CAP /
-    BENCH_C7_PAD_CAP (executor caps), BENCH_C7_BASELINE_N (host-engine
-    sample), BENCH_C7_REPS."""
+    (tail-anchor co-degree bound — the device-servable tail population;
+    hub anchors now serve through the degree-split path instead of
+    routing to host), BENCH_C7_ROW_CAP / BENCH_C7_PAD_CAP (executor
+    caps — smoke-tuned defaults; the CPU smoke cannot tune them for
+    real HBM, see README), BENCH_C7_BASELINE_N (host-engine sample),
+    BENCH_C7_REPS, BENCH_C7_HUB_THRESHOLD (hub split bound, default
+    MAX_DEG), BENCH_C7_HUB_MAX (hub sample's width ceiling, default
+    4×threshold — the fell-off-pad band, not the top-0.01% monsters),
+    BENCH_C7_HUB_N (hub lanes per dispatch, default half)."""
     import jax
 
     from hypergraphdb_tpu.join.ir import (
@@ -1089,7 +1104,11 @@ def bench_c7(snap, info):
         split_constants,
     )
     from hypergraphdb_tpu.join.planner import plan_join
-    from hypergraphdb_tpu.ops.join import execute_join, neighbor_csr
+    from hypergraphdb_tpu.ops.join import (
+        execute_join,
+        factorized_relations,
+        neighbor_csr,
+    )
 
     r = np.random.default_rng(43)
     K = int(os.environ.get("BENCH_SEEDS", 1024))
@@ -1249,6 +1268,117 @@ def bench_c7(snap, info):
                 [int(anchors[i]), int(counts[i]), int(hc[i])]
                 for i in bad
             ]
+    # -- hub-heavy configuration (join engine v2) ----------------------------
+    # TRIANGLES through anchors the PR-10 executor excluded: co-rows
+    # past the hub threshold (triangle keeps every step const-keyed, so
+    # the hub chain's chunked expansion serves the whole plan — the
+    # pattern's multiway intersections probe the other relations by
+    # binary search, width-free). Hub anchors sample just ABOVE the
+    # threshold (bounded by BENCH_C7_HUB_MAX): the fell-off-pad
+    # population the split reclaims, not the top-0.01% monsters whose
+    # binding tables outgrow any row budget. Mixed with tails so ONE
+    # dispatch exercises both chains; count-only, exact-count shape
+    # policy (var_pad_max) for all three modes so the comparison is the
+    # executor, not the pads.
+    hub_thr = int(os.environ.get("BENCH_C7_HUB_THRESHOLD", max_deg))
+    hub_cap = int(os.environ.get("BENCH_C7_HUB_MAX", 4 * hub_thr))
+    n_hub = min(int(os.environ.get("BENCH_C7_HUB_N",
+                                   max(lanes // 2, 1))), lanes)
+    w_ent = all_w[e0:l0]
+    hub_pool = np.flatnonzero((w_ent > hub_thr) & (w_ent <= hub_cap)) \
+        + e0
+    if not len(hub_pool):
+        # no anchor in the band at this scale: take the widest rows and
+        # drop the threshold just under them so the split still engages
+        # (recorded — the smoke stays honest about it)
+        hub_pool = np.argsort(w_ent)[-max(4 * n_hub, 8):] + e0
+        hub_thr = max(int(all_w[hub_pool].min()) - 1, 2)
+    hub_anchors = hub_pool[r.integers(0, len(hub_pool), size=n_hub)]
+    tail_anchors = cand[r.integers(0, len(cand), size=lanes - n_hub)]
+    anchors_h = np.concatenate([hub_anchors, tail_anchors]) \
+        .astype(np.int64)
+    pat_h = pattern_of("triangle", int(anchors_h[0]))
+    sig_h, consts0_h = split_constants(pat_h)
+    plan_h = plan_join(snap, pat_h, sig_h, consts0_h)
+    consts_h = np.repeat(anchors_h[:, None], 2, axis=1) \
+        .astype(np.int32)
+
+    t0 = time.perf_counter()
+    fact = factorized_relations(snap)
+    fact_build_s = time.perf_counter() - t0
+
+    def hub_run(mode: str):
+        kw = dict(top_r=0, count_only=True, row_cap=row_cap,
+                  pad_cap=pad_cap, var_pad_max=True)
+        if mode == "split":
+            kw.update(hub_threshold=hub_thr, factorized=False)
+        elif mode == "fact":
+            kw.update(hub_threshold=hub_thr, factorized=True)
+        else:                                   # the PR-10 executor
+            kw.update(hub_split=False, factorized=False)
+        return execute_join(snap, plan_h, consts_h, **kw)
+
+    hub_stats: dict = {
+        "hub_threshold": hub_thr,
+        "hub_lanes": n_hub,
+        "tail_lanes": lanes - n_hub,
+        "lane_ratio": round((lanes - n_hub) / max(n_hub, 1), 2),
+        "max_hub_width": int(all_w[hub_anchors].max()),
+        "fact_build_s": round(fact_build_s, 3),
+        "fact_entries": fact["co"].entries,
+        "fact_entries_flat": fact["co"].entries_flat,
+        "fact_groups": fact["co"].n_groups,
+    }
+    # throughput metric: EXACTLY-SERVED anchors per second — a
+    # truncated lane re-routes to the exact host path in production
+    # (orders of magnitude slower), so it is not served by the device
+    # path whatever the wall clock says. This is what makes the
+    # split-vs-PR10 comparison honest: PR 10 truncates the hub lanes
+    # (fast but unserved), the split serves them.
+    mode_counts = {}
+    for mode, key in (("split", "device_anchors_per_sec"),
+                      ("pr10", "pr10_anchors_per_sec"),
+                      ("fact", "fact_anchors_per_sec")):
+        jax.block_until_ready(hub_run(mode).counts)   # compile warmup
+
+        def timed_hub(mode=mode):
+            t0 = time.perf_counter()
+            ex = hub_run(mode)
+            jax.block_until_ready(ex.counts)
+            dt = time.perf_counter() - t0
+            exact = lanes - int(np.asarray(ex.trunc).sum())
+            return exact / dt, (ex, lanes / dt)
+
+        qps, (ex, raw_qps) = best_of(timed_hub, n=reps)
+        hub_stats[key] = round(qps, 1)
+        hub_stats[key.replace("anchors_per_sec", "raw_per_sec")] = \
+            round(raw_qps, 1)
+        mode_counts[mode] = (np.asarray(ex.counts, dtype=np.int64),
+                             np.asarray(ex.trunc))
+        if mode == "split":
+            hub_stats["hub_lanes_dispatched"] = ex.hub_lanes
+    s_counts, s_trunc = mode_counts["split"]
+    p_counts, p_trunc = mode_counts["pr10"]
+    f_counts, f_trunc = mode_counts["fact"]
+    hub_stats["n_truncated"] = int(s_trunc.sum())
+    hub_stats["pr10_truncated"] = int(p_trunc.sum())
+    hub_stats["split_vs_pr10"] = round(
+        hub_stats["device_anchors_per_sec"]
+        / max(hub_stats["pr10_anchors_per_sec"], 1e-9), 2)
+    hub_stats["factorized_vs_flat"] = round(
+        hub_stats["fact_anchors_per_sec"]
+        / max(hub_stats["device_anchors_per_sec"], 1e-9), 2)
+    ok = ~(s_trunc | f_trunc)
+    hub_stats["factorized_equal"] = bool(
+        np.array_equal(s_counts[ok], f_counts[ok])
+    )
+    hc_h = host_counts("triangle", anchors_h[:base_n])
+    exact_h = ~s_trunc[:base_n]
+    hub_stats["differential_equal"] = bool(
+        np.array_equal(s_counts[:base_n][exact_h], hc_h[exact_h])
+    ) and bool(exact_h.any())
+    result["hub_heavy"] = hub_stats
+
     telemetry = _telemetry_dump("c7")
     if telemetry:
         # the SAME sampling snapshot the telemetry sidecar carries also

@@ -24,7 +24,11 @@ Serving rides ``ServeRuntime.submit_join`` / ``query.bridge.
 to_join_request`` — see the README "Pattern joins" section.
 """
 
-from hypergraphdb_tpu.join.host import host_join, host_join_count
+from hypergraphdb_tpu.join.host import (
+    host_join,
+    host_join_count,
+    host_join_touching,
+)
 from hypergraphdb_tpu.join.ir import (
     ConjunctivePattern,
     JoinAtom,
@@ -35,14 +39,19 @@ from hypergraphdb_tpu.join.ir import (
     split_constants,
 )
 from hypergraphdb_tpu.join.planner import (
+    BagJoin,
+    BushyJoinPlan,
     DeviceJoinPlan,
     JoinPlan,
     JoinStep,
+    hub_lane_mask,
     plan_join,
 )
 from hypergraphdb_tpu.query.variables import Var, var
 
 __all__ = [
+    "BagJoin",
+    "BushyJoinPlan",
     "ConjunctivePattern",
     "DeviceJoinPlan",
     "JoinAtom",
@@ -54,6 +63,8 @@ __all__ = [
     "extract_pattern",
     "host_join",
     "host_join_count",
+    "host_join_touching",
+    "hub_lane_mask",
     "pattern_to_conditions",
     "plan_join",
     "split_constants",

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 from hypergraphdb_tpu.join.ir import (
     ConjunctivePattern,
+    JoinAtom,
     JoinUnsupported,
     pattern_to_conditions,
 )
@@ -107,3 +108,89 @@ def host_join(graph, pattern: ConjunctivePattern) -> list[tuple]:
 
 def host_join_count(graph, pattern: ConjunctivePattern) -> int:
     return len(host_join(graph, pattern))
+
+
+def _substitute_var(graph, pattern: ConjunctivePattern, v: str, d: int):
+    """The reduced pattern with variable ``v`` bound to atom ``d``:
+    every atom touching ``v`` becomes either a constant-keyed atom on
+    its OTHER variable (relation direction rewritten — ``inc(v, w)``
+    with ``v`` a link becomes ``tgt(w, d)``, etc.) or, when the other
+    side is already a constant, a direct ``satisfies`` check on ``d``.
+    Returns ``(ok, atoms)`` — ``ok`` False when a direct check failed
+    (no tuple through this substitution exists)."""
+    atoms: list[JoinAtom] = []
+    for a in pattern.atoms:
+        if a.var == v:
+            if a.key_is_var:
+                w = a.key
+                if a.rel == "co":
+                    atoms.append(JoinAtom("co", w, d))
+                elif a.rel == "inc":
+                    # d is a link whose targets include w
+                    atoms.append(JoinAtom("tgt", w, d))
+                else:  # tgt(v, w): d ∈ targets(w) → w is a link over d
+                    atoms.append(JoinAtom("inc", w, d))
+            else:
+                cond = {"co": c.CoIncident, "inc": c.Incident,
+                        "tgt": c.Target}[a.rel](int(a.key))
+                if not cond.satisfies(graph, d):
+                    return False, ()
+        elif a.key == v:
+            # the var side stays a variable; v becomes its constant key
+            atoms.append(JoinAtom(a.rel, a.var, d))
+        else:
+            atoms.append(a)
+    return True, tuple(atoms)
+
+
+def host_join_touching(graph, pattern: ConjunctivePattern,
+                       touched) -> list[tuple]:
+    """Every binding tuple of ``pattern`` that contains at least one
+    atom from ``touched`` — the per-lane memtable correction's work set
+    (ROADMAP 2d). Soundness rests on link immutability: a tuple that is
+    a result NOW but not over the pre-ingest base must witness some
+    newly added link, and every endpoint a new link makes newly
+    co-incident/incident/target-related is the link itself or one of
+    its targets — all members of the dirty set. So enumerating tuples
+    through each ``(variable, touched atom)`` substitution
+    (:func:`_substitute_var` + :func:`host_join` on the reduced
+    pattern) covers exactly the results a device answer over the base
+    can be missing, at cost proportional to the dirty set instead of
+    the whole batch's host re-serve."""
+    out: set = set()
+    consts_in = {int(a.key) for a in pattern.atoms if not a.key_is_var}
+    touched = sorted({int(x) for x in touched})
+    for vi, v in enumerate(pattern.vars):
+        rest = tuple(x for x in pattern.vars if x != v)
+        th = pattern.type_of(v)
+        types_rest = tuple(
+            (w, t) for w, t in pattern.types if w != v
+        )
+        for d in touched:
+            if pattern.distinct and d in consts_in:
+                continue
+            if th is not None and not c.AtomType(int(th)).satisfies(
+                graph, d
+            ):
+                continue
+            ok, atoms = _substitute_var(graph, pattern, v, d)
+            if not ok:
+                continue
+            if not rest:
+                out.add((d,))
+                continue
+            sub = ConjunctivePattern(
+                vars=rest, atoms=atoms, types=types_rest,
+                distinct=pattern.distinct,
+            )
+            for t in host_join(graph, sub):
+                # the ORIGINAL pattern's all-distinct convention: no
+                # binding repeats d or any original constant (atoms the
+                # substitution folded into direct checks dropped their
+                # constant from the reduced pattern's exclusion set)
+                if pattern.distinct and (
+                    d in t or any(x in consts_in for x in t)
+                ):
+                    continue
+                out.add(t[:vi] + (d,) + t[vi:])
+    return sorted(out)

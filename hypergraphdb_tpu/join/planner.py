@@ -1,15 +1,34 @@
 """Join planning: variable elimination orders from cardinality estimates.
 
 The EmptyHeaded recipe (PAPERS.md) specialized to this engine: a
-conjunctive pattern becomes a **left-deep generalized hypertree
-decomposition** — one bag per variable, processed in an elimination
-order chosen greedily to minimize the expected binding-table growth at
-every step. Acyclic patterns (paths, stars) get the classic width-1
-GHD; cyclic ones (triangles, loops) keep every extra atom as a
-membership filter on the step that closes the cycle, which is exactly
-the worst-case-optimal leapfrog discipline (TrieJax, PAPERS.md): never
-materialize a binary join larger than the intersection the full
-conjunction allows.
+conjunctive pattern becomes a **generalized hypertree decomposition** —
+one bag per variable, processed in an elimination order chosen greedily
+to minimize the expected binding-table growth at every step. Acyclic
+patterns (paths, stars) get the classic width-1 GHD; cyclic ones
+(triangles, loops) keep every extra atom as a membership filter on the
+step that closes the cycle, which is exactly the worst-case-optimal
+leapfrog discipline (TrieJax, PAPERS.md): never materialize a binary
+join larger than the intersection the full conjunction allows.
+
+Two plan shapes come out (join engine v2):
+
+* **Left-deep** (:class:`JoinPlan`) — one chain binding every variable,
+  the PR-10 executor's shape and still the default for single-component
+  patterns.
+* **Bushy** (:class:`BushyJoinPlan`) — when the pattern's variable-
+  variable atom graph falls into ≥2 connected components (star-of-stars
+  shapes: independently-anchored sub-patterns), each component plans as
+  its own chain; the cheapest becomes the SPINE and the rest become
+  materialized **bags** (EmptyHeaded's GHD bags) joined onto the spine
+  by ``ops/join.join_bag_join`` with cross-component distinctness — a
+  bag's multi-step chain runs once per batch instead of once per spine
+  binding row.
+
+The degree-split half of v2 also lives here as policy:
+:func:`hub_lane_mask` decides which request lanes anchor on rows wider
+than the hub threshold — those run the chunked dense-frontier chain
+(``ops/join.join_hub_expand``) instead of the padded tail path, so hub
+anchors stop falling off the device path.
 
 Cardinalities come from the same places the host planner's
 ``estimate()`` chain reads — snapshot CSR offsets (exact row widths for
@@ -104,6 +123,64 @@ class JoinPlan:
             extra = f"+{len(s.filters)}f" if s.filters else ""
             parts.append(f"{s.var}←{s.source_rel}({key}){extra}")
         return "join[" + " ⋈ ".join(parts) + "]"
+
+
+def _describe_chain(order: tuple, steps) -> str:
+    parts = []
+    for s in steps:
+        key = (f"${s.source_key.index}" if s.source_key.kind == "const"
+               else order[s.source_key.index])
+        extra = f"+{len(s.filters)}f" if s.filters else ""
+        parts.append(f"{s.var}←{s.source_rel}({key}){extra}")
+    return " ⋈ ".join(parts)
+
+
+@dataclass(frozen=True)
+class BagJoin:
+    """One materialized GHD bag of a bushy plan: a variable-connected
+    component planned as its own chain. ``vars`` is the bag's local
+    elimination order (its steps' ``col`` KeyRefs index the BAG's own
+    binding table); the executor materializes the bag once per batch and
+    joins its output onto the spine (``ops/join.join_bag_join``)."""
+
+    vars: tuple[str, ...]
+    steps: tuple[JoinStep, ...]
+    est_rows: float
+
+
+@dataclass(frozen=True)
+class BushyJoinPlan:
+    """A bushy decomposition: the SPINE chain (cheapest component) plus
+    one materialized bag per remaining component, folded on in ``bags``
+    order. ``order`` concatenates the spine's and each bag's local
+    orders — binding-table column ``i`` holds ``order[i]`` after the
+    last fold, so downstream consumers (finalize permutations, result
+    assembly) read it exactly like a left-deep plan's."""
+
+    sig: PatternSignature
+    order: tuple[str, ...]
+    spine: tuple[JoinStep, ...]
+    bags: tuple[BagJoin, ...]
+    distinct: bool
+    n_consts: int
+    est_rows: float
+
+    @property
+    def steps(self) -> tuple:
+        """Every step across spine and bags — the flat view cost models
+        and dispatch annotations read; executors MUST dispatch on
+        ``bags`` instead (the chains have disjoint column spaces)."""
+        return self.spine + tuple(
+            s for b in self.bags for s in b.steps
+        )
+
+    def describe(self) -> str:
+        spine = _describe_chain(self.order, self.spine)
+        bags = " ⊗ ".join(
+            "[" + _describe_chain(b.vars, b.steps) + "]"
+            for b in self.bags
+        )
+        return f"bushy[{spine} ⊗ {bags}]"
 
 
 # ---------------------------------------------------------------- statistics
@@ -207,33 +284,15 @@ def _filter_of(atom: JoinAtom, new_var: str, key: KeyRef) -> FilterSpec:
 # ---------------------------------------------------------------- planning
 
 
-def plan_join(snap, pattern: ConjunctivePattern,
-              sig: Optional[PatternSignature] = None,
-              consts: Optional[Sequence[int]] = None,
-              seed_var: Optional[str] = None) -> JoinPlan:
-    """Choose the elimination order greedily: start from the variable
-    with the narrowest constant-anchored candidate row, then repeatedly
-    bind the connected variable whose cheapest expansion grows the
-    binding table least. Every other atom that touches already-bound
-    variables becomes a membership filter on that step (the WCO
-    intersection). Raises :class:`JoinUnsupported` for patterns no step
-    can seed (no constant anchor) or reach (disconnected variables).
-
-    ``seed_var`` pre-binds one variable externally (the caller provides
-    its candidates — ``ops/join.execute_join``'s ``seeds`` mode, how an
-    UNANCHORED pattern like global triangle counting becomes runnable:
-    chunk the id space into seeds, sum the counts). Its step is a
-    placeholder the executor skips."""
-    if sig is None or consts is None:
-        sig, consts = split_constants(pattern)
-    stats = _Stats(snap)
-    slot_of: dict[int, int] = {}
-    # atom order == slot order (split_constants contract)
-    slot = 0
-    for a in pattern.atoms:
-        if not a.key_is_var:
-            slot_of[id(a)] = slot
-            slot += 1
+def _greedy_chain(stats: "_Stats", pattern: ConjunctivePattern,
+                  slot_of: dict, chain_vars, chain_atoms,
+                  seed_var: Optional[str] = None) -> tuple:
+    """The greedy elimination core over ONE variable-connected subset:
+    seed at the narrowest constant-anchored row, then repeatedly bind
+    the connected variable whose cheapest expansion grows the binding
+    table least; every other atom touching bound variables becomes a
+    membership filter (the WCO intersection). ``col`` KeyRefs index the
+    CHAIN's own binding table. Returns ``(order, steps, est_rows)``."""
 
     def key_ref(atom: JoinAtom, bound_idx: dict) -> KeyRef:
         if atom.key_is_var:
@@ -243,7 +302,7 @@ def plan_join(snap, pattern: ConjunctivePattern,
     bound: list[str] = []
     bound_idx: dict[str, int] = {}
     steps: list[JoinStep] = []
-    remaining = list(pattern.vars)
+    remaining = list(chain_vars)
     used: set[int] = set()
     est_rows = 1.0
     if seed_var is not None:
@@ -260,7 +319,7 @@ def plan_join(snap, pattern: ConjunctivePattern,
     while remaining:
         best = None  # (width, var, atom, source KeyRef)
         for v in remaining:
-            for a in pattern.atoms:
+            for a in chain_atoms:
                 if a.var == v and (not a.key_is_var or a.key in bound_idx):
                     ref = key_ref(a, bound_idx)
                     is_const = not a.key_is_var
@@ -288,7 +347,7 @@ def plan_join(snap, pattern: ConjunctivePattern,
         w, v, src, src_ref = best
         used.add(id(src))
         filters = []
-        for a in pattern.atoms:
+        for a in chain_atoms:
             if id(a) in used:
                 continue
             if a.var == v and (not a.key_is_var or a.key in bound_idx):
@@ -315,7 +374,7 @@ def plan_join(snap, pattern: ConjunctivePattern,
         # filters are selective; the width bound alone keeps est_rows an
         # upper bound, which is what bucket sizing wants
         est_rows *= max(w, 1.0)
-    unused = [a for a in pattern.atoms if id(a) not in used]
+    unused = [a for a in chain_atoms if id(a) not in used]
     if unused:
         # only reachable in seed mode: an atom whose endpoints are the
         # seed variable and a constant has no step to ride (the caller's
@@ -325,11 +384,132 @@ def plan_join(snap, pattern: ConjunctivePattern,
             "pre-seeded variables and constants; no executor step can "
             "apply them"
         )
-    return JoinPlan(
-        sig=sig, order=tuple(bound), steps=tuple(steps),
+    return tuple(bound), tuple(steps), est_rows
+
+
+def _var_components(pattern: ConjunctivePattern) -> list:
+    """Connected components of the variable-variable atom graph, in
+    ``pattern.vars`` order (a variable with no var-var atoms is its own
+    singleton) — the bushy decomposition's bag boundaries: components
+    share no variables, only constants."""
+    parent = {v: v for v in pattern.vars}
+
+    def find(v):
+        while parent[v] != v:
+            parent[v] = parent[parent[v]]
+            v = parent[v]
+        return v
+
+    for a in pattern.atoms:
+        if a.key_is_var:
+            parent[find(a.var)] = find(a.key)
+    comps: dict = {}
+    for v in pattern.vars:
+        comps.setdefault(find(v), []).append(v)
+    return list(comps.values())
+
+
+def plan_join(snap, pattern: ConjunctivePattern,
+              sig: Optional[PatternSignature] = None,
+              consts: Optional[Sequence[int]] = None,
+              seed_var: Optional[str] = None,
+              bushy="auto"):
+    """Plan ``pattern`` over ``snap``: a left-deep :class:`JoinPlan`
+    (one greedy chain — see :func:`_greedy_chain`) or, for patterns
+    whose variable-variable graph splits into ≥2 components, a
+    :class:`BushyJoinPlan` with the cheapest component as spine and the
+    rest as materialized bags. ``bushy="auto"`` (default) goes bushy
+    exactly when a non-trivial bag exists (some component has ≥2
+    variables — singleton-only splits like a plain star gain nothing
+    over the left-deep chain); ``True``/``False`` force the shape.
+    Raises :class:`JoinUnsupported` for patterns no step can seed (no
+    constant anchor) or reach (disconnected variables).
+
+    ``seed_var`` pre-binds one variable externally (the caller provides
+    its candidates — ``ops/join.execute_join``'s ``seeds`` mode, how an
+    UNANCHORED pattern like global triangle counting becomes runnable:
+    chunk the id space into seeds, sum the counts). Its step is a
+    placeholder the executor skips; seed mode is always left-deep."""
+    if sig is None or consts is None:
+        sig, consts = split_constants(pattern)
+    stats = _Stats(snap)
+    slot_of: dict[int, int] = {}
+    # atom order == slot order (split_constants contract)
+    slot = 0
+    for a in pattern.atoms:
+        if not a.key_is_var:
+            slot_of[id(a)] = slot
+            slot += 1
+    comps = _var_components(pattern)
+    use_bushy = (
+        seed_var is None and len(comps) >= 2
+        and (bushy is True
+             or (bushy == "auto" and any(len(c) >= 2 for c in comps)))
+    )
+    if not use_bushy:
+        order, steps, est_rows = _greedy_chain(
+            stats, pattern, slot_of, list(pattern.vars),
+            list(pattern.atoms), seed_var,
+        )
+        return JoinPlan(
+            sig=sig, order=order, steps=steps,
+            distinct=pattern.distinct, n_consts=sig.n_consts,
+            est_rows=est_rows,
+        )
+    planned = []
+    for comp in comps:
+        comp_set = set(comp)
+        atoms_c = [a for a in pattern.atoms
+                   if a.var in comp_set
+                   or (a.key_is_var and a.key in comp_set)]
+        planned.append(_greedy_chain(stats, pattern, slot_of,
+                                     list(comp), atoms_c))
+    # fold the cheapest chains first: every bag join's output is the
+    # running product, so ascending size keeps intermediates minimal
+    planned.sort(key=lambda t: t[2])
+    spine_order, spine_steps, spine_est = planned[0]
+    bags = tuple(
+        BagJoin(vars=o, steps=s, est_rows=e) for o, s, e in planned[1:]
+    )
+    order = spine_order + tuple(v for b in bags for v in b.vars)
+    est_rows = spine_est
+    for b in bags:
+        est_rows *= max(b.est_rows, 1.0)
+    return BushyJoinPlan(
+        sig=sig, order=order, spine=spine_steps, bags=bags,
         distinct=pattern.distinct, n_consts=sig.n_consts,
         est_rows=est_rows,
     )
+
+
+# ---------------------------------------------------------- degree split
+
+
+def hub_lane_mask(snap, steps, consts: np.ndarray,
+                  threshold: int) -> np.ndarray:
+    """The degree-split policy (plan-level, applied to one batch's
+    constant vectors): a lane is a HUB lane when any const-keyed step
+    would expand a row wider than ``threshold`` — exactly the lanes the
+    tail path's pads cannot hold, which PR 10 truncated onto the exact
+    host lane. Hub lanes run the chunked dense-frontier chain instead
+    (``ops/join.join_hub_expand``); dedupe (tgt) steps stay on the tail
+    kernel and don't qualify a lane. O(steps × K) host arithmetic over
+    CSR offsets already resident."""
+    from hypergraphdb_tpu.ops.join import _rel_host_offsets
+
+    consts = np.asarray(consts)
+    mask = np.zeros(len(consts), dtype=bool)
+    if not len(consts):
+        return mask
+    for s in steps:
+        if s.source_key.kind != "const" or s.dedupe:
+            continue
+        off = np.asarray(_rel_host_offsets(snap, s.source_rel),
+                         dtype=np.int64)
+        keys = np.clip(consts[:, s.source_key.index].astype(np.int64),
+                       0, snap.num_atoms)
+        mask |= (off[keys + 1] - off[keys]) > threshold
+    return mask
 
 
 # ---------------------------------------------------------------- cost model
@@ -377,16 +557,30 @@ def probe_bytes() -> float:
         return _DEFAULT_PROBE_BYTES
 
 
-def device_cost_bytes(plan: JoinPlan) -> float:
+def device_cost_bytes(plan) -> float:
     """Expected device bytes for ONE request through ``plan`` — binding
     rows × expansion width × per-probe bytes × (1 + filters), summed
-    over steps."""
+    over steps. Bushy plans charge each chain independently plus the
+    product fold (one probe per joined row) — the bushy-vs-left-deep
+    saving the shape choice banks on."""
     per_probe = probe_bytes()
-    rows = 1.0
-    total = 0.0
-    for s in plan.steps:
-        total += rows * s.width_est * per_probe * (1 + len(s.filters))
-        rows *= s.width_est
+
+    def chain(steps):
+        rows = 1.0
+        total = 0.0
+        for s in steps:
+            total += rows * s.width_est * per_probe * (1 + len(s.filters))
+            rows *= s.width_est
+        return total, rows
+
+    bags = getattr(plan, "bags", None)
+    if bags is None:
+        return chain(plan.steps)[0]
+    total, rows = chain(plan.spine)
+    for b in bags:
+        bag_total, bag_rows = chain(b.steps)
+        total += bag_total + rows * bag_rows * per_probe
+        rows *= bag_rows
     return total
 
 

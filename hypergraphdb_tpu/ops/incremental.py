@@ -163,6 +163,16 @@ class PinnedView(NamedTuple):
     sharded_base: object = None    # parallel.sharded.ShardedSnapshot
     sharded_delta: object = None   # parallel.sharded.ShardedDelta
 
+    def factorized_join_rels(self):
+        """The join engine's prefix-grouped (trie) relation encodings
+        for this view's base epoch — ``ops/join.factorized_relations``'s
+        build, cached on the base snapshot exactly like the device pair
+        and the co-incidence CSR, so every view pinned within one epoch
+        shares one build and a compaction swap invalidates them
+        together. None until someone (the serve tier's plan step, or
+        prewarm) builds them; readers treat None as "serve flat"."""
+        return getattr(self.base, "_fact_rels", None)
+
 
 class SnapshotManager:
     """Owns the (base, delta) pair for one graph: listens to mutation

@@ -24,6 +24,29 @@ and the serving tier re-serves exactly that request on host
 (``serve/runtime``'s exact-at-collect discipline). Nothing is silently
 dropped.
 
+Join engine v2 (this module's three upgrades, all behind
+:func:`execute_join`'s existing contract):
+
+* **Degree-split plans** — lanes whose const-keyed rows exceed the hub
+  threshold (``join/planner.hub_lane_mask``) run their whole step chain
+  through :func:`join_hub_expand`, a chunked dense-frontier kernel that
+  streams a row of ANY width in fixed ``block``-wide tiles (an ELL-style
+  expand with the same leapfrog filters per tile) — hub anchors stop
+  truncating on expansion width entirely; tail lanes keep the single-
+  gather fast path with pads sized to TAIL widths only.
+* **Factorized trie relations** — :func:`factorized_relations` builds a
+  prefix-grouped encoding of the co-incidence/target CSRs once per
+  pinned epoch (cached on the snapshot beside the existing device
+  twins): identical rows collapse to one stored group (TrieJax's shared
+  trie prefix — every member of a link shares the link's target run),
+  so K lanes probing equal rows touch one HBM copy. The co groups store
+  CLOSED rows (self included — that is what makes same-link rows equal);
+  the kernels re-impose irreflexivity with a one-compare mask.
+* **Bushy GHD bags** — ``join/planner.BushyJoinPlan`` chains execute
+  each variable-connected component as its own bag (small intermediate
+  tables, materialized on device) and :func:`join_bag_join` joins bag
+  outputs onto the spine with the cross-component distinctness masks.
+
 The co-incidence relation (two atoms sharing a link — the pattern edge)
 is materialized once per snapshot as :func:`neighbor_csr`, the binary
 adjacency the reference's ZigZag join walks through B-tree cursors
@@ -61,6 +84,11 @@ DEFAULT_PAD_CAP = 1 << 10
 #: default candidate-slot budget per expand step (rows × pad) — the
 #: executor's peak-memory bound: 2^25 int32 slots ≈ 128 MB
 DEFAULT_SLOT_BUDGET = 1 << 25
+
+#: default dense-frontier chunk width of the hub chain: a hub row is
+#: streamed ``HUB_BLOCK`` candidates per tile however wide it is, so the
+#: hub path's peak tensor is rows × block — never rows × row-width
+DEFAULT_HUB_BLOCK = 1 << 9
 
 #: co-incidence materialization budget, in ordered pairs (Σ arity·(a-1)
 #: over links). Past it the relation itself is gigabytes and the build
@@ -158,6 +186,136 @@ def neighbor_csr_device(snap: CSRSnapshot):
     return out
 
 
+# ------------------------------------------------------- factorized relations
+
+
+@dataclass(frozen=True)
+class FactorizedRelation:
+    """A prefix-grouped (trie-style) row encoding of one CSR relation:
+    identical rows collapse into one stored GROUP, so the flat payload
+    holds each shared prefix run once instead of once per owning row
+    (TrieJax's compressed-trie trick flattened to two levels). Row
+    lookup is one extra indirection: ``flat[offsets[group_of[u]]:
+    offsets[group_of[u] + 1]]``. Group 0 is the empty row (the dummy row
+    maps there). ``closed=True`` marks the co relation's convention:
+    rows INCLUDE the owning atom — that is what makes every member of a
+    single shared link carry an identical row — and the kernels restore
+    irreflexivity with a one-compare mask."""
+
+    group_of: np.ndarray     # (N+1,) int32 — row -> group id
+    offsets: np.ndarray      # (G+1,) int32 — group extents
+    flat: np.ndarray         # (F,) int32 — unique row contents, padded
+    n_groups: int
+    entries: int             # Σ unique-group widths (pre-pad)
+    entries_flat: int        # Σ per-row widths the flat CSR stores
+    closed: bool
+    max_width: int           # widest group (the var_pad_max bound)
+
+
+def _group_rows(offsets: np.ndarray, flat: np.ndarray, n_rows: int,
+                pad_value: int) -> tuple:
+    """Group identical rows of a CSR, vectorized per length class (rows
+    of one length form a dense matrix; ``np.unique(axis=0)`` lexsorts
+    and collapses it). Returns ``(group_of, grp_offsets, grp_flat)``
+    with group 0 reserved for the empty row."""
+    offsets = np.asarray(offsets, dtype=np.int64)
+    flat = np.asarray(flat, dtype=np.int64)
+    lens = offsets[1: n_rows + 1] - offsets[:n_rows]
+    group_of = np.zeros(n_rows + 1, dtype=np.int32)   # +1: the dummy row
+    uniq_chunks = [np.empty(0, dtype=np.int64)]
+    grp_lens: list = [0]                              # group 0 = empty
+    next_g = 1
+    for length in np.unique(lens):
+        L = int(length)
+        if L == 0:
+            continue
+        ids = np.flatnonzero(lens == L)
+        mat = flat[offsets[ids][:, None] + np.arange(L, dtype=np.int64)]
+        uniq, inv = np.unique(mat, axis=0, return_inverse=True)
+        group_of[ids] = next_g + inv.astype(np.int32)
+        next_g += len(uniq)
+        uniq_chunks.append(uniq.reshape(-1))
+        grp_lens.extend([L] * len(uniq))
+    grp_offsets = np.zeros(next_g + 1, dtype=np.int32)
+    grp_offsets[1:] = np.cumsum(np.asarray(grp_lens, dtype=np.int64))
+    grp_flat = np.concatenate(uniq_chunks).astype(np.int32)
+    if len(grp_flat) % 128:
+        tail = np.full(128 - len(grp_flat) % 128, pad_value,
+                       dtype=np.int32)
+        grp_flat = np.concatenate([grp_flat, tail])
+    elif not len(grp_flat):
+        grp_flat = np.full(128, pad_value, dtype=np.int32)
+    return group_of, grp_offsets, grp_flat
+
+
+def _closed_co_csr(snap: CSRSnapshot) -> tuple[np.ndarray, np.ndarray]:
+    """The co-incidence CSR with each non-empty row CLOSED under its
+    owner (self inserted in sort position) — the content-equalizing
+    transform: all k members of one k-ary link then share one row."""
+    off, flat = neighbor_csr(snap)
+    N = snap.num_atoms
+    off64 = off[: N + 1].astype(np.int64)
+    w = np.diff(off64)
+    n_e = int(off64[N])
+    left = np.repeat(np.arange(N, dtype=np.int64), w)
+    right = flat[:n_e].astype(np.int64)
+    selfs = np.flatnonzero(w > 0).astype(np.int64)
+    left = np.concatenate([left, selfs])
+    right = np.concatenate([right, selfs])
+    order = np.lexsort((right, left))
+    left, right = left[order], right[order]
+    offsets = np.zeros(N + 2, dtype=np.int64)
+    np.cumsum(np.bincount(left, minlength=N + 1),
+              out=offsets[1: N + 2])
+    return offsets, right
+
+
+def factorized_relations(snap: CSRSnapshot) -> dict:
+    """Build (or return the cached) factorized encodings of the co and
+    tgt relations for one snapshot — once per pinned epoch, the
+    ``_nbr_csr`` caching idiom. Raises ``JoinUnsupported`` when the co
+    relation itself is over the pair budget (the build reads it)."""
+    cached = getattr(snap, "_fact_rels", None)
+    if cached is not None:
+        return cached
+    N = snap.num_atoms
+    out = {}
+    co_off, co_flat = _closed_co_csr(snap)
+    g, o, f = _group_rows(co_off, co_flat, N, pad_value=N)
+    out["co"] = FactorizedRelation(
+        group_of=g, offsets=o, flat=f, n_groups=len(o) - 1,
+        entries=int(o[-1]), entries_flat=int(co_off[N + 1]),
+        closed=True,
+        max_width=int(np.max(np.diff(o.astype(np.int64)), initial=1)),
+    )
+    e = snap.n_edges_tgt
+    g, o, f = _group_rows(snap.tgt_offsets, snap.tgt_flat[:e], N,
+                          pad_value=N)
+    out["tgt"] = FactorizedRelation(
+        group_of=g, offsets=o, flat=f, n_groups=len(o) - 1,
+        entries=int(o[-1]), entries_flat=int(e), closed=False,
+        max_width=int(np.max(np.diff(o.astype(np.int64)), initial=1)),
+    )
+    object.__setattr__(snap, "_fact_rels", out)
+    return out
+
+
+def factorized_relations_device(snap: CSRSnapshot) -> dict:
+    """Device twins of :func:`factorized_relations`, uploaded once per
+    snapshot: ``{rel: (group_of, offsets, flat)}`` jnp arrays."""
+    cached = getattr(snap, "_fact_rels_dev", None)
+    if cached is not None:
+        return cached
+    rels = factorized_relations(snap)
+    out = {
+        rel: (jnp.asarray(fr.group_of), jnp.asarray(fr.offsets),
+              jnp.asarray(fr.flat))
+        for rel, fr in rels.items()
+    }
+    object.__setattr__(snap, "_fact_rels_dev", out)
+    return out
+
+
 # ---------------------------------------------------------------- kernels
 
 
@@ -186,6 +344,87 @@ def _member_elementwise(flat, starts, ends, queries):
         & (queries != SENTINEL)
 
 
+def _norm_filt_sel(filt_sel: tuple) -> tuple:
+    """Filter selectors as 4-tuples ``(rev, kind, idx, irref)`` —
+    legacy 3-tuple call sites (the sharded lane program) read as
+    irref=False."""
+    return tuple(
+        f if len(f) == 4 else (f[0], f[1], f[2], False) for f in filt_sel
+    )
+
+
+def _seg_of(offsets, group, keys):
+    """Segment bounds of ``keys``'s rows, through the factorized group
+    indirection when the relation is grouped (``group`` is its
+    ``group_of`` column) — the one lookup difference between flat and
+    trie-encoded relations."""
+    g = keys if group is None else group[keys]
+    return offsets[g], offsets[g + 1]
+
+
+def _filter_masks(cand, cmask, safe, key_of, filt_sel, filt_offsets,
+                  filt_flats, filt_groups):
+    """The leapfrog intersection masks: one membership probe per filter
+    relation, forward (candidate ∈ row(key)) or reversed (key ∈
+    row(candidate)); ``irref`` filters additionally re-impose
+    irreflexivity over CLOSED factorized co rows."""
+    from hypergraphdb_tpu.ops.setops import segment_member_mask
+
+    for (rev, kind, kidx, irref), off_f, flat_f, grp_f in zip(
+        filt_sel, filt_offsets, filt_flats, filt_groups
+    ):
+        o = key_of((kind, kidx))
+        if not rev:
+            # candidate ∈ row(key): per-row segment, shared bounds
+            s, e = _seg_of(off_f, grp_f, o)
+            cmask = cmask & segment_member_mask(flat_f, s, e, cand)
+            if irref:
+                cmask = cmask & (cand != o[:, None])
+        else:
+            # key ∈ row(candidate): per-element segments
+            qo = jnp.broadcast_to(o[:, None], cand.shape)
+            s, e = _seg_of(off_f, grp_f, safe)
+            cmask = cmask & _member_elementwise(flat_f, s, e, qo)
+            if irref:
+                cmask = cmask & (qo != safe)
+    return cmask
+
+
+def _value_window_mask(cmask, safe, value_cols, value_win, value_ops):
+    """Rank-window leapfrog: gather each candidate's order-preserving
+    value rank words + kind byte and compare against the window — pure
+    vector compute, applied BEFORE compaction so out-of-range candidates
+    never occupy binding rows (``ops/setops``'s rank convention: 64-bit
+    ranks as two uint32 words, hi then lo; cross-kind comparisons are
+    always False)."""
+    vh = value_cols[0][safe]
+    vl = value_cols[1][safe]
+    vk = value_cols[2][safe].astype(jnp.uint32)
+    cmask = cmask & (vk == value_win[0])
+    lo_op, hi_op = value_ops
+    if lo_op is not None:
+        gt = (vh > value_win[1]) | ((vh == value_win[1])
+                                    & (vl > value_win[2]))
+        eq = (vh == value_win[1]) & (vl == value_win[2])
+        cmask = cmask & (gt | eq if lo_op == "gte" else gt)
+    if hi_op is not None:
+        gt = (vh > value_win[3]) | ((vh == value_win[3])
+                                    & (vl > value_win[4]))
+        eq = (vh == value_win[3]) & (vl == value_win[4])
+        cmask = cmask & (~gt if hi_op == "lte" else ~gt & ~eq)
+    return cmask
+
+
+def _distinct_masks(cmask, cand, cols, consts, lanes, n_distinct_cols,
+                    distinct_consts):
+    for j in range(n_distinct_cols):
+        cmask = cmask & (cand != cols[:, j, None])
+    if distinct_consts:
+        for s in range(consts.shape[1]):
+            cmask = cmask & (cand != consts[lanes, s][:, None])
+    return cmask
+
+
 @hgverify.entry(
     shapes=lambda: (
         (hgverify.sds((33,), "int32"), hgverify.sds((64,), "int32"),
@@ -198,7 +437,7 @@ def _member_elementwise(flat, starts, ends, queries):
     ),
     statics={
         "exp_sel": ("const", 0),
-        "filt_sel": ((False, "col", 0),),
+        "filt_sel": ((False, "col", 0, False),),
         "type_handle": -1,
         "pad": 8, "rows_out": 16, "n_lanes": 4,
         "n_distinct_cols": 1, "distinct_consts": True, "dedupe": False,
@@ -207,6 +446,7 @@ def _member_elementwise(flat, starts, ends, queries):
 @partial(jax.jit, static_argnames=(
     "exp_sel", "filt_sel", "type_handle", "pad", "rows_out", "n_lanes",
     "n_distinct_cols", "distinct_consts", "dedupe", "value_ops",
+    "exp_irref",
 ))
 def join_expand_step(
     exp_offsets: jax.Array,   # (N+2,) int32 — expansion CSR offsets
@@ -220,9 +460,12 @@ def join_expand_step(
     type_of: jax.Array,       # (N+1,) int32
     value_cols: Optional[tuple] = None,  # (rank_hi, rank_lo, kind) (N+1,)
     value_win: Optional[jax.Array] = None,  # (5,) uint32: kind + bound words
+    exp_group: Optional[jax.Array] = None,  # (N+1,) int32 — factorized
+    # row->group indirection of the expansion relation (None = flat CSR)
+    filt_groups: Optional[tuple] = None,    # per-filter group columns
     *,
     exp_sel: tuple,           # ("col", j) | ("const", slot)
-    filt_sel: tuple,          # ((rev, "col"|"const", idx), ...)
+    filt_sel: tuple,          # ((rev, "col"|"const", idx[, irref]), ...)
     type_handle: int,         # -1 = unconstrained
     pad: int,                 # expansion width bucket
     rows_out: int,            # binding-row bucket after this step
@@ -234,6 +477,8 @@ def join_expand_step(
     # value-rank window on THIS step's candidates (the hgindex planner
     # hook: a value predicate pruning the intersection instead of
     # post-filtering the result); None keeps the trace unchanged
+    exp_irref: bool = False,  # expansion rows are CLOSED (factorized co):
+    # drop the self candidate to restore irreflexive semantics
 ) -> tuple:
     """Bind ONE variable for every binding row of a K-request batch:
     expand candidates from the keyed CSR row, leapfrog-intersect against
@@ -245,6 +490,9 @@ def join_expand_step(
     or whose survivors overflowed ``rows_out``."""
     R, T = cols.shape
     dummy = type_of.shape[0] - 1
+    filt_sel = _norm_filt_sel(filt_sel)
+    if filt_groups is None:
+        filt_groups = (None,) * len(filt_sel)
 
     def key_of(sel):
         kind, idx = sel
@@ -252,8 +500,7 @@ def join_expand_step(
         return jnp.where(valid, k, dummy)
 
     key = key_of(exp_sel)
-    starts = exp_offsets[key]
-    ends = exp_offsets[key + 1]
+    starts, ends = _seg_of(exp_offsets, exp_group, key)
     widths = ends - starts
     over_row = (widths > pad) & valid
     lane_ix = jnp.arange(pad, dtype=jnp.int32)
@@ -262,6 +509,8 @@ def join_expand_step(
                       exp_flat.shape[0] - 1)
     cand = jnp.where(cmask, exp_flat[idx], SENTINEL)
     cmask = cmask & valid[:, None]
+    if exp_irref:
+        cmask = cmask & (cand != key[:, None])
     if dedupe:
         # target tuples may repeat a value; keep the first occurrence so
         # binding rows stay DISTINCT tuples. Sort-based — stable argsort
@@ -280,52 +529,15 @@ def join_expand_step(
         ].set(dup_sorted)
         cmask = cmask & ~dup
     safe = jnp.where(cmask, cand, dummy)
-    for (rev, kind, kidx), off_f, flat_f in zip(
-        filt_sel, filt_offsets, filt_flats
-    ):
-        o = key_of((kind, kidx))
-        if not rev:
-            # candidate ∈ row(key): per-row segment, shared bounds
-            from hypergraphdb_tpu.ops.setops import segment_member_mask
-
-            cmask = cmask & segment_member_mask(
-                flat_f, off_f[o], off_f[o + 1], cand
-            )
-        else:
-            # key ∈ row(candidate): per-element segments
-            qo = jnp.broadcast_to(o[:, None], cand.shape)
-            cmask = cmask & _member_elementwise(
-                flat_f, off_f[safe], off_f[safe + 1], qo
-            )
+    cmask = _filter_masks(cand, cmask, safe, key_of, filt_sel,
+                          filt_offsets, filt_flats, filt_groups)
     if type_handle >= 0:
         cmask = cmask & (type_of[safe] == type_handle)
     if value_ops is not None:
-        # rank-window leapfrog: gather each candidate's order-preserving
-        # value rank words + kind byte and compare against the window —
-        # pure vector compute, applied BEFORE compaction so out-of-range
-        # candidates never occupy binding rows (``ops/setops``'s rank
-        # convention: 64-bit ranks as two uint32 words, hi then lo;
-        # cross-kind comparisons are always False)
-        vh = value_cols[0][safe]
-        vl = value_cols[1][safe]
-        vk = value_cols[2][safe].astype(jnp.uint32)
-        cmask = cmask & (vk == value_win[0])
-        lo_op, hi_op = value_ops
-        if lo_op is not None:
-            gt = (vh > value_win[1]) | ((vh == value_win[1])
-                                        & (vl > value_win[2]))
-            eq = (vh == value_win[1]) & (vl == value_win[2])
-            cmask = cmask & (gt | eq if lo_op == "gte" else gt)
-        if hi_op is not None:
-            gt = (vh > value_win[3]) | ((vh == value_win[3])
-                                        & (vl > value_win[4]))
-            eq = (vh == value_win[3]) & (vl == value_win[4])
-            cmask = cmask & (~gt if hi_op == "lte" else ~gt & ~eq)
-    for j in range(n_distinct_cols):
-        cmask = cmask & (cand != cols[:, j, None])
-    if distinct_consts:
-        for s in range(consts.shape[1]):
-            cmask = cmask & (cand != consts[lanes, s][:, None])
+        cmask = _value_window_mask(cmask, safe, value_cols, value_win,
+                                   value_ops)
+    cmask = _distinct_masks(cmask, cand, cols, consts, lanes,
+                            n_distinct_cols, distinct_consts)
     lane_counts = jnp.zeros(n_lanes, jnp.int32).at[lanes].add(
         cmask.sum(axis=1, dtype=jnp.int32)
     )
@@ -347,6 +559,218 @@ def join_expand_step(
         flat_mask[dropped].astype(jnp.int32), mode="drop"
     )
     trunc_i = trunc_i.at[lanes].add(over_row.astype(jnp.int32))
+    return new_cols, new_lanes, new_valid, lane_counts, trunc_i > 0
+
+
+@hgverify.entry(
+    shapes=lambda: (
+        (hgverify.sds((33,), "int32"), hgverify.sds((64,), "int32"),
+         hgverify.sds((8, 1), "int32"), hgverify.sds((8,), "int32"),
+         hgverify.sds((8,), "bool"), hgverify.sds((4, 2), "int32"),
+         (hgverify.sds((33,), "int32"),),
+         (hgverify.sds((64,), "int32"),),
+         hgverify.sds((32,), "int32")),
+        {},
+    ),
+    statics={
+        "exp_sel": ("const", 0),
+        "filt_sel": ((False, "col", 0, False),),
+        "type_handle": -1,
+        "block": 8, "rows_out": 16, "n_lanes": 4,
+        "n_distinct_cols": 1, "distinct_consts": True,
+    },
+)
+@partial(jax.jit, static_argnames=(
+    "exp_sel", "filt_sel", "type_handle", "block", "rows_out", "n_lanes",
+    "n_distinct_cols", "distinct_consts", "value_ops", "exp_irref",
+))
+def join_hub_expand(
+    exp_offsets: jax.Array,   # (N+2,) int32 — expansion CSR offsets
+    exp_flat: jax.Array,      # (E,) int32 — expansion CSR payload
+    cols: jax.Array,          # (R, T) int32 bound binding columns
+    lanes: jax.Array,         # (R,) int32
+    valid: jax.Array,         # (R,) bool
+    consts: jax.Array,        # (n_lanes, A) int32
+    filt_offsets: tuple,
+    filt_flats: tuple,
+    type_of: jax.Array,       # (N+1,) int32
+    value_cols: Optional[tuple] = None,
+    value_win: Optional[jax.Array] = None,
+    exp_group: Optional[jax.Array] = None,
+    filt_groups: Optional[tuple] = None,
+    *,
+    exp_sel: tuple,
+    filt_sel: tuple,
+    type_handle: int,
+    block: int,               # dense-frontier chunk width
+    rows_out: int,            # pooled survivor bucket
+    n_lanes: int,
+    n_distinct_cols: int,
+    distinct_consts: bool,
+    value_ops: Optional[tuple] = None,
+    exp_irref: bool = False,
+) -> tuple:
+    """The degree-split twin of :func:`join_expand_step` for HUB rows: a
+    dense-frontier expansion that streams each keyed row in fixed
+    ``block``-wide chunks (an on-device while loop over ``⌈w_max/block⌉``
+    tiles) instead of one padded gather — a row of ANY width expands
+    without width truncation, and the peak tensor is ``R × block``
+    however wide the hub is. Filters/type/value/distinct masks apply per
+    tile (identical semantics to the tail kernel); survivors stream-
+    compact into one pooled ``rows_out`` buffer through a running
+    cursor, each lane's survivors arriving in ascending candidate order.
+    Returns the same ``(cols', lanes', valid', lane_counts, lane_trunc)``
+    contract — ``lane_counts`` stay exact even when the pooled buffer
+    overflows (counted per tile, pre-compaction); only ``rows_out``
+    overflow can set ``lane_trunc``. No dedupe mode: degree-split plans
+    route dedupe (tgt) steps through the tail kernel."""
+    R, T = cols.shape
+    dummy = type_of.shape[0] - 1
+    filt_sel = _norm_filt_sel(filt_sel)
+    if filt_groups is None:
+        filt_groups = (None,) * len(filt_sel)
+
+    def key_of(sel):
+        kind, idx = sel
+        k = cols[:, idx] if kind == "col" else consts[lanes, idx]
+        return jnp.where(valid, k, dummy)
+
+    key = key_of(exp_sel)
+    starts, ends = _seg_of(exp_offsets, exp_group, key)
+    widths = jnp.where(valid, ends - starts, 0)
+    n_chunks = (jnp.max(widths) + block - 1) // block
+    lane_ix = jnp.arange(block, dtype=jnp.int32)
+    emax = exp_flat.shape[0] - 1
+    slot_ix = jnp.arange(R * block, dtype=jnp.int32)
+    src_row = jnp.repeat(jnp.arange(R, dtype=jnp.int32), block)
+
+    out_cols = jnp.zeros((rows_out, T + 1), jnp.int32)
+    out_lanes = jnp.full((rows_out,), n_lanes, jnp.int32)
+    out_valid = jnp.zeros((rows_out,), bool)
+    counts0 = jnp.zeros(n_lanes, jnp.int32)
+    dropped0 = jnp.zeros(n_lanes, jnp.int32)
+
+    def body(ci, state):
+        out_cols, out_lanes, out_valid, counts, dropped, cursor = state
+        base_ix = ci * block + lane_ix
+        cmask = base_ix[None, :] < widths[:, None]
+        idx = jnp.minimum(starts[:, None] + base_ix[None, :], emax)
+        cand = jnp.where(cmask, exp_flat[idx], SENTINEL)
+        if exp_irref:
+            cmask = cmask & (cand != key[:, None])
+        safe = jnp.where(cmask, cand, dummy)
+        cmask = _filter_masks(cand, cmask, safe, key_of, filt_sel,
+                              filt_offsets, filt_flats, filt_groups)
+        if type_handle >= 0:
+            cmask = cmask & (type_of[safe] == type_handle)
+        if value_ops is not None:
+            cmask = _value_window_mask(cmask, safe, value_cols,
+                                       value_win, value_ops)
+        cmask = _distinct_masks(cmask, cand, cols, consts, lanes,
+                                n_distinct_cols, distinct_consts)
+        counts = counts.at[lanes].add(cmask.sum(axis=1, dtype=jnp.int32))
+        # stream-compact this tile's survivors at the cursor: a stable
+        # sort keeps row-major order, so each LANE's survivors land in
+        # ascending candidate order across tiles — the pooled prefix is
+        # per-lane honest
+        flat_mask = cmask.reshape(-1)
+        order = jnp.argsort(~flat_mask)
+        pos = cursor + slot_ix
+        write = flat_mask[order] & (pos < rows_out)
+        dst = jnp.where(write, pos, rows_out)
+        rsel = src_row[order]
+        new_rows = jnp.concatenate(
+            [cols[rsel], cand.reshape(-1)[order][:, None]], axis=1
+        )
+        out_cols = out_cols.at[dst].set(new_rows, mode="drop")
+        out_lanes = out_lanes.at[dst].set(lanes[rsel], mode="drop")
+        out_valid = out_valid.at[dst].set(write, mode="drop")
+        over = flat_mask[order] & (pos >= rows_out)
+        dropped = dropped.at[lanes[rsel]].add(
+            over.astype(jnp.int32), mode="drop"
+        )
+        cursor = cursor + flat_mask.sum(dtype=jnp.int32)
+        return out_cols, out_lanes, out_valid, counts, dropped, cursor
+
+    out_cols, out_lanes, out_valid, counts, dropped, _ = jax.lax.fori_loop(
+        0, n_chunks, body,
+        (out_cols, out_lanes, out_valid, counts0, dropped0, jnp.int32(0)),
+    )
+    return out_cols, out_lanes, out_valid, counts, dropped > 0
+
+
+@hgverify.entry(
+    shapes=lambda: (hgverify.sds((16, 1), "int32"),
+                    hgverify.sds((16,), "int32"),
+                    hgverify.sds((16,), "bool"),
+                    hgverify.sds((16, 1), "int32"),
+                    hgverify.sds((16,), "int32"),
+                    hgverify.sds((16,), "bool")),
+    statics={"pad": 8, "rows_out": 32, "n_lanes": 4, "distinct": True},
+)
+@partial(jax.jit, static_argnames=("pad", "rows_out", "n_lanes",
+                                   "distinct"))
+def join_bag_join(
+    cols: jax.Array,       # (R1, T1) int32 — spine binding rows
+    lanes: jax.Array,      # (R1,) int32
+    valid: jax.Array,      # (R1,) bool
+    bag_cols: jax.Array,   # (R2, T2) int32 — materialized bag rows
+    bag_lanes: jax.Array,  # (R2,) int32
+    bag_valid: jax.Array,  # (R2,) bool
+    *,
+    pad: int,              # bag rows per lane bucket
+    rows_out: int,         # joined-row bucket
+    n_lanes: int,
+    distinct: bool,        # cross-side all-distinct masks
+) -> tuple:
+    """Join a materialized GHD bag onto the spine table: every spine row
+    pairs with its own lane's bag rows (the bushy plan's bag⋈bag step —
+    components share no variables, so the join is a per-lane product
+    under the cross-side distinctness masks; within-side distinctness
+    and constant exclusion were already enforced by each chain). Same
+    compaction/trunc/count contract as :func:`join_expand_step`; a lane
+    whose bag holds more than ``pad`` rows flags trunc (honest lower
+    bound, host re-serve)."""
+    R1, T1 = cols.shape
+    R2, T2 = bag_cols.shape
+    # lane-sort the bag so each lane's rows are one contiguous segment
+    bkey = jnp.where(bag_valid, bag_lanes, n_lanes)
+    border = jnp.argsort(bkey)
+    sb_cols = bag_cols[border]
+    sb_key = bkey[border]
+    bag_off = jnp.searchsorted(
+        sb_key, jnp.arange(n_lanes + 1, dtype=jnp.int32)
+    ).astype(jnp.int32)
+    lane_k = jnp.minimum(jnp.where(valid, lanes, n_lanes), n_lanes - 1)
+    starts = bag_off[lane_k]
+    bcount = bag_off[lane_k + 1] - starts
+    j = jnp.arange(pad, dtype=jnp.int32)
+    cmask = (j[None, :] < jnp.minimum(bcount, pad)[:, None]) \
+        & valid[:, None]
+    over_pad = (bcount > pad) & valid
+    bidx = jnp.minimum(starts[:, None] + j[None, :], R2 - 1)
+    if distinct:
+        for i in range(T1):
+            for k in range(T2):
+                cmask = cmask & (sb_cols[bidx, k] != cols[:, i, None])
+    lane_counts = jnp.zeros(n_lanes, jnp.int32).at[lanes].add(
+        cmask.sum(axis=1, dtype=jnp.int32)
+    )
+    flat_mask = cmask.reshape(-1)
+    src_row = jnp.repeat(jnp.arange(R1, dtype=jnp.int32), pad)
+    order = jnp.argsort(~flat_mask)
+    sel = order[:rows_out]
+    new_valid = flat_mask[sel]
+    rsel = src_row[sel]
+    bsel = bidx.reshape(-1)[sel]
+    new_cols = jnp.concatenate([cols[rsel], sb_cols[bsel]], axis=1)
+    new_lanes = lanes[rsel]
+    dropped = order[rows_out:]
+    trunc_i = jnp.zeros(n_lanes, jnp.int32)
+    trunc_i = trunc_i.at[lanes[src_row[dropped]]].add(
+        flat_mask[dropped].astype(jnp.int32), mode="drop"
+    )
+    trunc_i = trunc_i.at[lanes].add(over_pad.astype(jnp.int32))
     return new_cols, new_lanes, new_valid, lane_counts, trunc_i > 0
 
 
@@ -401,7 +825,9 @@ class JoinExecution:
     """Async device handles of one executed join batch — pair with
     ``np.asarray`` / :meth:`full_bindings` to sync. ``counts[k]`` is
     exact unless ``trunc[k]`` (then a lower bound — the serving tier
-    re-serves that request on host)."""
+    re-serves that request on host). ``hub_lanes`` counts the real lanes
+    the degree-split routed through the dense-frontier hub chain (a
+    host-side int, known at launch)."""
 
     order: tuple
     counts: jax.Array                  # (K,) int32
@@ -410,6 +836,7 @@ class JoinExecution:
     cols: Optional[jax.Array] = None    # full mode: final binding rows
     lanes: Optional[jax.Array] = None
     valid: Optional[jax.Array] = None
+    hub_lanes: int = 0
 
     def full_bindings(self, lane: int) -> np.ndarray:
         """All complete binding rows of one request lane, host-side —
@@ -437,11 +864,16 @@ def _rel_host_offsets(snap: CSRSnapshot, rel: str):
     return snap.tgt_offsets
 
 
-def _rel_max_width(snap: CSRSnapshot, rel: str) -> int:
+def _rel_max_width(snap: CSRSnapshot, rel: str,
+                   fact: Optional[dict] = None) -> int:
     """The relation's widest row — a per-(snapshot, relation) invariant,
     cached like ``_nbr_csr``: recomputing the O(N) diff+max per step per
     dispatch would charge pure host bookkeeping to every timed device
-    window (the c7 bench runs 64 dispatches per rep)."""
+    window (the c7 bench runs 64 dispatches per rep). Factorized
+    relations answer from their own group extents (closed co rows are
+    one wider than flat)."""
+    if fact is not None and rel in fact:
+        return fact[rel].max_width
     cache = getattr(snap, "_join_wmax", None)
     if cache is None:
         cache = {}
@@ -453,9 +885,330 @@ def _rel_max_width(snap: CSRSnapshot, rel: str) -> int:
     return cache[rel]
 
 
+def _rel_widths_of(snap: CSRSnapshot, rel: str, keys: np.ndarray,
+                   fact: Optional[dict]) -> np.ndarray:
+    """Host-side row widths of ``keys`` under the encoding the kernels
+    will actually gather from (the pad must cover the CLOSED row when
+    the factorized co relation serves the step)."""
+    if fact is not None and rel in fact:
+        fr = fact[rel]
+        g = fr.group_of[np.minimum(keys, len(fr.group_of) - 1)]
+        off = fr.offsets.astype(np.int64)
+        return off[g + 1] - off[g]
+    off_h = np.asarray(_rel_host_offsets(snap, rel), dtype=np.int64)
+    return off_h[keys + 1] - off_h[keys]
+
+
+class _ChainCtx:
+    """Shared launch context of one :func:`execute_join` call: the
+    device arrays, shape knobs, and factorized twins every chain (tail,
+    hub, bag) reads."""
+
+    def __init__(self, snap, dev, K, A, consts, consts_dev, n_real,
+                 distinct, row_cap, pad_cap, var_pad_max, slot_budget,
+                 vwindows, hub_block, fact, fact_dev):
+        self.snap = snap
+        self.dev = dev
+        self.K = K
+        self.A = A
+        self.consts = consts
+        self.consts_dev = consts_dev
+        self.n_real = n_real
+        self.distinct = distinct
+        self.row_cap = row_cap
+        self.pad_cap = pad_cap
+        self.var_pad_max = var_pad_max
+        self.slot_budget = slot_budget
+        self.vwindows = vwindows
+        self.hub_block = hub_block
+        self.fact = fact
+        self.fact_dev = fact_dev
+
+    def rel(self, rel: str):
+        """(offsets, flat, group, irref) device arrays of one relation —
+        the factorized twin when one is cached (inc is never
+        factorized; rev filters ride the inc dual and stay flat)."""
+        if self.fact_dev is not None and rel in self.fact_dev:
+            g, o, f = self.fact_dev[rel]
+            return o, f, g, self.fact[rel].closed
+        o, f = _rel_arrays(self.snap, self.dev, rel)
+        return o, f, None, False
+
+    def value_window(self, var: str):
+        win = self.vwindows.get(var)
+        if win is None:
+            return None, None, None
+        kind, lo_r, lo_op, hi_r, hi_op = win
+        vcols = (self.dev.value_rank_hi, self.dev.value_rank_lo,
+                 self.dev.value_kind)
+        words = np.asarray(
+            [int(kind),
+             (lo_r or 0) >> 32, (lo_r or 0) & 0xFFFFFFFF,
+             (hi_r or 0) >> 32, (hi_r or 0) & 0xFFFFFFFF],
+            dtype=np.uint64,
+        ).astype(np.uint32)
+        return vcols, jnp.asarray(words), (lo_op, hi_op)
+
+    def real_keys(self, step, lane_sel: Optional[np.ndarray]) -> np.ndarray:
+        """Clipped const-slot keys of the REAL lanes a pad computation
+        may price (optionally a sub-selection — the degree split prices
+        tail pads from tail lanes only)."""
+        real = (self.consts if self.n_real is None
+                else self.consts[: self.n_real])
+        if lane_sel is not None:
+            real = real[lane_sel[: len(real)]]
+        if not len(real):
+            return np.zeros(0, dtype=np.int64)
+        return np.clip(real[:, step.source_key.index].astype(np.int64),
+                       0, self.snap.num_atoms)
+
+
+def _run_chain(ctx: _ChainCtx, steps, cols, lanes, valid, *,
+               hub: bool, lane_sel: Optional[np.ndarray] = None):
+    """Run one expand-step chain over an existing binding table. In the
+    hub chain, CONST-keyed non-dedupe steps — the ones whose keyed row
+    IS a hub row — stream through the chunked dense-frontier kernel
+    (width-truncation-free); var-keyed steps (per-row tail-sized
+    expansions even on hub lanes) and dedupe steps keep the padded
+    single-gather fast path, with pads priced from ``lane_sel``'s lanes
+    only. Returns ``(cols, lanes, valid, counts, trunc, final_drop)``
+    — ``final_drop`` isolates a LAST-step hub-kernel row-buffer
+    overflow: the one truncation class that leaves ``counts`` exact
+    (hub-kernel counts accumulate per tile BEFORE compaction and no
+    later step consumed the clipped table), so count-only callers need
+    not treat it as truncation."""
+    K = ctx.K
+    trunc = jnp.zeros(K, bool)
+    final_drop = jnp.zeros(K, bool)
+    counts = jnp.zeros(K, jnp.int32)
+    for si, s in enumerate(steps):
+        R = int(cols.shape[0])
+        exp_off, exp_flat, exp_grp, exp_irref = ctx.rel(s.source_rel)
+        filt_sel = []
+        filt_offs = []
+        filt_flats = []
+        filt_grps = []
+        for f in s.filters:
+            fo, ff, fg, firr = ctx.rel(f.rel)
+            filt_sel.append((f.rev, f.key.kind, f.key.index, firr))
+            filt_offs.append(fo)
+            filt_flats.append(ff)
+            filt_grps.append(fg)
+        n_dist = int(cols.shape[1]) if ctx.distinct else 0
+        vcols, vwin, vops = ctx.value_window(s.var)
+        use_hub = hub and not s.dedupe and s.source_key.kind == "const"
+        use_row_split = hub and not s.dedupe and \
+            s.source_key.kind == "col"
+        if use_hub:
+            block = _bucket(
+                max(min(ctx.hub_block,
+                        max(ctx.slot_budget // max(R, 1), 8)), 8),
+                minimum=8,
+            )
+            # survivor bucket sized to what the hub rows can actually
+            # mint: on the chain's FIRST step (one table row per lane)
+            # that is exactly the SUM of the keyed row widths — half or
+            # less of rows × max on skewed batches, and every
+            # downstream step's table shrinks with it; mid-chain the
+            # per-row bound is rows × the widest keyed row
+            keys = ctx.real_keys(s, lane_sel)
+            widths_h = _rel_widths_of(ctx.snap, s.source_rel, keys,
+                                      ctx.fact)
+            w_max = int(np.max(widths_h, initial=1)) if len(keys) else 1
+            cap_rows = (int(widths_h.sum()) if int(cols.shape[1]) == 0
+                        else max(R, 1) * max(w_max, 1))
+            rows_out = min(_bucket(max(cap_rows, 1)), ctx.row_cap)
+            cols, lanes, valid, counts, step_trunc = join_hub_expand(
+                exp_off, exp_flat, cols, lanes, valid, ctx.consts_dev,
+                tuple(filt_offs), tuple(filt_flats), ctx.dev.type_of,
+                vcols, vwin, exp_grp, tuple(filt_grps),
+                exp_sel=(s.source_key.kind, s.source_key.index),
+                filt_sel=tuple(filt_sel),
+                type_handle=(-1 if s.type_handle is None
+                             else int(s.type_handle)),
+                block=block, rows_out=rows_out, n_lanes=K,
+                n_distinct_cols=n_dist,
+                distinct_consts=ctx.distinct and ctx.A > 0,
+                value_ops=vops, exp_irref=exp_irref,
+            )
+            if si == len(steps) - 1:
+                final_drop = final_drop | step_trunc
+            else:
+                trunc = trunc | step_trunc
+            continue
+        if s.source_key.kind == "const":
+            # real lanes only: zero-filled pad lanes would price every
+            # sparse batch's pad by atom 0's row (a hub in age-ordered
+            # id spaces); under a degree split, tail lanes only — one
+            # hub must not inflate every tail lane's pad
+            keys = ctx.real_keys(s, lane_sel)
+            w = (int(np.max(_rel_widths_of(ctx.snap, s.source_rel, keys,
+                                           ctx.fact), initial=1))
+                 if len(keys) else 1)
+        elif ctx.var_pad_max:
+            # exact-count mode (bench): pay the relation's true max row
+            # width so only the pad_cap itself can truncate
+            w = _rel_max_width(ctx.snap, s.source_rel, ctx.fact)
+        else:
+            # the estimate is a relation AVERAGE; 4× headroom keeps
+            # ordinary rows in-pad (hubs past it flag trunc honestly)
+            w = 4 * (int(s.width_est) + 1)
+        # the pad is additionally bounded by the candidate-slot budget
+        # (R × pad is the step's peak tensor): a one-row table may pay a
+        # six-figure pad (wide one-shot anchors), a deep table only a
+        # narrow one — constant memory either way
+        pad = _bucket(
+            max(min(w, ctx.pad_cap,
+                    max(ctx.slot_budget // max(R, 1), 8)), 1),
+            minimum=8,
+        )
+        rows_out = min(_bucket(R * pad), ctx.row_cap, R * pad)
+        if use_row_split:
+            # hub-VALUED variables: a var-keyed step on the hub chain
+            # can bind rows that are themselves hubs (a hub's
+            # neighbours include the other hubs), and no pad holds
+            # them. Per-ROW width split: rows within the pad keep the
+            # single-gather kernel; the (few) wider rows compact into a
+            # small bucket and stream through the chunked kernel —
+            # compaction overflow is the only remaining truncation.
+            dummy_id = ctx.snap.num_atoms
+            key_dev = jnp.where(
+                valid, cols[:, s.source_key.index], dummy_id
+            )
+            s_dev, e_dev = _seg_of(exp_off, exp_grp, key_dev)
+            wide = valid & ((e_dev - s_dev) > pad)
+            wide_bucket = min(_bucket(max(R // 8, 64)), _bucket(R))
+            worder = jnp.argsort(~wide)
+            wsel = worder[:wide_bucket]
+            w_cols, w_lanes = cols[wsel], lanes[wsel]
+            w_valid = wide[wsel]
+            lost = worder[wide_bucket:]
+            wide_over = jnp.zeros(K, jnp.int32).at[lanes[lost]].add(
+                wide[lost].astype(jnp.int32), mode="drop"
+            ) > 0
+            common = dict(
+                exp_sel=(s.source_key.kind, s.source_key.index),
+                filt_sel=tuple(filt_sel),
+                type_handle=(-1 if s.type_handle is None
+                             else int(s.type_handle)),
+                n_lanes=K, n_distinct_cols=n_dist,
+                distinct_consts=ctx.distinct and ctx.A > 0,
+                value_ops=vops, exp_irref=exp_irref,
+            )
+            n_cols, n_lanes_a, n_valid, n_counts, n_trunc = \
+                join_expand_step(
+                    exp_off, exp_flat, cols, lanes, valid & ~wide,
+                    ctx.consts_dev, tuple(filt_offs),
+                    tuple(filt_flats), ctx.dev.type_of, vcols, vwin,
+                    exp_grp, tuple(filt_grps),
+                    pad=pad, rows_out=rows_out, dedupe=False, **common,
+                )
+            block = _bucket(
+                max(min(ctx.hub_block,
+                        max(ctx.slot_budget // max(wide_bucket, 1),
+                            8)), 8),
+                minimum=8,
+            )
+            rows_out_w = min(
+                _bucket(wide_bucket
+                        * _rel_max_width(ctx.snap, s.source_rel,
+                                         ctx.fact)),
+                ctx.row_cap,
+            )
+            w_cols, w_lanes_a, w_valid, w_counts, w_trunc = \
+                join_hub_expand(
+                    exp_off, exp_flat, w_cols, w_lanes, w_valid,
+                    ctx.consts_dev, tuple(filt_offs),
+                    tuple(filt_flats), ctx.dev.type_of, vcols, vwin,
+                    exp_grp, tuple(filt_grps),
+                    block=block, rows_out=rows_out_w, **common,
+                )
+            cols = jnp.concatenate([n_cols, w_cols])
+            lanes = jnp.concatenate([n_lanes_a, w_lanes_a])
+            valid = jnp.concatenate([n_valid, w_valid])
+            counts = n_counts + w_counts
+            # narrow rows fit the pad by construction and the wide pass
+            # never width-truncates: both kernels' flags are pure
+            # row-buffer drops (count-preserving on a final step);
+            # only the wide-bucket overflow loses candidates outright
+            if si == len(steps) - 1:
+                final_drop = final_drop | n_trunc | w_trunc
+                trunc = trunc | wide_over
+            else:
+                trunc = trunc | n_trunc | w_trunc | wide_over
+            continue
+        cols, lanes, valid, counts, step_trunc = join_expand_step(
+            exp_off, exp_flat, cols, lanes, valid, ctx.consts_dev,
+            tuple(filt_offs), tuple(filt_flats), ctx.dev.type_of,
+            vcols, vwin, exp_grp, tuple(filt_grps),
+            exp_sel=(s.source_key.kind, s.source_key.index),
+            filt_sel=tuple(filt_sel),
+            type_handle=(-1 if s.type_handle is None
+                         else int(s.type_handle)),
+            pad=pad, rows_out=rows_out, n_lanes=K,
+            n_distinct_cols=n_dist,
+            distinct_consts=ctx.distinct and ctx.A > 0,
+            dedupe=s.dedupe,
+            value_ops=vops, exp_irref=exp_irref,
+        )
+        trunc = trunc | step_trunc
+    return cols, lanes, valid, counts, trunc, final_drop
+
+
+def _split_chain(ctx: _ChainCtx, steps, base_valid, hub_mask):
+    """One component's chain under the degree split: tail lanes through
+    the padded fast path, hub lanes (``hub_mask``) through the chunked
+    dense-frontier chain, tables re-pooled afterwards. Returns
+    ``(cols, lanes, valid, counts, trunc, final_drop, n_hub)``."""
+    K = ctx.K
+    cols0 = jnp.zeros((K, 0), jnp.int32)
+    lanes0 = jnp.arange(K, dtype=jnp.int32)
+    n_hub = int(hub_mask.sum()) if hub_mask is not None else 0
+    if not n_hub:
+        out = _run_chain(ctx, steps, cols0, lanes0, base_valid, hub=False)
+        return (*out, 0)
+    hub_dev = jnp.asarray(hub_mask)
+    if n_hub >= (ctx.K if ctx.n_real is None else ctx.n_real):
+        out = _run_chain(ctx, steps, cols0, lanes0,
+                         base_valid & hub_dev, hub=True,
+                         lane_sel=hub_mask)
+        return (*out, n_hub)
+    t_cols, t_lanes, t_valid, t_counts, t_trunc, t_fd = _run_chain(
+        ctx, steps, cols0, lanes0, base_valid & ~hub_dev, hub=False,
+        lane_sel=~hub_mask,
+    )
+    h_cols, h_lanes, h_valid, h_counts, h_trunc, h_fd = _run_chain(
+        ctx, steps, cols0, lanes0, base_valid & hub_dev, hub=True,
+        lane_sel=hub_mask,
+    )
+    return (
+        jnp.concatenate([t_cols, h_cols]),
+        jnp.concatenate([t_lanes, h_lanes]),
+        jnp.concatenate([t_valid, h_valid]),
+        t_counts + h_counts,
+        t_trunc | h_trunc,
+        t_fd | h_fd,
+        n_hub,
+    )
+
+
+def _resolve_factorized(snap: CSRSnapshot, factorized):
+    """The per-call factorized-relation decision: ``False`` = flat CSRs,
+    ``True`` = build (and cache) the trie encoding now, ``None`` = use
+    it only when someone already built it for this snapshot (the serve
+    tier builds at plan time / prewarm — ad-hoc callers never pay the
+    build implicitly)."""
+    if factorized is False:
+        return None, None
+    if factorized is None and getattr(snap, "_fact_rels", None) is None:
+        return None, None
+    fact = factorized_relations(snap)
+    return fact, factorized_relations_device(snap)
+
+
 def execute_join(
     snap: CSRSnapshot,
-    plan,                    # join/planner.JoinPlan
+    plan,                    # join/planner.JoinPlan | BushyJoinPlan
     consts: np.ndarray,      # (K, n_consts) int32 — per-request constants
     *,
     top_r: int = 16,
@@ -468,6 +1221,10 @@ def execute_join(
     n_real: Optional[int] = None,
     slot_budget: int = DEFAULT_SLOT_BUDGET,
     value_windows: Optional[dict] = None,
+    hub_split: bool = True,
+    hub_threshold: Optional[int] = None,
+    hub_block: int = DEFAULT_HUB_BLOCK,
+    factorized: Optional[bool] = None,
 ) -> JoinExecution:
     """Run ``plan`` for K same-signature requests in one batched pass —
     async (no host sync; every return field is a device handle).
@@ -480,6 +1237,16 @@ def execute_join(
     — the exact-count mode the c7 bench runs). Row buckets grow
     multiplicatively and cap at ``row_cap``. Anything the caps cut
     off surfaces per request in ``trunc`` — never silently.
+
+    ``hub_split=True`` (the degree-split plan, v2's default): lanes
+    whose const-keyed rows exceed ``hub_threshold`` (default: the pad
+    cap — exactly the lanes the tail pads could never hold) run their
+    whole chain through the chunked :func:`join_hub_expand` dense-
+    frontier kernel, so hub anchors expand at ANY width without
+    truncation; tail lanes keep the padded fast path with pads priced
+    from tail widths only. ``factorized`` routes the co/tgt gathers
+    through the prefix-grouped trie encoding (None = only when the
+    snapshot already carries one — see :func:`factorized_relations`).
 
     ``seeds`` replaces the first step: the given ids become the var-0
     binding column of ONE request lane (the benchmark's global-counting
@@ -497,14 +1264,26 @@ def execute_join(
     K, A = (int(consts.shape[0]), int(consts.shape[1]))
     consts = np.ascontiguousarray(consts, dtype=np.int32)
     consts_dev = jnp.asarray(consts) if A else jnp.zeros((K, 0), jnp.int32)
+    fact, fact_dev = _resolve_factorized(snap, factorized)
+    ctx = _ChainCtx(
+        snap, dev, K, A, consts, consts_dev, n_real, plan.distinct,
+        row_cap, pad_cap, var_pad_max, slot_budget, value_windows or {},
+        hub_block, fact, fact_dev,
+    )
+    bags = getattr(plan, "bags", None)
+    if bags is not None:
+        if seeds is not None:
+            raise ValueError("seeds mode requires a left-deep plan")
+        return _execute_bushy(ctx, plan, top_r=top_r, full=full,
+                              count_only=count_only,
+                              hub_split=hub_split,
+                              hub_threshold=hub_threshold)
     if seeds is None:
-        cols = jnp.zeros((K, 0), jnp.int32)
-        lanes = jnp.arange(K, dtype=jnp.int32)
-        # pad lanes (serving's pad-to-bucket shapes) start invalid: they
-        # cost their slots but never gather, count, or truncate
-        valid = (jnp.ones(K, bool) if n_real is None
-                 else jnp.arange(K, dtype=jnp.int32) < int(n_real))
-        steps = plan.steps
+        base_valid = (jnp.ones(K, bool) if n_real is None
+                      else jnp.arange(K, dtype=jnp.int32) < int(n_real))
+        hub_mask = _hub_mask(ctx, plan.steps, hub_split, hub_threshold)
+        cols, lanes, valid, counts, trunc, final_drop, n_hub = \
+            _split_chain(ctx, plan.steps, base_valid, hub_mask)
     else:
         if K != 1:
             raise ValueError("seeds mode is single-lane (K == 1)")
@@ -513,80 +1292,28 @@ def execute_join(
         lanes = jnp.zeros(len(seeds), jnp.int32)
         valid = jnp.ones(len(seeds), bool)
         steps = plan.steps[1:]
-    trunc = jnp.zeros(K, bool)
-    # a 1-variable plan in seeds mode has no steps left: the seeds ARE
-    # the complete bindings
-    counts = (jnp.zeros(K, jnp.int32).at[lanes].add(valid.astype(jnp.int32))
-              if seeds is not None and not steps
-              else jnp.zeros(K, jnp.int32))
-    vwindows = value_windows or {}
-    for s in steps:
-        R = int(cols.shape[0])
-        if s.source_key.kind == "const":
-            off_h = _rel_host_offsets(snap, s.source_rel)
-            # real lanes only: zero-filled pad lanes would price every
-            # sparse batch's pad by atom 0's row (a hub in age-ordered
-            # id spaces)
-            real = consts if n_real is None else consts[:n_real]
-            keys = np.clip(real[:, s.source_key.index], 0, snap.num_atoms)
-            w = int(np.max(off_h[keys + 1] - off_h[keys], initial=1))
-        elif var_pad_max:
-            # exact-count mode (bench): pay the relation's true max row
-            # width so only the pad_cap itself can truncate
-            w = _rel_max_width(snap, s.source_rel)
+        n_hub = 0
+        final_drop = jnp.zeros(K, bool)
+        # a 1-variable plan in seeds mode has no steps left: the seeds
+        # ARE the complete bindings
+        if not steps:
+            counts = jnp.zeros(K, jnp.int32).at[lanes].add(
+                valid.astype(jnp.int32)
+            )
+            trunc = jnp.zeros(K, bool)
         else:
-            # the estimate is a relation AVERAGE; 4× headroom keeps
-            # ordinary rows in-pad (hubs past it flag trunc honestly)
-            w = 4 * (int(s.width_est) + 1)
-        # the pad is additionally bounded by the candidate-slot budget
-        # (R × pad is the step's peak tensor): a one-row table may pay a
-        # six-figure pad (wide one-shot anchors), a deep table only a
-        # narrow one — constant memory either way
-        pad = _bucket(
-            max(min(w, pad_cap, max(slot_budget // max(R, 1), 8)), 1),
-            minimum=8,
-        )
-        rows_out = min(_bucket(R * pad), row_cap, R * pad)
-        exp_off, exp_flat = _rel_arrays(snap, dev, s.source_rel)
-        filt_sel = []
-        filt_offs = []
-        filt_flats = []
-        for f in s.filters:
-            fo, ff = _rel_arrays(snap, dev, f.rel)
-            filt_sel.append((f.rev, f.key.kind, f.key.index))
-            filt_offs.append(fo)
-            filt_flats.append(ff)
-        n_dist = int(cols.shape[1]) if plan.distinct else 0
-        win = vwindows.get(s.var)
-        vcols = vwin = None
-        vops = None
-        if win is not None:
-            kind, lo_r, lo_op, hi_r, hi_op = win
-            vcols = (dev.value_rank_hi, dev.value_rank_lo, dev.value_kind)
-            words = np.asarray(
-                [int(kind),
-                 (lo_r or 0) >> 32, (lo_r or 0) & 0xFFFFFFFF,
-                 (hi_r or 0) >> 32, (hi_r or 0) & 0xFFFFFFFF],
-                dtype=np.uint64,
-            ).astype(np.uint32)
-            vwin = jnp.asarray(words)
-            vops = (lo_op, hi_op)
-        cols, lanes, valid, counts, step_trunc = join_expand_step(
-            exp_off, exp_flat, cols, lanes, valid, consts_dev,
-            tuple(filt_offs), tuple(filt_flats), dev.type_of,
-            vcols, vwin,
-            exp_sel=(s.source_key.kind, s.source_key.index),
-            filt_sel=tuple(filt_sel),
-            type_handle=(-1 if s.type_handle is None
-                         else int(s.type_handle)),
-            pad=pad, rows_out=rows_out, n_lanes=K,
-            n_distinct_cols=n_dist,
-            distinct_consts=plan.distinct and A > 0,
-            dedupe=s.dedupe,
-            value_ops=vops,
-        )
-        trunc = trunc | step_trunc
-    out = JoinExecution(order=plan.order, counts=counts, trunc=trunc)
+            cols, lanes, valid, counts, trunc, final_drop = _run_chain(
+                ctx, steps, cols, lanes, valid, hub=False
+            )
+    # count-only callers never download the (clipped) table, and a
+    # final-step hub drop leaves counts exact — not a truncation for
+    # them; tuple/full consumers still see it flagged (their prefix
+    # would be incomplete)
+    out = JoinExecution(
+        order=plan.order, counts=counts,
+        trunc=(trunc if count_only else trunc | final_drop),
+        hub_lanes=n_hub,
+    )
     if count_only:
         return out
     if top_r > 0:
@@ -596,6 +1323,79 @@ def execute_join(
         out.tuples = join_finalize(cols, lanes, valid,
                                    top_r=top_r, n_lanes=K,
                                    sort_cols=sort_cols)
+    if full:
+        out.cols, out.lanes, out.valid = cols, lanes, valid
+    return out
+
+
+def _hub_mask(ctx: _ChainCtx, steps, hub_split: bool,
+              hub_threshold: Optional[int]):
+    """The planner's degree-split policy applied to this batch's
+    constants (``join/planner.hub_lane_mask``), or None when the split
+    is off / no lane qualifies."""
+    if not hub_split or not steps:
+        return None
+    from hypergraphdb_tpu.join.planner import hub_lane_mask
+
+    thr = min(hub_threshold if hub_threshold is not None else ctx.pad_cap,
+              ctx.pad_cap)
+    n_real = ctx.K if ctx.n_real is None else ctx.n_real
+    mask = hub_lane_mask(ctx.snap, steps, ctx.consts[:n_real], thr)
+    if not mask.any():
+        return None
+    if len(mask) < ctx.K:
+        mask = np.concatenate([mask, np.zeros(ctx.K - len(mask), bool)])
+    return mask
+
+
+def _execute_bushy(ctx: _ChainCtx, plan, *, top_r: int, full: bool,
+                   count_only: bool, hub_split: bool,
+                   hub_threshold: Optional[int]) -> JoinExecution:
+    """The bushy GHD executor: run the spine component's chain, run each
+    bag's chain to a small materialized table, then fold bags onto the
+    spine with :func:`join_bag_join` (cross-component distinctness at
+    each fold). Counts come from the final fold; truncation anywhere —
+    spine, a bag chain, a fold's pad or row bucket — flags the owning
+    lane honestly."""
+    K = ctx.K
+    base_valid = (jnp.ones(K, bool) if ctx.n_real is None
+                  else jnp.arange(K, dtype=jnp.int32) < int(ctx.n_real))
+    hub_mask = _hub_mask(ctx, plan.spine, hub_split, hub_threshold)
+    cols, lanes, valid, counts, trunc, s_fd, n_hub = _split_chain(
+        ctx, plan.spine, base_valid, hub_mask
+    )
+    # every chain output feeds a fold here, so a clipped table anywhere
+    # undercounts downstream: final-step drops are NOT count-preserving
+    # in a bushy plan — fold them into trunc conservatively
+    trunc = trunc | s_fd
+    for bag in plan.bags:
+        b_hub = _hub_mask(ctx, bag.steps, hub_split, hub_threshold)
+        b_cols, b_lanes, b_valid, _, b_trunc, b_fd, b_n_hub = \
+            _split_chain(ctx, bag.steps, base_valid, b_hub)
+        b_trunc = b_trunc | b_fd
+        n_hub += b_n_hub
+        R1 = int(cols.shape[0])
+        R2 = int(b_cols.shape[0])
+        pad = _bucket(
+            max(min(_bucket(R2),
+                    max(ctx.slot_budget // max(R1, 1), 8)), 8),
+            minimum=8,
+        )
+        rows_out = min(_bucket(R1 * pad), ctx.row_cap, R1 * pad)
+        cols, lanes, valid, counts, j_trunc = join_bag_join(
+            cols, lanes, valid, b_cols, b_lanes, b_valid,
+            pad=pad, rows_out=rows_out, n_lanes=K,
+            distinct=plan.distinct,
+        )
+        trunc = trunc | b_trunc | j_trunc
+    out = JoinExecution(order=plan.order, counts=counts, trunc=trunc,
+                        hub_lanes=n_hub)
+    if count_only:
+        return out
+    if top_r > 0:
+        sort_cols = tuple(plan.order.index(v) for v in plan.sig.vars)
+        out.tuples = join_finalize(cols, lanes, valid, top_r=top_r,
+                                   n_lanes=K, sort_cols=sort_cols)
     if full:
         out.cols, out.lanes, out.valid = cols, lanes, valid
     return out

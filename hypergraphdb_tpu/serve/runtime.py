@@ -125,6 +125,33 @@ class ServeConfig:
     #: deadline window after every compaction. Opt-in: BFS/pattern-only
     #: tiers should not pay it.
     prewarm_join_nbr: bool = False
+    # -- join engine v2 (degree-split / factorized / partial correction) -----
+    #: build the prefix-grouped (trie) encoding of the co/tgt relations
+    #: once per (signature-cache miss, base epoch) at plan time — K
+    #: lanes probing equal rows then touch one HBM copy. The build is
+    #: O(E log E) host work per epoch; joins-light tiers can switch it
+    #: off and keep the flat CSRs.
+    join_factorized: bool = True
+    #: degree-split plans: lanes whose const-keyed rows exceed the hub
+    #: threshold run the chunked dense-frontier chain instead of
+    #: truncating onto the host path (``ops/join.join_hub_expand``)
+    join_hub_split: bool = True
+    #: hub threshold override (row width); None = the executor's pad cap
+    join_hub_threshold: Optional[int] = None
+    #: executor shape caps for the join lane (``ops/join`` defaults:
+    #: 2^15 pooled binding rows, 2^10 expansion pad) — a deployment
+    #: serving hub-anchored joins device-exact raises join_row_cap to
+    #: hold the hub's full binding set
+    join_row_cap: int = 1 << 15
+    join_pad_cap: int = 1 << 10
+    #: per-lane memtable correction (ROADMAP 2d): while the dirty set —
+    #: new links plus their targets — stays at most this many atoms,
+    #: join batches keep dispatching on device and collect merges the
+    #: host-enumerated tuples touching the dirty set
+    #: (``join/host.host_join_touching``); past it (or on any tombstone/
+    #: revalue) the whole batch takes the exact host path as before.
+    #: 0 disables the partial path.
+    join_dirty_max: int = 16
     #: value DIMENSIONS (kind bytes, e.g. ``(ord("i"),)``) whose sorted
     #: index columns build + upload at startup, with the range-lane
     #: executables warmed per bucket when an AOT cache is configured —
@@ -179,6 +206,11 @@ class LaunchedBatch:
     #: collect needs its column order to permute tuples back into the
     #: request's variable order
     join_plan: object = None
+    #: join batches dispatched under a SMALL pure-add dirty memtable:
+    #: the sorted touched-atom list (new links + their targets, captured
+    #: at launch) the per-lane collect correction enumerates against —
+    #: None when the memtable was clean at pin (ROADMAP 2d)
+    join_dirty: object = None
     #: range batches: how many leading entries of the view's
     #: ``new_atoms`` the dispatched delta column covered — the collect
     #: residual (``new_atoms[covered:]``) the host correction owes
@@ -222,8 +254,9 @@ class DeviceExecutor:
         #: to this graph generation (quiet rebuild on mismatch).
         self.aot = self._open_aot_cache()
         self._aot_failed = False
-        #: (epoch, new_atoms scanned, verdict) — _join_mem_dirty's memo
-        self._join_dirty_memo: tuple = (-1, 0, False)
+        #: (epoch, new_atoms scanned, touched set | "full") —
+        #: _join_dirty_info's memo
+        self._join_dirty_memo: tuple = (-1, 0, frozenset())
 
     def _open_aot_cache(self):
         import os
@@ -419,8 +452,21 @@ class DeviceExecutor:
         plan through the mesh's lane-sharded program)."""
         from hypergraphdb_tpu.ops.join import execute_join
 
+        cfg = self.config
+        # the view's epoch-cached trie encodings (built at plan time /
+        # prewarm when join_factorized): present → serve through them,
+        # absent (or disabled) → flat CSRs; never build on the dispatch
+        # hot path
+        fact = (view.factorized_join_rels()
+                if cfg.join_factorized else None)
         return execute_join(view.base, plan, consts,
-                            top_r=self.config.top_r, n_real=n_real)
+                            top_r=cfg.top_r, n_real=n_real,
+                            row_cap=cfg.join_row_cap,
+                            pad_cap=cfg.join_pad_cap,
+                            hub_split=cfg.join_hub_split,
+                            hub_threshold=cfg.join_hub_threshold,
+                            factorized=(None if fact is not None
+                                        else False))
 
     def prewarm(self, buckets, max_hops: Optional[int] = None) -> int:
         """Compile (or load from the AOT cache) the BFS serving
@@ -445,11 +491,17 @@ class DeviceExecutor:
         if self.config.prewarm_join_nbr:
             # the join lane's co-incidence CSR: built + uploaded at
             # deploy time (in-budget snapshots only — over budget it
-            # raises and the serve path declines to host anyway)
-            from hypergraphdb_tpu.ops.join import neighbor_csr_device
+            # raises and the serve path declines to host anyway), plus
+            # the factorized trie encoding when the v2 path will use it
+            from hypergraphdb_tpu.ops.join import (
+                factorized_relations_device,
+                neighbor_csr_device,
+            )
 
             try:
                 neighbor_csr_device(self.mgr.base)
+                if self.config.join_factorized:
+                    factorized_relations_device(self.mgr.base)
             except Exception:  # noqa: BLE001 - never block startup
                 pass
         range_dims = tuple(self.config.prewarm_range_dims or ())
@@ -773,11 +825,15 @@ class DeviceExecutor:
             n = view.base.num_atoms
             # a memtable LINK can mint bindings anywhere in the tuple
             # space — not correctable against a compact device prefix.
-            # Exact-at-collect discipline, join edition: the whole batch
-            # takes the exact host path while the memtable is dirty
-            # (bounded by the next compaction), same honesty as the
-            # pattern lane's truncated-plus-dirty case.
-            plan = (None if self._join_mem_dirty(view)
+            # Exact-at-collect discipline, join edition: while the dirty
+            # set stays SMALL and pure-add, the batch still dispatches
+            # on device and collect merges the per-lane correction
+            # (tuples touching the dirty atoms — ROADMAP 2d); tombstones,
+            # revalues, or a dirty set past ``join_dirty_max`` take the
+            # whole batch to the exact host path as before (bounded by
+            # the next compaction).
+            dirty = self._join_dirty_info(view)
+            plan = (None if dirty == "full"
                     else self._join_plan(sig, batch.tickets[0].request,
                                          view.base))
             if plan is None:
@@ -796,12 +852,15 @@ class DeviceExecutor:
                     lane += 1
                 if out.lane_tickets:
                     out.join_plan = plan
+                    out.join_dirty = dirty
                     with self._dispatch_cm("join", batch.bucket,
                                            len(plan.steps)):
                         with self.tracer.span("join.execute",
                                               sig=str(sig.atoms)):
                             ex = self._execute_join(view, plan, consts,
                                                     n_real=lane)
+                    if ex.hub_lanes:
+                        self.stats.record_join_hub_dispatch(ex.hub_lanes)
                     out.dev_out = (ex.counts, ex.trunc, ex.tuples)
         else:  # pragma: no cover - batch keys come from our own requests
             raise Unservable(f"unknown batch kind {kind!r}")
@@ -885,24 +944,60 @@ class DeviceExecutor:
         windows, permute tuple columns from the plan's elimination order
         back to the request's variable order, and re-serve any
         truncation-flagged lane exactly on host (a flagged count is a
-        LOWER bound — honest, but not what a caller asked for)."""
+        LOWER bound — honest, but not what a caller asked for).
+
+        Batches dispatched under a small pure-add dirty memtable
+        (``launched.join_dirty``) merge the per-lane correction here:
+        the host enumerates exactly the tuples touching the dirty atoms
+        (``join/host.host_join_touching`` — sound because a new link
+        only ever mints tuples containing itself or its targets) and
+        unions them into the device answer. Lanes whose device window is
+        a PREFIX (count beyond top_r) re-serve on host instead — a
+        prefix cannot absorb corrections, the pattern lane's rule."""
         view = launched.view
         sig = launched.batch.key[1]
         plan = launched.join_plan
+        dirty = launched.join_dirty
         counts, trunc, tuples = (np.asarray(x) for x in launched.dev_out)
         perm = [plan.order.index(v) for v in sig.vars]
+        top_r = self.config.top_r
         out = []
         for lane, ticket in launched.lane_tickets:
             try:
-                if trunc[lane]:
+                rows = tuples[lane]
+                rows = rows[rows[:, 0] >= 0][:, perm].astype(np.int64)
+                count = int(counts[lane])
+                if trunc[lane] or (dirty and count > len(rows)):
                     self.stats.record_host_fallback()
                     out.append((ticket,
                                 self._host_join(ticket.request,
                                                 view.epoch)))
                     continue
-                rows = tuples[lane]
-                rows = rows[rows[:, 0] >= 0][:, perm].astype(np.int64)
-                count = int(counts[lane])
+                if dirty:
+                    from hypergraphdb_tpu.join.host import (
+                        host_join_touching,
+                    )
+
+                    try:
+                        extra = host_join_touching(
+                            self.graph, sig.bind(ticket.request.consts),
+                            dirty,
+                        )
+                    except Exception:  # noqa: BLE001 - odd shape → exact
+                        self.stats.record_host_fallback()
+                        out.append((ticket,
+                                    self._host_join(ticket.request,
+                                                    view.epoch)))
+                        continue
+                    if extra:
+                        merged = sorted(
+                            {tuple(int(x) for x in r) for r in rows}
+                            | set(extra)
+                        )
+                        rows = np.asarray(merged, dtype=np.int64)
+                        rows = rows.reshape(-1, len(sig.vars))[:top_r]
+                        count = len(merged)
+                    self.stats.record_join_partial_correction()
                 out.append((ticket, JoinResult(
                     "join", count, rows, sig.vars,
                     count > len(rows), view.epoch,
@@ -1206,34 +1301,48 @@ class DeviceExecutor:
         return ServeResult("pattern", count, matches, False, view.epoch)
 
     # -- join lane helpers ----------------------------------------------------
-    def _join_mem_dirty(self, view) -> bool:
-        """Does the memtable hold anything a join answer could see?
-        Tombstones/revalues can remove a result's only witness; a new
-        LINK can mint bindings anywhere in the tuple space. Fresh NODES
-        alone cannot (nothing in the base points at them), so pure-node
-        ingest keeps the device lane open.
+    def _join_dirty_info(self, view):
+        """What the memtable holds that a join answer could see.
+        Returns ``None`` — clean, device lane open with no correction;
+        a sorted touched-atom list — small pure-ADD dirty set (every new
+        link plus its targets, ≤ ``join_dirty_max`` atoms): the batch
+        still dispatches on device and collect merges the per-lane
+        correction (ROADMAP 2d); ``"full"`` — tombstones/revalues (a
+        vanished witness is not correctable against a compact window)
+        or a dirty set past the bound: the whole batch takes the exact
+        host path. Fresh NODES alone never dirty anything (nothing in
+        the base points at them).
 
         Memoized per epoch with incremental suffix scans — ``new_atoms``
-        only grows within an epoch and a link verdict is sticky, so a
-        bulk pure-node ingest costs each batch only the atoms that
-        arrived since the last one, not an O(memtable) store walk on the
-        dispatch thread."""
+        only grows within an epoch and the touched set only accumulates
+        (the ``"full"`` verdict is sticky), so a bulk ingest costs each
+        batch only the atoms that arrived since the last one, not an
+        O(memtable) store walk on the dispatch thread."""
         if view.dead or view.revalued:
-            return True
+            return "full"
         epoch, n_seen, dirty = self._join_dirty_memo
         if epoch != view.epoch:
-            n_seen, dirty = 0, False
-        if not dirty:
+            n_seen, dirty = 0, frozenset()
+        limit = self.config.join_dirty_max
+        if dirty != "full" and len(view.new_atoms) > n_seen:
             g = self.graph
+            acc = set(dirty)
             for h in view.new_atoms[n_seen:]:
                 try:
-                    if g.get_targets(h):
-                        dirty = True
-                        break
+                    ts = g.get_targets(h)
                 except Exception:  # noqa: BLE001 - racing delete
                     continue
+                if ts:
+                    acc.add(int(h))
+                    acc.update(int(t) for t in ts)
+                    if len(acc) > limit:
+                        acc = "full"
+                        break
+            dirty = acc if acc == "full" else frozenset(acc)
         self._join_dirty_memo = (view.epoch, len(view.new_atoms), dirty)
-        return dirty
+        if dirty == "full":
+            return "full"
+        return sorted(dirty) if dirty else None
 
     def _join_plan(self, sig, req0: JoinRequest, base):
         """The signature's compiled decomposition, planned once per
@@ -1268,6 +1377,26 @@ class DeviceExecutor:
                             base, sig.bind(req0.consts), sig,
                             req0.consts,
                         )
+                    if cache[sig] is not None and \
+                            self.config.join_factorized:
+                        # the trie encoding, built HERE (plan time, once
+                        # per base epoch — the _nbr_csr discipline) so
+                        # the O(E log E) grouping never lands inside a
+                        # steady-state dispatch; execute_join picks it
+                        # up via the snapshot cache. Its OWN failure
+                        # (the closed-co build re-checks the pair
+                        # budget, which a co-free signature never
+                        # tripped above) must not poison the cached
+                        # plan — the flat CSRs still serve it.
+                        from hypergraphdb_tpu.ops.join import (
+                            factorized_relations,
+                        )
+
+                        try:
+                            with self.tracer.span("join.factorize"):
+                                factorized_relations(base)
+                        except Exception:  # noqa: BLE001 - flat serves
+                            pass
             except JoinUnsupported:
                 cache[sig] = None
         return cache[sig]

@@ -140,7 +140,6 @@ class ShardedExecutor(DeviceExecutor):
 
     # -- joins -----------------------------------------------------------------
     def _execute_join(self, view, plan, consts, n_real: int):
-        from hypergraphdb_tpu.ops.join import execute_join
         from hypergraphdb_tpu.ops.sharded_serving import (
             execute_join_sharded,
         )
@@ -148,12 +147,14 @@ class ShardedExecutor(DeviceExecutor):
         from hypergraphdb_tpu.ops.sharded_serving import mesh_carrier
 
         K = int(consts.shape[0])
-        if K % self.n_dev:
-            # bucket not splittable over this mesh: exact single-chip
-            # execution (correctness first; serve buckets are powers of
-            # two, so this only happens with exotic configs)
-            return execute_join(view.base, plan, consts,
-                                top_r=self.config.top_r, n_real=n_real)
+        if K % self.n_dev or getattr(plan, "bags", None):
+            # bucket not splittable over this mesh, or a bushy plan (the
+            # sharded lane program runs one flat chain — sharding bag
+            # materialization is the ROADMAP follow-up): exact
+            # single-chip execution through the BASE executor, so the
+            # join-v2 config knobs (caps, hub split, factorized) are
+            # honored identically to the non-sharded tier
+            return super()._execute_join(view, plan, consts, n_real)
         self.stats.record_sharded_dispatch()
         return execute_join_sharded(
             view.base, mesh_carrier(self.mesh), plan, consts,

@@ -72,6 +72,8 @@ LANE_NAMES = tuple(
 #: ``serve.breaker.state.<key>`` / ``serve.breaker.trips.<key>``
 #: (:data:`BREAKER_KEY_PREFIX`), created on a key's first transition.
 DOTTED_NAMES = LANE_NAMES + (
+    "serve.join.hub_dispatches",
+    "serve.join.partial_corrections",
     "serve.submitted",
     "serve.completed",
     "serve.shed_deadline",
@@ -134,6 +136,8 @@ class ServeStats:
         self._sharded_dispatches = r.counter("serve.sharded_dispatches")
         self._range_dispatches = r.counter("serve.range_dispatches")
         self._retries = r.counter("serve.retries")
+        self._join_hub = r.counter("serve.join.hub_dispatches")
+        self._join_partial = r.counter("serve.join.partial_corrections")
         self._breaker_trips = r.counter("serve.breaker_trips")
         self._breaker_state = r.gauge("serve.breaker_state")
         self._lanes_real = r.counter("serve.lanes_real")
@@ -160,6 +164,7 @@ class ServeStats:
             self._batches, self._device_dispatches,
             self._sharded_dispatches, self._range_dispatches,
             self._device_seconds,
+            self._join_hub, self._join_partial,
             self._retries, self._breaker_trips, self._breaker_state,
             self._lanes_real, self._lanes_padded, self._latency,
             self._queue_depth,
@@ -221,6 +226,23 @@ class ServeStats:
         collect-failure host re-serve)."""
         with self._lock:
             self._retries.inc()
+
+    def record_join_hub_dispatch(self, n_lanes: int = 1) -> None:
+        """``n_lanes`` real join lanes dispatched through the
+        degree-split dense-frontier hub chain (join engine v2) — the
+        lanes PR 10 routed to the exact host path. The live gate
+        (``tools/join.sh``) asserts this moves on a hub-anchored
+        smoke."""
+        with self._lock:
+            self._join_hub.inc(n_lanes)
+
+    def record_join_partial_correction(self) -> None:
+        """One join request answered device-side under a SMALL dirty
+        memtable with the per-lane correction merged in (ROADMAP 2d) —
+        a request the previous whole-batch rule would have re-routed to
+        host."""
+        with self._lock:
+            self._join_partial.inc()
 
     def record_breaker_trip(self) -> None:
         with self._lock:
@@ -371,6 +393,14 @@ class ServeStats:
     @property
     def breaker_trips(self) -> int:
         return self._breaker_trips.value
+
+    @property
+    def join_hub_dispatches(self) -> int:
+        return self._join_hub.value
+
+    @property
+    def join_partial_corrections(self) -> int:
+        return self._join_partial.value
 
     @property
     def host_fallbacks(self) -> int:

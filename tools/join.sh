@@ -1,16 +1,19 @@
 #!/usr/bin/env bash
 # hgjoin gate: the conjunctive-pattern-join suite — the differential
 # suite (device executor == host find_all truth across triangle / path /
-# star / typed / link-variable shapes, truncation honesty, pad-lane
-# garbage, seeds-mode global counting, mid-ingest memtable visibility
-# through the serving lane), the query suites that own the compiler
-# pushdown + bridge, then the c7 pattern-join bench in SMOKE mode
-# (small graph, few anchors) proving the whole device pipeline runs
-# green and records its device-vs-host ratio + differential verdict to
-# BENCH_C7_smoke.json.
+# star / typed / link-variable shapes, the join-engine-v2 degree-split /
+# bushy / factorized suites, truncation honesty, pad-lane garbage,
+# seeds-mode global counting, mid-ingest memtable visibility incl. the
+# per-lane partial correction), the query suites that own the compiler
+# pushdown + bridge, a live serve smoke asserting hub-anchored joins
+# dispatch on DEVICE (serve.join.hub_dispatches > 0) with exact results,
+# then the c7 pattern-join bench in SMOKE mode (small graph, few
+# anchors) proving the whole device pipeline — including the hub-heavy
+# configuration's split-vs-PR10 and factorized-vs-flat differentials —
+# runs green and records to BENCH_C7_smoke.json.
 #
 # Sits beside lint.sh (AST hazards), verify.sh (jaxpr ground truth +
-# cost budgets — the two ops/join entries gate there), chaos.sh,
+# cost budgets — the four ops/join entries gate there), chaos.sh,
 # obs.sh, perf.sh, and replica.sh: this one gates the join subsystem.
 #
 # Usage: tools/join.sh [extra pytest args]
@@ -28,6 +31,44 @@ rc=$?
 if [ "$rc" -ne 0 ]; then
     echo "tools/join.sh: join tests failed (exit $rc)" >&2
     exit "$rc"
+fi
+
+# -- live hub smoke: degree-split lanes dispatch on device -------------------
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - <<'PY'
+from tests.conftest import make_random_hypergraph
+from hypergraphdb_tpu import HyperGraph, join
+from hypergraphdb_tpu.query import conditions as c
+from hypergraphdb_tpu.query.variables import var
+from hypergraphdb_tpu.serve import ServeConfig, ServeRuntime
+
+g = HyperGraph()
+nodes, _ = make_random_hypergraph(g, n_nodes=80, n_links=160,
+                                  max_arity=4, seed=7)
+nodes = [int(n) for n in nodes]
+hub = nodes[0]
+for i in range(40):
+    g.add_link([hub, nodes[1 + i % 70]], value=f"hub-{i}")
+rt = ServeRuntime(g, ServeConfig(buckets=(4, 16), max_linger_s=0.001,
+                                 top_r=512, join_hub_threshold=8))
+try:
+    spec = {"y": c.CoIncident(hub), "z": c.CoIncident(var("y"))}
+    res = rt.submit_join(spec).result(timeout=120)
+    truth = join.host_join(g, join.extract_pattern(g, spec))
+    assert res.served_by == "device", res.served_by
+    assert res.count == len(truth), (res.count, len(truth))
+    got = sorted(tuple(int(v) for v in r) for r in res.tuples)
+    assert got == (truth[:512] if res.truncated else truth)
+    hub_lanes = rt.stats.join_hub_dispatches
+    assert hub_lanes > 0, "hub lane did not dispatch on device"
+finally:
+    rt.close()
+print("tools/join.sh hub smoke: serve.join.hub_dispatches =", hub_lanes,
+      "differential_equal = True")
+PY
+hub_rc=$?
+if [ "$hub_rc" -ne 0 ]; then
+    echo "tools/join.sh: hub serve smoke failed (exit $hub_rc)" >&2
+    exit "$hub_rc"
 fi
 
 # -- c7 smoke: the bench pipeline end to end at toy scale --------------------
@@ -48,10 +89,20 @@ r = bench._config_c7()
 for shape in ("triangle", "path2"):
     assert r[shape]["differential_equal"], (shape, r[shape])
     assert r[shape]["vs_host"] is not None, (shape, r[shape])
+hub = r["hub_heavy"]
+assert hub["differential_equal"], hub
+assert hub["hub_lanes_dispatched"] > 0, hub
+assert hub["factorized_equal"], hub
+assert hub["split_vs_pr10"] >= 1.0, (
+    "degree-split executor slower than the PR-10 path on the hub-heavy "
+    "smoke", hub)
 print("tools/join.sh c7 smoke:", json.dumps({
-    s: {k: r[s][k] for k in ("vs_host", "bindings_total", "n_truncated",
-                             "differential_equal")}
-    for s in ("triangle", "path2")
+    **{s: {k: r[s][k] for k in ("vs_host", "bindings_total",
+                                "n_truncated", "differential_equal")}
+       for s in ("triangle", "path2")},
+    "hub_heavy": {k: hub[k] for k in (
+        "hub_lanes", "tail_lanes", "split_vs_pr10", "factorized_vs_flat",
+        "factorized_equal", "differential_equal", "n_truncated")},
 }))
 PY
 smoke_rc=$?
