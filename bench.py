@@ -121,19 +121,25 @@ def _enable_compile_cache() -> None:
         pass
 
 
-_enable_compile_cache()
-# pull-BFS plan pyramids persist keyed by snapshot content: warm bench runs
-# skip the ~15 s 10M-scale host plan build (VERDICT r4 weak #2)
-os.environ.setdefault(
-    "HG_PLAN_CACHE",
-    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".plan_cache"),
-)
-# serving AOT executables persist too (ops/aot_cache): ServeRuntime
-# prewarm + the c6 cold-start probe read this root
-os.environ.setdefault(
-    "HG_AOT_CACHE",
-    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".aot_cache"),
-)
+def _bench_entry_env() -> None:
+    """Bench ENTRY-point environment, called from ``main()`` and the
+    per-config wrappers (the isolated-subprocess entries) — deliberately
+    NOT at import time: importing bench as a library (the envelope/diff
+    tests, ``--diff``, tooling) must not flip process-global jax config
+    or seed cache env vars that every later ServeRuntime in the same
+    process would silently open (a leaked ``HG_AOT_CACHE`` once handed
+    stale sharded executables to an unrelated test's runtime).
+
+    - persistent XLA compile cache (minutes of 10M-scale compiles);
+    - pull-BFS plan pyramids keyed by snapshot content: warm bench runs
+      skip the ~15 s 10M-scale host plan build (VERDICT r4 weak #2);
+    - serving AOT executables (ops/aot_cache): ServeRuntime prewarm +
+      the c6 cold-start probe read this root."""
+    _enable_compile_cache()
+    here = os.path.dirname(os.path.abspath(__file__))
+    os.environ.setdefault("HG_PLAN_CACHE", os.path.join(here,
+                                                        ".plan_cache"))
+    os.environ.setdefault("HG_AOT_CACHE", os.path.join(here, ".aot_cache"))
 
 
 def _xla_cache_files() -> int:
@@ -807,6 +813,7 @@ def bench_c6(cold=_PROBE):
     batch occupancy, shed counts, and latency percentiles, plus a
     one-request-per-dispatch baseline at the SAME offered load — the
     number the ≥5× batched-serving claim is judged against."""
+    _bench_entry_env()
     import threading
 
     from hypergraphdb_tpu import HyperGraph
@@ -987,7 +994,7 @@ def bench_c6(cold=_PROBE):
         # BENCH_C6_<tag>.json) — one capture, so the two can't disagree
         out["tracing"] = telemetry["sampling"]
         out["telemetry"] = telemetry
-    out["recorded_to"] = _record_c6(out)
+    out["recorded_to"] = _record_bench("c6_serving", out)
     return out
 
 
@@ -1096,6 +1103,7 @@ def bench_c7(snap, info):
     MAX_DEG), BENCH_C7_HUB_MAX (hub sample's width ceiling, default
     4×threshold — the fell-off-pad band, not the top-0.01% monsters),
     BENCH_C7_HUB_N (hub lanes per dispatch, default half)."""
+    _bench_entry_env()
     import jax
 
     from hypergraphdb_tpu.join.ir import (
@@ -1386,7 +1394,7 @@ def bench_c7(snap, info):
         # two can't disagree; telemetry paths stay excluded)
         result["tracing"] = telemetry["sampling"]
         result["telemetry"] = telemetry
-    result["recorded_to"] = _record_c7(result)
+    result["recorded_to"] = _record_bench("c7_pattern_join", result)
     return result
 
 
@@ -1404,6 +1412,7 @@ def bench_c8():
     Env knobs: BENCH_C8_ENTITIES / _LINKS (graph scale; the 10M shape on
     real hardware), BENCH_C8_REQUESTS, BENCH_C8_HOPS, BENCH_C8_DEVICES
     (comma list, default "1,2,4,8" clipped to visible), BENCH_C8_TAG."""
+    _bench_entry_env()
     import jax
 
     from hypergraphdb_tpu import HyperGraph
@@ -1580,7 +1589,7 @@ def bench_c8():
         # sampling snapshot rides the recorded result (c6's discipline)
         out["tracing"] = telemetry["sampling"]
         out["telemetry"] = telemetry
-    out["recorded_to"] = _record_c8(out)
+    out["recorded_to"] = _record_bench("c8_sharded", out)
     return out
 
 
@@ -1599,6 +1608,7 @@ def bench_c9():
 
     Env knobs: BENCH_C9_ENTITIES / _LINKS (graph scale), _REQUESTS,
     _WINDOW (value width of each range), _BASELINE_N, _TAG."""
+    _bench_entry_env()
     from hypergraphdb_tpu import HyperGraph
     from hypergraphdb_tpu.query import conditions as qc
     from hypergraphdb_tpu.serve import ServeConfig, ServeRuntime
@@ -1743,26 +1753,72 @@ def bench_c9():
         # sampling snapshot rides the recorded result (c6's discipline)
         out["tracing"] = telemetry["sampling"]
         out["telemetry"] = telemetry
-    out["recorded_to"] = _record_c9(out)
+    out["recorded_to"] = _record_bench("c9_value_index", out)
     return out
 
 
-def _record_c9(result: dict) -> Optional[str]:
-    """Persist the c9 value-index numbers (device-vs-host-scan ratio,
-    dispatch counts, differential verdict) to ``BENCH_C9_<tag>.json``
-    next to this file — the committed record the ISSUE asks for.
-    Best-effort like :func:`_record_c6`."""
-    tag = os.environ.get("BENCH_C9_TAG", "local")
-    path = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), f"BENCH_C9_{tag}.json"
-    )
+# ------------------------------------------------------------- bench records
+
+#: committed envelope schema for every ``BENCH_C*_<tag>.json`` record.
+#: One envelope — ``schema_version`` / ``tag`` / ``backend`` /
+#: ``git_rev`` / ``recorded_unix`` wrapping a single ``<config_key>``
+#: payload — shared by every writer (c6/c7/c8/c9 used to carry four
+#: copy-pasted writers that could drift). v2 added ``git_rev`` so a
+#: recorded curve names the code that produced it; the reader accepts
+#: v1 too (the committed smokes stay readable).
+BENCH_SCHEMA_VERSION = 2
+BENCH_SCHEMA_ACCEPTED = (1, 2)
+
+#: the recorded configs: payload key -> (tag env knob, file prefix)
+BENCH_RECORDED = {
+    "c6_serving": ("BENCH_C6_TAG", "BENCH_C6"),
+    "c7_pattern_join": ("BENCH_C7_TAG", "BENCH_C7"),
+    "c8_sharded": ("BENCH_C8_TAG", "BENCH_C8"),
+    "c9_value_index": ("BENCH_C9_TAG", "BENCH_C9"),
+}
+
+
+def _git_rev() -> Optional[str]:
+    """Short git revision of this checkout, or None (tarball installs,
+    no git binary) — best-effort provenance, never a failure."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            ["git", "rev-parse", "--short=12", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10,
+        )
+    except Exception:  # noqa: BLE001 - provenance is optional
+        return None
+    rev = proc.stdout.strip()
+    return rev if proc.returncode == 0 and rev else None
+
+
+def _record_dir() -> str:
+    """Where records land: next to this file, or ``BENCH_RECORD_DIR``
+    (tests and read-only-checkout CI point it at a scratch dir)."""
+    return (os.environ.get("BENCH_RECORD_DIR")
+            or os.path.dirname(os.path.abspath(__file__)))
+
+
+def _record_bench(config_key: str, result: dict) -> Optional[str]:
+    """Persist one config's numbers in the ONE committed envelope to
+    ``<prefix>_<tag>.json`` (tag from the config's env knob, default
+    ``local``). Best-effort: an unwritable checkout (read-only CI,
+    site-packages) must not discard the minutes-long run it is trying
+    to record. Returns the basename written, or None."""
+    tag_env, prefix = BENCH_RECORDED[config_key]
+    tag = os.environ.get(tag_env, "local")
+    path = os.path.join(_record_dir(), f"{prefix}_{tag}.json")
     record = {
-        "schema_version": 1,
+        "schema_version": BENCH_SCHEMA_VERSION,
         "recorded_unix": int(time.time()),
         "tag": tag,
         "backend": _backend_name(),
-        "c9_value_index": {k: v for k, v in result.items()
-                           if k not in ("telemetry", "recorded_to")},
+        "git_rev": _git_rev(),
+        config_key: {k: v for k, v in result.items()
+                     if k not in ("telemetry", "recorded_to")},
     }
     try:
         with open(path, "w") as f:
@@ -1776,93 +1832,237 @@ def _record_c9(result: dict) -> Optional[str]:
     return os.path.basename(path)
 
 
-def _record_c8(result: dict) -> Optional[str]:
-    """Persist the c8 sharded-serving scaling curve (per-device-count
-    qps, sharded-vs-single ratio, differential verdict) to
-    ``BENCH_C8_<tag>.json`` next to this file — the committed record the
-    real-TPU sweep validates. Best-effort like :func:`_record_c6`."""
-    tag = os.environ.get("BENCH_C8_TAG", "local")
-    path = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), f"BENCH_C8_{tag}.json"
-    )
-    record = {
-        "schema_version": 1,
-        "recorded_unix": int(time.time()),
-        "tag": tag,
-        "backend": _backend_name(),
-        "c8_sharded": {k: v for k, v in result.items()
-                       if k not in ("telemetry", "recorded_to")},
+def read_bench(path: str) -> dict:
+    """The version-checking reader for recorded bench files: rejects
+    unknown schema versions and envelopes missing the committed keys or
+    carrying anything but exactly one known config payload — ``--diff``
+    must never compare shapes it merely guessed."""
+    with open(path) as f:
+        record = json.load(f)
+    v = record.get("schema_version")
+    if v not in BENCH_SCHEMA_ACCEPTED:
+        raise ValueError(
+            f"{path}: bench schema {v!r} not in {BENCH_SCHEMA_ACCEPTED}"
+        )
+    for key in ("tag", "backend", "recorded_unix"):
+        if key not in record:
+            raise ValueError(f"{path}: bench record missing {key!r}")
+    keys = [k for k in record if k in BENCH_RECORDED]
+    if len(keys) != 1:
+        raise ValueError(
+            f"{path}: expected exactly one config payload, found {keys}"
+        )
+    return record
+
+
+def bench_payload(record: dict) -> tuple:
+    """(config_key, payload) of a :func:`read_bench` record."""
+    key = next(k for k in record if k in BENCH_RECORDED)
+    return key, record[key]
+
+
+# ------------------------------------------------------------- bench --diff
+
+#: metric direction by dotted-name match: throughput/efficiency up is
+#: good, time/lag up is bad; everything else (counts, scale knobs,
+#: verdict booleans) is comparison CONTEXT, not a gated metric
+_HIGHER_MARKS = ("per_sec", "qps", "ratio", "_vs_", "speedup", "gbps",
+                 "occupancy", "edges_per")
+_LOWER_MARKS = ("latency", "seconds", "_lag")
+_LOWER_SUFFIXES = ("_s", "_ms")
+
+#: config KNOBS that would otherwise match a direction rule — a
+#: deliberately changed deadline or offered load must read as comparison
+#: context, not a perf regression (offered_qps is the INPUT rate the
+#: open-loop configs were driven at; served_qps is the measurement)
+_INFO_SEGMENTS = ("deadline_s", "offered_qps")
+
+
+def _metric_direction(name: str) -> str:
+    """Direction of one flattened dotted path. Matched per SEGMENT:
+    ``triangle.vs_host`` is a higher-is-better ratio (the full-path
+    ``startswith("vs_")`` would never see past the dot), while the
+    lower-is-better seconds suffix applies to the FINAL segment only
+    (``cold_start_s.entities`` is a count under a timing dict, not a
+    timing)."""
+    segments = name.lower().split(".")
+    if segments[-1] in _INFO_SEGMENTS:
+        return "info"
+    for seg in segments:
+        if any(m in seg for m in _HIGHER_MARKS) or seg.startswith("vs_"):
+            return "higher"
+    last = segments[-1]
+    if (any(m in last for m in _LOWER_MARKS)
+            or last.endswith(_LOWER_SUFFIXES)):
+        return "lower"
+    return "info"
+
+
+def _flatten_scalars(payload, prefix: str = "") -> dict:
+    """{dotted path: scalar} over nested dicts/lists — the leaves
+    ``--diff`` compares. Booleans ride along (context equality, never a
+    direction-gated metric)."""
+    out: dict = {}
+    if isinstance(payload, dict):
+        items = payload.items()
+    elif isinstance(payload, (list, tuple)):
+        items = ((str(i), v) for i, v in enumerate(payload))
+    else:
+        items = ()
+    for k, v in items:
+        name = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, (dict, list, tuple)):
+            out.update(_flatten_scalars(v, name))
+        elif isinstance(v, (bool, int, float)):
+            out[name] = v
+    return out
+
+
+def bench_diff(path_a: str, path_b: str, tolerance: float = 0.25) -> dict:
+    """Per-metric regression verdict between two recorded bench files
+    (A = reference, B = candidate): every shared numeric leaf is
+    classified by direction and compared under ``tolerance`` (relative;
+    0.25 = B may be up to 25% worse before it counts as regressed —
+    generous by default because the CPU smokes are noisy; a real-TPU
+    sweep passes its own). Cross-backend diffs are allowed — comparing
+    a TPU run against the committed CPU smoke is exactly the "is the
+    CPU smoke lying" question — but flagged ``backend_differs`` so the
+    verdict is read with that in mind. Info leaves (scale knobs,
+    counts, verdict booleans) that differ are listed as
+    ``context_mismatch``: the perf verdict still computes, the caller
+    decides whether the runs were comparable."""
+    a, b = read_bench(path_a), read_bench(path_b)
+    key_a, pay_a = bench_payload(a)
+    key_b, pay_b = bench_payload(b)
+    if key_a != key_b:
+        raise ValueError(
+            f"config mismatch: {path_a} records {key_a}, "
+            f"{path_b} records {key_b}"
+        )
+    flat_a = _flatten_scalars(pay_a)
+    flat_b = _flatten_scalars(pay_b)
+    metrics: dict = {}
+    regressed: list = []
+    improved: list = []
+    context: list = []
+    for name in sorted(set(flat_a) & set(flat_b)):
+        va, vb = flat_a[name], flat_b[name]
+        direction = _metric_direction(name)
+        if (direction == "info" or isinstance(va, bool)
+                or isinstance(vb, bool)):
+            if va != vb:
+                context.append(name)
+            continue
+        entry = {"a": va, "b": vb, "direction": direction}
+        if va == 0:
+            entry["verdict"] = "ok" if vb == 0 else "incomparable"
+        else:
+            change = (vb - va) / abs(va)
+            entry["change"] = round(change, 4)
+            if direction == "lower":
+                verdict = ("regressed" if vb > va * (1 + tolerance)
+                           else "improved" if vb < va * (1 - tolerance)
+                           else "ok")
+            else:
+                verdict = ("regressed" if vb < va * (1 - tolerance)
+                           else "improved" if vb > va * (1 + tolerance)
+                           else "ok")
+            entry["verdict"] = verdict
+            if verdict == "regressed":
+                regressed.append(name)
+            elif verdict == "improved":
+                improved.append(name)
+        metrics[name] = entry
+    return {
+        "config": key_a,
+        "a": {"path": path_a, "tag": a["tag"], "backend": a["backend"],
+              "git_rev": a.get("git_rev")},
+        "b": {"path": path_b, "tag": b["tag"], "backend": b["backend"],
+              "git_rev": b.get("git_rev")},
+        "tolerance": tolerance,
+        "backend_differs": a["backend"] != b["backend"],
+        "context_mismatch": context,
+        "metrics": metrics,
+        "regressed": regressed,
+        "improved": improved,
+        "verdict": "regressed" if regressed else "ok",
     }
+
+
+def _diff_main(argv: list) -> int:
+    """``bench.py --diff A.json B.json [--diff-tolerance 0.25]``:
+    prints the verdict JSON; exit 0 clean, 1 on any regressed metric,
+    2 on usage/unreadable/mismatched inputs — the CI gate contract
+    (``tools/perf.sh``) and the real-TPU sweep's comparison tool."""
+    import sys
+
+    i = argv.index("--diff")
+    paths = []
+    tolerance = 0.25
+    rest = argv[i + 1:]
+    j = 0
+    while j < len(rest):
+        arg = rest[j]
+        if arg == "--diff-tolerance":
+            if j + 1 >= len(rest):
+                print("bench --diff: --diff-tolerance needs a value",
+                      file=sys.stderr)
+                return 2
+            try:
+                tolerance = float(rest[j + 1])
+            except ValueError:
+                print(f"bench --diff: bad tolerance {rest[j + 1]!r}",
+                      file=sys.stderr)
+                return 2
+            j += 2
+            continue
+        if arg.startswith("-"):
+            # a mistyped flag must not silently gate at the defaults
+            print(f"bench --diff: unknown flag {arg!r} "
+                  "(did you mean --diff-tolerance?)", file=sys.stderr)
+            return 2
+        paths.append(arg)
+        j += 1
+    if len(paths) != 2:
+        print("usage: bench.py --diff A.json B.json "
+              "[--diff-tolerance 0.25]", file=sys.stderr)
+        return 2
     try:
-        with open(path, "w") as f:
-            json.dump(record, f, indent=2, sort_keys=True)
-            f.write("\n")
-    except OSError as e:
-        import sys
-
-        print(f"bench: could not write {path}: {e}", file=sys.stderr)
-        return None
-    return os.path.basename(path)
+        report = bench_diff(paths[0], paths[1], tolerance)
+    except (OSError, ValueError) as e:
+        print(f"bench --diff: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps(report, indent=2, sort_keys=True))
+    return 1 if report["regressed"] else 0
 
 
-def _record_c7(result: dict) -> Optional[str]:
-    """Persist the c7 pattern-join numbers (device-vs-host ratio for
-    triangle + 2-path counting, truncation honesty, differential
-    verdict) to ``BENCH_C7_<tag>.json`` next to this file — the
-    committed record the ISSUE asks for. Best-effort like
-    :func:`_record_c6`."""
-    tag = os.environ.get("BENCH_C7_TAG", "local")
-    path = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), f"BENCH_C7_{tag}.json"
-    )
-    record = {
-        "schema_version": 1,
-        "recorded_unix": int(time.time()),
-        "tag": tag,
-        "backend": _backend_name(),
-        "c7_pattern_join": {k: v for k, v in result.items()
-                            if k not in ("telemetry", "recorded_to")},
-    }
-    try:
-        with open(path, "w") as f:
-            json.dump(record, f, indent=2, sort_keys=True)
-            f.write("\n")
-    except OSError as e:
-        import sys
+def _seed_baseline_main(argv: list) -> int:
+    """``bench.py --seed-baseline [out.json]``: seed the hgperf runtime
+    baseline (``PERF_BASELINE.json``) from the recorded bench files —
+    scanned next to this script AND under ``BENCH_RECORD_DIR`` (where a
+    read-only-checkout run just recorded), newest record per config
+    winning, so a fresh real-hardware sweep beats the committed
+    smokes."""
+    import sys
 
-        print(f"bench: could not write {path}: {e}", file=sys.stderr)
-        return None
-    return os.path.basename(path)
+    from hypergraphdb_tpu.obs.perf import BASELINE_FILENAME, seed_baseline
 
-
-def _record_c6(result: dict) -> Optional[str]:
-    """Persist the c6 serving numbers (ratio, occupancy, percentiles) to
-    ``BENCH_C6_<tag>.json`` next to this file — the committed record the
-    ROADMAP asks for. Shape documented in README "Serving runtime".
-    Best-effort: an unwritable checkout (read-only CI, site-packages)
-    must not discard the minutes-long run it is trying to record."""
-    tag = os.environ.get("BENCH_C6_TAG", "local")
-    path = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), f"BENCH_C6_{tag}.json"
-    )
-    record = {
-        "schema_version": 1,
-        "recorded_unix": int(time.time()),
-        "tag": tag,
-        "backend": _backend_name(),
-        "c6_serving": {k: v for k, v in result.items()
-                       if k not in ("telemetry", "recorded_to")},
-    }
-    try:
-        with open(path, "w") as f:
-            json.dump(record, f, indent=2, sort_keys=True)
-            f.write("\n")
-    except OSError as e:
-        import sys
-
-        print(f"bench: could not write {path}: {e}", file=sys.stderr)
-        return None
-    return os.path.basename(path)
+    i = argv.index("--seed-baseline")
+    flags = [a for a in argv[i + 1:] if a.startswith("-")]
+    if flags:
+        # same contract as --diff: a mistyped flag must not silently
+        # seed with the defaults
+        print(f"bench --seed-baseline: unknown flag {flags[0]!r}",
+              file=sys.stderr)
+        return 2
+    rest = list(argv[i + 1:])
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = rest[0] if rest else os.path.join(_record_dir(),
+                                            BASELINE_FILENAME)
+    record = seed_baseline((here, _record_dir()), out_path=out)
+    print(json.dumps({"wrote": out, "lanes": sorted(record["lanes"]),
+                      "source": record["source"]}, sort_keys=True))
+    return 0 if record["lanes"] else 1
 
 
 def _backend_name() -> str:
@@ -1893,15 +2093,18 @@ def _with_telemetry(name: str, fn) -> dict:
 
 
 def _config_c2() -> dict:
+    _bench_entry_env()
     return _with_telemetry("c2", bench_c2)
 
 
 def _config_c3() -> dict:
+    _bench_entry_env()
     snap, info, _ = _build_10m()
     return _with_telemetry("c3", lambda: bench_c3(snap, info))
 
 
 def _config_c4() -> dict:
+    _bench_entry_env()
     snap, info, build_s = _build_10m()
     out = _with_telemetry("c4", lambda: bench_c4(snap, info))
     out["_graph"] = {
@@ -1913,23 +2116,28 @@ def _config_c4() -> dict:
 
 
 def _config_c5() -> dict:
+    _bench_entry_env()
     return _with_telemetry("c5", bench_c5)
 
 
 def _config_c6() -> dict:
+    _bench_entry_env()
     return bench_c6()
 
 
 def _config_c7() -> dict:
+    _bench_entry_env()
     snap, info, _ = _build_10m()
     return _with_telemetry("c7", lambda: bench_c7(snap, info))
 
 
 def _config_c8() -> dict:
+    _bench_entry_env()
     return _with_telemetry("c8", bench_c8)
 
 
 def _config_c9() -> dict:
+    _bench_entry_env()
     return _with_telemetry("c9", bench_c9)
 
 
@@ -1970,6 +2178,12 @@ def _run_isolated(name: str) -> dict:
 def main() -> None:
     import sys
 
+    if "--diff" in sys.argv:
+        # comparison tool, not a run: never touches a device
+        sys.exit(_diff_main(sys.argv[1:]))
+    if "--seed-baseline" in sys.argv:
+        sys.exit(_seed_baseline_main(sys.argv[1:]))
+    _bench_entry_env()
     if "--telemetry" in sys.argv:
         # optional positional dir after the flag; default: next to results
         i = sys.argv.index("--telemetry")

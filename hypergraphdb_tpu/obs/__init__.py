@@ -33,7 +33,12 @@ this repro had faithfully reproduced as ``utils.metrics.Metrics`` vs
   attribution;
 - **SLOs** (:mod:`~hypergraphdb_tpu.obs.slo`): declarative objectives
   over sliding windows with multi-window error-budget burn-rate alerts
-  that fire as flight-recorder incidents.
+  that fire as flight-recorder incidents;
+- **perf sentinel** (:mod:`~hypergraphdb_tpu.obs.perf`): per-lane
+  rolling digests vs the committed ``PERF_BASELINE.json``, multi-window
+  drift detection with auto-captured incident profiles, and mesh
+  skew/straggler attribution — the runtime twin of hgverify's HV401
+  static cost gate.
 
 Cross-process tracing: trace contexts propagate over peer messages
 (``peer/messages.attach_trace``), so a replication push or snapshot
@@ -59,7 +64,7 @@ Usage::
         ...
 """
 
-from hypergraphdb_tpu.obs import device, export, fleet, flight, http, slo
+from hypergraphdb_tpu.obs import device, export, fleet, flight, http, perf, slo
 from hypergraphdb_tpu.obs.device import annotate, block_timed, profile
 from hypergraphdb_tpu.obs.export import (
     TRACE_SCHEMA_VERSION,
@@ -97,6 +102,12 @@ from hypergraphdb_tpu.obs.registry import (
     Registry,
     default_registry,
 )
+from hypergraphdb_tpu.obs.perf import (
+    PerfSentinel,
+    load_baseline,
+    seed_baseline,
+    shard_skew,
+)
 from hypergraphdb_tpu.obs.slo import Objective, SLOMonitor, fleet_objectives
 from hypergraphdb_tpu.obs.trace import Clock, Span, Trace, Tracer, global_tracer
 
@@ -126,6 +137,7 @@ __all__ = [
     "Histogram",
     "LocalNodeSource",
     "Objective",
+    "PerfSentinel",
     "Registry",
     "SLOMonitor",
     "Span",
@@ -150,14 +162,18 @@ __all__ = [
     "global_tracer",
     "http",
     "install_sigterm_dump",
+    "load_baseline",
     "merge_expositions",
     "parse_flight_jsonl",
     "parse_traces_jsonl",
+    "perf",
     "profile",
     "prometheus_text",
     "relabel_exposition",
     "runtime_health",
     "sample_value",
+    "seed_baseline",
+    "shard_skew",
     "slo",
     "trace_to_dict",
     "tracer",

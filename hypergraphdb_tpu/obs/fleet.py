@@ -450,6 +450,34 @@ class FleetCollector:
             "incidents": incidents,
         }
 
+    def fleet_perf(self) -> dict:
+        """The door's ``/fleet/perf`` body: every node's perf-sentinel
+        verdict (the ``perf`` section ``obs.http.runtime_health`` embeds
+        in ``/healthz``) merged into one view — per-node summaries, the
+        ``{node: [lanes]}`` violation map (so "which lane on which node
+        drifted" is one GET), fleet-total alerts, and how many nodes
+        report a sentinel at all (a node without one is absent, not
+        healthy-by-omission)."""
+        nodes: dict = {}
+        violating: dict = {}
+        alerts = 0
+        for node_id, scrape in sorted(self.node_scrapes().items()):
+            p = (scrape.health or {}).get("perf")
+            if not isinstance(p, dict):
+                continue
+            nodes[node_id] = p
+            v = p.get("violating") or []
+            if v:
+                violating[node_id] = list(v)
+            alerts += int(p.get("alerts_total") or 0)
+        return {
+            "role": "fleet",
+            "nodes": nodes,
+            "violating": violating,
+            "alerts_total": alerts,
+            "nodes_reporting": len(nodes),
+        }
+
     # -- reading: assembled traces -------------------------------------------
     def fleet_traces(self) -> list:
         """Summaries of every assembled trace id, most recent last:
@@ -529,7 +557,8 @@ class FleetCollector:
 def explain_record(trace, result=None, lane_path: Optional[str] = None,
                    breaker_state: Optional[str] = None,
                    shard_owner: Optional[int] = None,
-                   node_id: Optional[str] = None) -> dict:
+                   node_id: Optional[str] = None,
+                   join: Optional[dict] = None) -> dict:
     """The per-request cost-attribution (EXPLAIN) record, assembled from
     a FINISHED request trace's own span tree — the one source of truth,
     so the record can never disagree with the trace an operator later
@@ -579,6 +608,11 @@ def explain_record(trace, result=None, lane_path: Optional[str] = None,
     }
     if node_id is not None:
         rec["node"] = node_id
+    if join is not None:
+        # join-engine attribution (plan shape flat/bushy/hub/host, hub
+        # dispatches, partial memtable corrections) — assembled by the
+        # runtime from the launched batch, batch-level by construction
+        rec["join"] = dict(join)
     if result is not None:
         rec["served_by"] = getattr(result, "served_by", None)
         rec["count"] = int(getattr(result, "count", 0))

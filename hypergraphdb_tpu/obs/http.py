@@ -73,6 +73,16 @@ def runtime_health(rt) -> HealthProbe:
                 payload["mesh"] = mesh_rep()
             except Exception:  # noqa: BLE001 - health must not 500 on it
                 pass
+        perf = getattr(rt, "perf", None)
+        if perf is not None:
+            # the hgperf sentinel's verdict (violating lanes, alerts,
+            # skew) — what FleetCollector.fleet_perf merges. A pure
+            # read: scrapes must not drive evaluation. Perf drift is
+            # degraded-not-down: it never flips the health verdict.
+            try:
+                payload["perf"] = perf.health_summary()
+            except Exception:  # noqa: BLE001 - health must not 500 on it
+                pass
         healthy = (payload["accepting"]
                    and all(v != "open" for v in states.values()))
         return healthy, payload

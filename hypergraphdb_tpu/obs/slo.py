@@ -262,8 +262,9 @@ def fleet_objectives(collector, monitor: Optional[SLOMonitor] = None,
                      deadline_target: float = 0.99,
                      lag_target: float = 0.999,
                      availability_target: float = 0.999,
+                     perf_target: float = 0.999,
                      windows: tuple = DEFAULT_WINDOWS) -> SLOMonitor:
-    """Wire the standard fleet SLO trio over a
+    """Wire the standard fleet SLO set over a
     :class:`~hypergraphdb_tpu.obs.fleet.FleetCollector`:
 
     - ``serve_deadline`` — deadline-hit ratio from the ``serve.*``
@@ -272,7 +273,12 @@ def fleet_objectives(collector, monitor: Optional[SLOMonitor] = None,
     - ``replication_lag`` — per poll, each replica whose advertised lag
       exceeds its own advertised bound is one bad event;
     - ``availability`` — per poll, each node unreachable, unhealthy, or
-      with an OPEN serve breaker is one bad event.
+      with an OPEN serve breaker is one bad event;
+    - ``perf_drift`` — per poll, each node whose perf sentinel
+      (``obs.perf.PerfSentinel``, advertised as the ``perf`` healthz
+      section) reports ANY lane or skew violation is one bad event —
+      the fleet-level error budget over the hgperf verdicts. Nodes
+      without a sentinel don't vote (absent ≠ healthy).
 
     Returns the monitor (created on the collector's clock when not
     passed) — attach it with ``FleetCollector(..., slo=monitor)`` or
@@ -287,7 +293,7 @@ def fleet_objectives(collector, monitor: Optional[SLOMonitor] = None,
 
     # level-triggered objectives accumulate poll verdicts here (sources
     # must yield CUMULATIVE totals)
-    acc = {"lag": [0, 0], "avail": [0, 0]}
+    acc = {"lag": [0, 0], "avail": [0, 0], "perf": [0, 0]}
 
     def lag_source():
         good, bad = 0, 0
@@ -320,6 +326,20 @@ def fleet_objectives(collector, monitor: Optional[SLOMonitor] = None,
         acc["avail"][1] += bad
         return tuple(acc["avail"])
 
+    def perf_source():
+        good, bad = 0, 0
+        for scrape in collector.node_scrapes().values():
+            p = (scrape.health or {}).get("perf")
+            if not isinstance(p, dict):
+                continue  # no sentinel on this node: it doesn't vote
+            if p.get("violating"):
+                bad += 1
+            else:
+                good += 1
+        acc["perf"][0] += good
+        acc["perf"][1] += bad
+        return tuple(acc["perf"])
+
     mon.add(Objective("serve_deadline", deadline_target,
                       "requests resolved within their deadline",
                       windows), deadline_source)
@@ -329,4 +349,7 @@ def fleet_objectives(collector, monitor: Optional[SLOMonitor] = None,
     mon.add(Objective("availability", availability_target,
                       "nodes reachable, healthy, breakers not open",
                       windows), avail_source)
+    mon.add(Objective("perf_drift", perf_target,
+                      "nodes with every lane inside its perf baseline",
+                      windows), perf_source)
     return mon

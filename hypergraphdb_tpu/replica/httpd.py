@@ -29,7 +29,8 @@ front door and load balancers need it ON the submit port.
 **Fleet views** (``SubmitServer(fleet=FleetCollector(...))`` — the
 front door wears them): ``GET /fleet/metrics`` (per-node-labelled
 merged exposition), ``/fleet/healthz`` (worst-of + per-node detail),
-``/fleet/slo`` (error-budget burn state), and ``/fleet/traces/<tid>``
+``/fleet/slo`` (error-budget burn state), ``/fleet/perf`` (per-node
+perf-sentinel verdicts + violation map), and ``/fleet/traces/<tid>``
 (one cross-process span tree stitched from every node's half) ride the
 same port as ``/submit``, so the fleet is observed through the URL
 callers already use. ``POST /submit {"explain": true}`` adds the
@@ -128,6 +129,8 @@ class _Handler(BaseHTTPRequestHandler):
                                     "message": "no SLO monitor attached"})
             else:
                 self._respond(200, fleet.slo.snapshot())
+        elif path == "/fleet/perf":
+            self._respond(200, fleet.fleet_perf())
         elif path == "/fleet/traces":
             self._respond(200, {"traces": fleet.fleet_traces()})
         elif path.startswith("/fleet/traces/"):
@@ -192,7 +195,8 @@ class SubmitServer:
         self.submit_fn = submit_fn
         self.health = health
         #: optional hgobs FleetCollector: serves /fleet/metrics,
-        #: /fleet/healthz, /fleet/slo, /fleet/traces[/<tid>] ON this
+        #: /fleet/healthz, /fleet/slo, /fleet/perf,
+        #: /fleet/traces[/<tid>] ON this
         #: port — the front door wears it so the fleet is operated
         #: through the same URL callers submit to
         self.fleet = fleet

@@ -100,6 +100,15 @@ class ServeConfig:
     admission_gate: Optional[Callable[[], Optional[str]]] = None
     tracer: Optional[object] = None         # hgobs Tracer; None → global
     device_timing: bool = False             # launch→ready deltas per batch
+    #: hgperf sentinel (``obs.perf.PerfSentinel``): every completed
+    #: request feeds its rolling per-lane digests, and the completion
+    #: path drives its rate-limited evaluation (``maybe_tick``). The
+    #: device-seconds digest additionally needs ``device_timing=True``
+    #: AND an enabled tracer (``block_timed`` measurement rides the
+    #: trace clock). Give the sentinel the SAME clock as the runtime —
+    #: samples are stamped on it. None disables (zero cost: one
+    #: attribute read per completion).
+    perf: Optional[object] = None
     # -- self-healing (hgfault) ----------------------------------------------
     max_retries: int = 2                    # transient launch re-attempts
     retry_base_s: float = 0.005             # backoff seed: base * 2^(n-1)
@@ -211,6 +220,12 @@ class LaunchedBatch:
     #: at launch) the per-lane collect correction enumerates against —
     #: None when the memtable was clean at pin (ROADMAP 2d)
     join_dirty: object = None
+    #: join batches: real lanes this dispatch routed through the
+    #: degree-split dense-frontier hub chain, and collect-side partial
+    #: memtable corrections merged — batch-level EXPLAIN attribution
+    #: (the per-request record reports the batch it rode)
+    join_hub_lanes: int = 0
+    join_partials: int = 0
     #: range batches: how many leading entries of the view's
     #: ``new_atoms`` the dispatched delta column covered — the collect
     #: residual (``new_atoms[covered:]``) the host correction owes
@@ -861,6 +876,7 @@ class DeviceExecutor:
                                                     n_real=lane)
                     if ex.hub_lanes:
                         self.stats.record_join_hub_dispatch(ex.hub_lanes)
+                    out.join_hub_lanes = int(ex.hub_lanes)
                     out.dev_out = (ex.counts, ex.trunc, ex.tuples)
         else:  # pragma: no cover - batch keys come from our own requests
             raise Unservable(f"unknown batch kind {kind!r}")
@@ -998,6 +1014,7 @@ class DeviceExecutor:
                         rows = rows.reshape(-1, len(sig.vars))[:top_r]
                         count = len(merged)
                     self.stats.record_join_partial_correction()
+                    launched.join_partials += 1
                 out.append((ticket, JoinResult(
                     "join", count, rows, sig.vars,
                     count > len(rows), view.epoch,
@@ -1485,6 +1502,7 @@ class ServeRuntime:
         self.clock: Clock = self.config.clock or time.monotonic
         self.tracer = self.config.tracer or global_tracer()
         self.stats = ServeStats(self.config.latency_window)
+        self.perf = self.config.perf
         self.faults = self.config.faults or global_faults()
         # per-batch-key breaker: a flaky device bucket trips to the exact
         # host-fallback path and recovers via half-open probes; the
@@ -1899,6 +1917,21 @@ class ServeRuntime:
                 # one histogram observation per measured batch — the
                 # device-time distribution BENCH_C6 summarizes
                 self.stats.record_device_time(t_dev[1] - t_dev[0])
+                if self.perf is not None and key is not None:
+                    # the perf sentinel's device-seconds/request digest
+                    # (guarded like EXPLAIN: a sentinel bug must degrade
+                    # observability, never the batch)
+                    try:
+                        self.perf.observe_batch(
+                            key[0], t_dev[1] - t_dev[0],
+                            n_real=len(getattr(token, "lane_tickets",
+                                               ()) or ()),
+                            n_total=getattr(getattr(token, "batch", None),
+                                            "bucket", 0) or 0,
+                            t=self.clock(),
+                        )
+                    except Exception:  # noqa: BLE001
+                        pass
             for ticket, res in results:
                 tr = ticket.trace
                 if tr is None or tr.finished:
@@ -1922,14 +1955,37 @@ class ServeRuntime:
                         if getattr(res, "served_by", None) == "host"
                         else device_lane)
                 if ticket.explain:
-                    self._attach_explain(ticket, res, key, path)
+                    self._attach_explain(ticket, res, key, path, token)
                 if ticket.resolve(res):
                     # a cancel()ed future neither raises out of the
                     # dispatch thread nor counts as a completion
                     self.stats.record_complete(now - ticket.submit_t)
                     self.stats.record_lane(res.kind, path)
+                    if self.perf is not None:
+                        try:
+                            self.perf.observe(res.kind,
+                                              now - ticket.submit_t,
+                                              path=path, t=now)
+                        except Exception:  # noqa: BLE001
+                            pass
+        if self.perf is not None:
+            # rate-limited drift evaluation rides the completion path —
+            # the sentinel has no thread of its own. Guarded: an
+            # evaluation bug raising out of _finalize would unwind
+            # pump() before the NEXT batch's pending handoff and strand
+            # its tickets — observability must never cost a request
+            try:
+                self.perf.maybe_tick()
+            except Exception:  # noqa: BLE001
+                import logging
 
-    def _attach_explain(self, ticket, res, key, path: str) -> None:
+                logging.getLogger("hypergraphdb_tpu.serve").warning(
+                    "perf sentinel tick failed (continuing)",
+                    exc_info=True,
+                )
+
+    def _attach_explain(self, ticket, res, key, path: str,
+                        token=None) -> None:
         """The EXPLAIN resolve path: finish the ticket's trace EARLY
         (terminal ``resolve`` — ``Ticket.resolve``'s own close then
         no-ops, first-end-wins) and attach the cost-attribution record
@@ -1937,7 +1993,9 @@ class ServeRuntime:
         reading ``fut.result()`` then ``fut.explain`` never races this
         thread. The record is assembled FROM the finished span tree
         (``obs.fleet.explain_record``) — the one source of truth the
-        fleet trace view also serves."""
+        fleet trace view also serves. Join requests additionally carry
+        the batch's plan-shape/hub/correction attribution read off the
+        launched token (``_join_explain``)."""
         tr = ticket.trace
         if tr is None:
             return
@@ -1950,9 +2008,40 @@ class ServeRuntime:
                 breaker_state=(None if key is None
                                else self.breaker.state_of(key)),
                 shard_owner=self._shard_owner(ticket.request),
+                join=self._join_explain(res, path, token),
             )
         except Exception:  # noqa: BLE001 - never fail a resolve over EXPLAIN
             ticket.future.explain = None
+
+    @staticmethod
+    def _join_explain(res, path: str, token):
+        """Join-engine attribution for the EXPLAIN record (ROADMAP: the
+        PR-13 records predate join engine v2): the chosen plan shape —
+        ``bushy`` (GHD bag decomposition) / ``hub`` (degree-split
+        dense-frontier lanes in this batch) / ``flat`` (the PR-10 step
+        chain) / ``host`` (exact host path, no device plan) — plus the
+        batch's ``hub_dispatches`` and collect-side
+        ``partial_corrections`` (batch-level counts: the request reports
+        the dispatch it rode, the per-batch twin of the
+        ``serve.join.*`` counters). None for non-join requests."""
+        if getattr(res, "kind", None) != "join":
+            return None
+        plan = getattr(token, "join_plan", None)
+        hub = int(getattr(token, "join_hub_lanes", 0) or 0)
+        if path == "host" or plan is None:
+            shape = "host"
+        elif type(plan).__name__ == "BushyJoinPlan":
+            shape = "bushy"
+        elif hub:
+            shape = "hub"
+        else:
+            shape = "flat"
+        return {
+            "plan": shape,
+            "hub_dispatches": hub,
+            "partial_corrections": int(
+                getattr(token, "join_partials", 0) or 0),
+        }
 
     def _shard_owner(self, request):
         """The mesh partition that owns this request's primary id (the

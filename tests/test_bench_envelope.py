@@ -1,0 +1,231 @@
+"""Bench record envelope + ``--diff`` regression verdicts.
+
+One ``_record_bench`` envelope for every ``BENCH_C*_<tag>.json`` writer
+(schema v2 adds ``git_rev``; the committed v1 smokes stay readable), the
+version-checking reader, and the per-metric diff tool the real-TPU sweep
+answers the "is the CPU smoke lying" question with.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import bench
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+FIXTURES = os.path.join(HERE, "perf_fixtures")
+BASE = os.path.join(FIXTURES, "BENCH_C6_base.json")
+REGRESSED = os.path.join(FIXTURES, "BENCH_C6_regressed.json")
+
+
+# ---------------------------------------------------------------- envelope
+
+
+def test_record_bench_envelope_shape(tmp_path, monkeypatch):
+    monkeypatch.setenv("BENCH_RECORD_DIR", str(tmp_path))
+    monkeypatch.setenv("BENCH_C6_TAG", "unit")
+    name = bench._record_bench("c6_serving", {
+        "served_qps": 10.0, "telemetry": {"x": 1}, "recorded_to": "old",
+    })
+    assert name == "BENCH_C6_unit.json"
+    rec = bench.read_bench(str(tmp_path / name))
+    assert rec["schema_version"] == bench.BENCH_SCHEMA_VERSION
+    assert rec["tag"] == "unit"
+    assert "backend" in rec and "recorded_unix" in rec
+    assert "git_rev" in rec           # provenance (None off-git is fine)
+    # envelope-internal keys never leak into the payload
+    assert rec["c6_serving"] == {"served_qps": 10.0}
+    key, payload = bench.bench_payload(rec)
+    assert key == "c6_serving" and payload["served_qps"] == 10.0
+
+
+def test_every_recorded_config_shares_the_envelope(tmp_path, monkeypatch):
+    monkeypatch.setenv("BENCH_RECORD_DIR", str(tmp_path))
+    for config_key, (tag_env, prefix) in bench.BENCH_RECORDED.items():
+        monkeypatch.setenv(tag_env, "unit")
+        name = bench._record_bench(config_key, {"m": 1.0})
+        assert name == f"{prefix}_unit.json"
+        rec = bench.read_bench(str(tmp_path / name))
+        assert set(rec) == {"schema_version", "recorded_unix", "tag",
+                            "backend", "git_rev", config_key}
+
+
+def test_reader_accepts_committed_v1_smokes():
+    for name in ("BENCH_C7_smoke.json", "BENCH_C8_smoke.json",
+                 "BENCH_C9_smoke.json", "BENCH_C6_local.json"):
+        rec = bench.read_bench(os.path.join(REPO, name))
+        assert rec["schema_version"] in bench.BENCH_SCHEMA_ACCEPTED
+
+
+def test_reader_rejects_bad_records(tmp_path):
+    p = tmp_path / "x.json"
+    p.write_text(json.dumps({"schema_version": 99, "tag": "t",
+                             "backend": "cpu", "recorded_unix": 1,
+                             "c6_serving": {}}))
+    with pytest.raises(ValueError, match="schema"):
+        bench.read_bench(str(p))
+    p.write_text(json.dumps({"schema_version": 2, "backend": "cpu",
+                             "recorded_unix": 1, "c6_serving": {}}))
+    with pytest.raises(ValueError, match="tag"):
+        bench.read_bench(str(p))
+    p.write_text(json.dumps({"schema_version": 2, "tag": "t",
+                             "backend": "cpu", "recorded_unix": 1}))
+    with pytest.raises(ValueError, match="config payload"):
+        bench.read_bench(str(p))
+    p.write_text(json.dumps({"schema_version": 2, "tag": "t",
+                             "backend": "cpu", "recorded_unix": 1,
+                             "c6_serving": {}, "c8_sharded": {}}))
+    with pytest.raises(ValueError, match="config payload"):
+        bench.read_bench(str(p))
+
+
+# -------------------------------------------------------------------- diff
+
+
+def test_diff_identical_files_verdict_ok():
+    report = bench.bench_diff(BASE, BASE)
+    assert report["verdict"] == "ok"
+    assert report["regressed"] == [] and report["improved"] == []
+    assert report["context_mismatch"] == []
+    assert report["backend_differs"] is False
+
+
+def test_diff_injected_regression_fixture_pair():
+    report = bench.bench_diff(BASE, REGRESSED)
+    assert report["verdict"] == "regressed"
+    assert "latency_ms_p50" in report["regressed"]
+    assert "served_qps" in report["regressed"]
+    assert "batched_vs_unbatched" in report["regressed"]
+    m = report["metrics"]["latency_ms_p50"]
+    assert m["direction"] == "lower" and m["verdict"] == "regressed"
+    # scale knobs matched, so the comparison context is clean
+    assert report["context_mismatch"] == []
+
+
+def test_diff_improvement_is_not_regression():
+    # reversed direction: B is the FASTER file → improved, exit-0 class
+    report = bench.bench_diff(REGRESSED, BASE)
+    assert report["verdict"] == "ok"
+    assert "latency_ms_p50" in report["improved"]
+    assert report["regressed"] == []
+
+
+def test_diff_tolerance_is_honored():
+    strict = bench.bench_diff(BASE, REGRESSED, tolerance=0.01)
+    loose = bench.bench_diff(BASE, REGRESSED, tolerance=10.0)
+    assert strict["verdict"] == "regressed"
+    assert loose["verdict"] == "ok"
+
+
+def test_diff_context_mismatch_flagged_not_fatal(tmp_path):
+    rec = json.load(open(BASE))
+    rec["c6_serving"]["entities"] = 9999          # different scale
+    other = tmp_path / "BENCH_C6_other.json"
+    other.write_text(json.dumps(rec))
+    report = bench.bench_diff(BASE, str(other))
+    assert "entities" in report["context_mismatch"]
+    assert report["verdict"] == "ok"
+
+
+def test_diff_config_mismatch_raises():
+    with pytest.raises(ValueError, match="config mismatch"):
+        bench.bench_diff(BASE, os.path.join(REPO, "BENCH_C8_smoke.json"))
+
+
+def test_metric_direction_classification():
+    d = bench._metric_direction
+    assert d("served_qps") == "higher"
+    assert d("edges_per_sec") == "higher"
+    assert d("batched_vs_unbatched") == "higher"
+    assert d("batch_occupancy") == "higher"
+    assert d("served_qps_per_device_count.8") == "higher"
+    # matched per SEGMENT: the nested vs_host ratios c7 records gate too
+    assert d("triangle.vs_host") == "higher"
+    assert d("hub_heavy.device_anchors_per_sec") == "higher"
+    assert d("latency_ms_p99") == "lower"
+    assert d("fact_build_s") == "lower"
+    assert d("cold_start_s.cache_absent_s") == "lower"
+    # the seconds suffix applies to the FINAL segment only
+    assert d("cold_start_s.entities") == "info"
+    # config knobs never read as perf regressions
+    assert d("deadline_s") == "info"
+    assert d("offered_qps") == "info"      # the INPUT rate, not served
+    assert d("requests") == "info"
+    assert d("entities") == "info"
+    assert d("devices.0") == "info"
+
+
+def test_diff_gates_nested_vs_ratios():
+    """A c7-style nested vs_host collapse must exit nonzero (the
+    full-path classifier once read `triangle.vs_host` as info)."""
+    rec = {"schema_version": 2, "recorded_unix": 1, "tag": "a",
+           "backend": "cpu", "git_rev": None,
+           "c7_pattern_join": {"triangle": {"vs_host": 8.0}}}
+    import copy
+    worse = copy.deepcopy(rec)
+    worse["c7_pattern_join"]["triangle"]["vs_host"] = 0.5
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        a, b = os.path.join(td, "a.json"), os.path.join(td, "b.json")
+        json.dump(rec, open(a, "w"))
+        json.dump(worse, open(b, "w"))
+        report = bench.bench_diff(a, b)
+    assert report["regressed"] == ["triangle.vs_host"]
+
+
+# --------------------------------------------------------------------- CLI
+
+
+def run_cli(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), *args],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+
+
+def test_cli_exit_codes():
+    ok = run_cli("--diff", BASE, BASE)
+    assert ok.returncode == 0, ok.stderr
+    assert json.loads(ok.stdout)["verdict"] == "ok"
+    bad = run_cli("--diff", BASE, REGRESSED)
+    assert bad.returncode == 1, bad.stderr
+    assert json.loads(bad.stdout)["verdict"] == "regressed"
+    loose = run_cli("--diff", BASE, REGRESSED, "--diff-tolerance", "10")
+    assert loose.returncode == 0, loose.stderr
+    usage = run_cli("--diff", BASE)
+    assert usage.returncode == 2
+    missing = run_cli("--diff", BASE, "/nonexistent.json")
+    assert missing.returncode == 2
+    # a mistyped flag must not silently gate at the default tolerance
+    typo = run_cli("--diff", BASE, REGRESSED, "--tolerance", "10")
+    assert typo.returncode == 2 and "unknown flag" in typo.stderr
+
+
+def test_cli_seed_baseline(tmp_path):
+    out = str(tmp_path / "PERF_BASELINE.json")
+    proc = run_cli("--seed-baseline", out)
+    assert proc.returncode == 0, proc.stderr
+    from hypergraphdb_tpu.obs.perf import load_baseline
+
+    rec = load_baseline(out)
+    assert rec["lanes"]                     # seeded from committed smokes
+    assert json.loads(proc.stdout)["wrote"] == out
+
+
+def test_committed_perf_baseline_loads():
+    """The committed PERF_BASELINE.json is readable and names real
+    serve lanes — the file the sentinel drill loads."""
+    from hypergraphdb_tpu.obs.perf import load_baseline
+    from hypergraphdb_tpu.serve.stats import LANE_KINDS
+
+    rec = load_baseline(os.path.join(REPO, "PERF_BASELINE.json"))
+    assert rec["lanes"]
+    assert set(rec["lanes"]) <= set(LANE_KINDS)
+    for lane in rec["lanes"].values():
+        assert lane.get("p50_s") or lane.get("p99_s")

@@ -583,3 +583,96 @@ def test_lane_counters_follow_served_path():
     assert counts[("pattern", "device")] == 1
     assert counts[("bfs", "host")] == 0
     rt.close()
+
+
+# --------------------------------------------------- join EXPLAIN (hgperf)
+
+
+def test_join_explain_plan_shape_derivation():
+    """The EXPLAIN join attribution (PR-13 records predate join engine
+    v2): plan shape flat/bushy/hub/host + the batch's hub/correction
+    counts, derived from the launched token."""
+    from types import SimpleNamespace as NS
+
+    derive = ServeRuntime._join_explain
+    res = NS(kind="join")
+    flat_plan = NS()
+    # non-join results carry no join section
+    assert derive(NS(kind="bfs"), "device", NS(join_plan=flat_plan)) is None
+    # host path (or no device plan): "host", no hub lanes
+    rec = derive(res, "host", NS(join_plan=None, join_hub_lanes=0,
+                                 join_partials=0))
+    assert rec == {"plan": "host", "hub_dispatches": 0,
+                   "partial_corrections": 0}
+    # flat vs hub distinguished by the batch's hub lanes
+    rec = derive(res, "device", NS(join_plan=flat_plan, join_hub_lanes=0,
+                                   join_partials=1))
+    assert rec["plan"] == "flat" and rec["partial_corrections"] == 1
+    rec = derive(res, "device", NS(join_plan=flat_plan, join_hub_lanes=3,
+                                   join_partials=0))
+    assert rec["plan"] == "hub" and rec["hub_dispatches"] == 3
+    # a bushy decomposition is named by its plan class
+    from hypergraphdb_tpu.join.planner import BushyJoinPlan
+
+    bushy = BushyJoinPlan.__new__(BushyJoinPlan)
+    rec = derive(res, "device", NS(join_plan=bushy, join_hub_lanes=2,
+                                   join_partials=0))
+    assert rec["plan"] == "bushy"
+
+
+def test_join_explain_record_rides_the_span_tree():
+    rec = explain_record(
+        _finished_trace(), join={"plan": "hub", "hub_dispatches": 2,
+                                 "partial_corrections": 1},
+    )
+    assert rec["join"] == {"plan": "hub", "hub_dispatches": 2,
+                           "partial_corrections": 1}
+    assert "join" not in explain_record(_finished_trace())
+
+
+def _finished_trace():
+    tracer = Tracer(clock=FakeClock()).enable()
+    tr = tracer.start_trace("serve.request", kind="join")
+    tr.finish_terminal("resolve")
+    return tr
+
+
+def test_join_explain_end_to_end_device_and_host():
+    """A real device-served join carries its plan shape + batch counts;
+    a tombstoned memtable routes the next join to the exact host path
+    and the record says so."""
+    jax = pytest.importorskip("jax")  # noqa: F841 - device lane needed
+    from hypergraphdb_tpu import HyperGraph
+    from hypergraphdb_tpu.query import conditions as c
+    from hypergraphdb_tpu.query.variables import var
+    from tests.conftest import make_random_hypergraph
+
+    g = HyperGraph()
+    try:
+        nodes, links = make_random_hypergraph(g, n_nodes=60, n_links=120,
+                                              max_arity=3, seed=7)
+        tracer = Tracer().enable()
+        rt = ServeRuntime(g, ServeConfig(buckets=(4,), max_linger_s=0.001,
+                                         tracer=tracer, top_r=128))
+        try:
+            spec = {"y": c.And(c.CoIncident(int(nodes[3])),
+                               c.CoIncident(var("z"))),
+                    "z": c.CoIncident(int(nodes[3]))}
+            fut = rt.submit_join(spec, explain=True)
+            fut.result(timeout=120)
+            rec = fut.explain
+            assert rec is not None and rec["kind"] == "join"
+            assert rec["join"]["plan"] in ("flat", "bushy", "hub")
+            assert rec["join"]["hub_dispatches"] >= 0
+            assert rec["join"]["partial_corrections"] >= 0
+            # a tombstone dirties the memtable past correction: the
+            # whole next batch serves exactly on host, attributed so
+            g.remove(int(links[0]))
+            fut2 = rt.submit_join(spec, explain=True)
+            fut2.result(timeout=120)
+            assert fut2.explain["join"]["plan"] == "host"
+            assert fut2.explain["lane"] == "join/host"
+        finally:
+            rt.close()
+    finally:
+        g.close()

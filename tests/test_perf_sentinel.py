@@ -1,0 +1,580 @@
+"""hgperf: runtime perf baselines, drift sentinel, skew attribution,
+incident profiles.
+
+Everything runs on fake clocks and an injectable profiler (jax-free);
+the acceptance contract is the end-to-end drill at the bottom: a seeded
+slowdown on one serve lane fires exactly ONE flight incident whose dump
+dir holds both the flight window and a profiler capture, ``/fleet/perf``
+names that lane (and only that lane), and the undisturbed soak fires
+zero.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from contextlib import contextmanager
+
+import pytest
+
+from hypergraphdb_tpu.obs.flight import FlightRecorder, parse_flight_jsonl
+from hypergraphdb_tpu.obs.fleet import FleetCollector, LocalNodeSource
+from hypergraphdb_tpu.obs.http import runtime_health
+from hypergraphdb_tpu.obs.perf import (
+    BASELINE_SCHEMA_VERSION,
+    PerfSentinel,
+    load_baseline,
+    save_baseline,
+    seed_baseline,
+    shard_skew,
+)
+from hypergraphdb_tpu.obs.slo import fleet_objectives
+from hypergraphdb_tpu.serve import ServeConfig, ServeRuntime
+from tests.test_serve_runtime import FakeClock, FakeExecutor
+
+BASELINE = {
+    "schema_version": BASELINE_SCHEMA_VERSION,
+    "backend": "fake",
+    "lanes": {"bfs": {"p50_s": 0.01, "p99_s": 0.02, "qps": 100.0}},
+    "factors": {"p50_s": 3.0, "p99_s": 3.0, "device_s_per_req": 3.0},
+}
+
+
+class FakeProfiler:
+    """Injectable ``obs.profile`` stand-in: records open/close edges and
+    drops a trace marker file in the session dir (what the real
+    profiler's trace files assert as)."""
+
+    def __init__(self):
+        self.events: list = []
+
+    def __call__(self, logdir):
+        @contextmanager
+        def session():
+            self.events.append(("open", logdir))
+            with open(os.path.join(logdir, "trace.marker"), "w") as f:
+                f.write("profiler trace\n")
+            yield True
+            self.events.append(("close", logdir))
+
+        return session()
+
+
+def make_sentinel(tmp_path=None, baseline=BASELINE, windows=(5.0, 20.0),
+                  **kw):
+    clock = FakeClock()
+    incident_dir = str(tmp_path) if tmp_path is not None else None
+    flight = FlightRecorder(clock=clock, incident_dir=incident_dir,
+                            min_dump_interval_s=0.0)
+    profiler = FakeProfiler()
+    kw.setdefault("min_samples", 4)
+    kw.setdefault("eval_interval_s", 0.0)
+    kw.setdefault("profile_s", 2.0)
+    sen = PerfSentinel(baseline=baseline, clock=clock, flight=flight,
+                       windows=windows, profiler=profiler, **kw)
+    return sen, clock, flight, profiler
+
+
+def feed(sen, clock, latency, n, dt=1.0, kind="bfs", tick=True):
+    for _ in range(n):
+        clock.advance(dt)
+        sen.observe(kind, latency)
+        if tick:
+            sen.tick()
+
+
+# ---------------------------------------------------------------- baseline
+
+
+def test_baseline_round_trip_and_version_check(tmp_path):
+    path = str(tmp_path / "PERF_BASELINE.json")
+    save_baseline(BASELINE, path)
+    assert load_baseline(path)["lanes"]["bfs"]["p99_s"] == 0.02
+    bad = dict(BASELINE, schema_version=BASELINE_SCHEMA_VERSION + 1)
+    save_baseline(bad, path)
+    with pytest.raises(ValueError):
+        load_baseline(path)
+    save_baseline({"schema_version": BASELINE_SCHEMA_VERSION}, path)
+    with pytest.raises(ValueError):  # no lanes mapping
+        load_baseline(path)
+
+
+def test_seed_baseline_from_bench_records(tmp_path):
+    (tmp_path / "BENCH_C6_smoke.json").write_text(json.dumps({
+        "schema_version": 1, "tag": "smoke", "backend": "cpu",
+        "recorded_unix": 1,
+        "c6_serving": {"batched_vs_unbatched": 2.0, "latency_ms_p50": 20.0,
+                       "latency_ms_p99": 30.0, "served_qps": 120.0},
+    }))
+    (tmp_path / "BENCH_C9_local.json").write_text(json.dumps({
+        "schema_version": 2, "tag": "local", "backend": "cpu",
+        "recorded_unix": 1, "git_rev": "abc",
+        "c9_value_index": {"latency_ms_p50": 5.0, "latency_ms_p99": 8.0,
+                           "served_qps": 900.0},
+    }))
+    (tmp_path / "BENCH_C7_smoke.json").write_text(json.dumps({
+        "schema_version": 1, "tag": "smoke", "backend": "cpu",
+        "recorded_unix": 1,
+        "c7_pattern_join": {"triangle": {"device_anchors_per_sec": 50.0}},
+    }))
+    out = str(tmp_path / "PERF_BASELINE.json")
+    rec = seed_baseline(str(tmp_path), out_path=out)
+    assert sorted(rec["lanes"]) == ["bfs", "join", "range"]
+    assert rec["lanes"]["bfs"]["p50_s"] == pytest.approx(0.02)
+    assert rec["lanes"]["range"]["p99_s"] == pytest.approx(0.008)
+    assert rec["lanes"]["join"]["p50_s"] == pytest.approx(0.02)
+    assert rec["backend"] == "cpu"
+    # the written file round-trips through the version-checking reader
+    assert load_baseline(out)["source"] == rec["source"]
+    # pattern has no bench record: not seeded, so never gated
+    assert "pattern" not in rec["lanes"]
+
+
+# ---------------------------------------------------------------- windows
+
+
+def test_window_digest_math():
+    sen, clock, _, _ = make_sentinel(windows=(10.0, 40.0))
+    for lat in (0.01, 0.02, 0.03, 0.04):
+        clock.advance(1.0)
+        sen.observe("bfs", lat)
+    sen.observe("bfs", 0.05, path="host")
+    sen.observe_batch("bfs", 0.008, n_real=2, n_total=4)
+    snap = sen.tick()
+    short = snap["lanes"]["bfs"]["windows"][0]
+    assert short["n"] == 5
+    assert short["qps"] == pytest.approx(0.5)
+    assert short["p50_s"] == pytest.approx(0.03)
+    assert short["p99_s"] == pytest.approx(0.05)
+    assert short["host_fraction"] == pytest.approx(0.2)
+    assert short["device_s_per_req"] == pytest.approx(0.004)
+    assert short["occupancy"] == pytest.approx(0.5)
+
+
+def test_healthy_soak_fires_zero_incidents(tmp_path):
+    sen, clock, flight, profiler = make_sentinel(tmp_path)
+    feed(sen, clock, 0.01, 60)       # exactly at baseline p50
+    assert flight.incidents == 0
+    assert profiler.events == []
+    lane = sen.snapshot()["lanes"]["bfs"]
+    assert lane["violating"] is False
+    assert all(w["degraded"] is False for w in lane["windows"])
+
+
+def test_sustained_slowdown_exactly_one_incident_with_profile(tmp_path):
+    sen, clock, flight, profiler = make_sentinel(tmp_path)
+    feed(sen, clock, 0.01, 30)                  # healthy history
+    feed(sen, clock, 0.2, 40)                   # sustained 20× slowdown
+    assert flight.incidents == 1                # edge-triggered: ONE
+    lane = sen.snapshot()["lanes"]["bfs"]
+    assert lane["violating"] is True
+    assert lane["alerts_total"] == 1
+    # the flight window dump landed beside a profiler capture
+    dump = lane["last_incident"]
+    assert dump is not None and os.path.exists(dump)
+    records = parse_flight_jsonl(open(dump).read())
+    assert any(r["kind"] == "incident"
+               and r["reason"] == "perf_drift_bfs" for r in records)
+    profile_dir = lane["last_profile"]
+    assert profile_dir is not None and os.path.isdir(profile_dir)
+    assert os.path.dirname(profile_dir) == os.path.dirname(dump)
+    assert os.path.exists(os.path.join(profile_dir, "trace.marker"))
+    manifest = json.load(open(os.path.join(profile_dir, "PROFILE.json")))
+    assert manifest["lane"] == "bfs"
+    assert manifest["profiler_active"] is True
+    # the session is BOUNDED: it closed profile_s after opening, and the
+    # manifest records both edges
+    assert profiler.events[0][0] == "open"
+    assert ("close", profile_dir) in profiler.events
+    assert manifest["t1"] >= manifest["t0"]
+
+
+def test_short_blip_does_not_alert(tmp_path):
+    sen, clock, flight, _ = make_sentinel(tmp_path, windows=(5.0, 60.0))
+    feed(sen, clock, 0.01, 60)
+    feed(sen, clock, 0.2, 2)         # 2-sample blip: 2/60 < 5% long-window
+    feed(sen, clock, 0.01, 10)
+    assert flight.incidents == 0
+
+
+def test_rearm_only_after_every_window_clears(tmp_path):
+    sen, clock, flight, _ = make_sentinel(tmp_path, windows=(5.0, 20.0))
+    feed(sen, clock, 0.01, 25)
+    feed(sen, clock, 0.2, 20)        # sustained → one incident
+    assert flight.incidents == 1
+    # recover just past the SHORT window: the long window still holds
+    # the degraded period, so the lane stays armed-off — a fresh burst
+    # must NOT fire a second incident
+    feed(sen, clock, 0.01, 7)
+    lane = sen.snapshot()["lanes"]["bfs"]
+    assert lane["windows"][0]["degraded"] is False
+    assert lane["windows"][1]["degraded"] is True
+    assert lane["violating"] is True              # not yet re-armed
+    feed(sen, clock, 0.2, 6)
+    assert flight.incidents == 1
+    # clear EVERY window, then a new sustained degradation fires again
+    feed(sen, clock, 0.01, 25)
+    assert sen.snapshot()["lanes"]["bfs"]["violating"] is False
+    feed(sen, clock, 0.2, 20)
+    assert flight.incidents == 2
+
+
+def test_unwatched_lane_never_gates(tmp_path):
+    sen, clock, flight, _ = make_sentinel(tmp_path)
+    feed(sen, clock, 9.9, 50, kind="pattern")     # no baseline entry
+    assert flight.incidents == 0
+    lane = sen.snapshot()["lanes"]["pattern"]
+    assert lane["watched"] is False
+    assert lane["violating"] is False
+
+
+def test_snapshot_is_a_pure_read(tmp_path):
+    sen, clock, flight, profiler = make_sentinel(tmp_path)
+    feed(sen, clock, 0.01, 25, tick=False)
+    feed(sen, clock, 0.2, 20, tick=False)
+    for _ in range(5):
+        assert sen.snapshot()["lanes"]["bfs"]["violating"] is False
+    assert flight.incidents == 0
+    assert profiler.events == []
+    sen.tick()                        # the mutating edge
+    assert flight.incidents == 1
+
+
+def test_incidents_rate_limited_by_flight_recorder(tmp_path):
+    """The dump machinery is the flight recorder's own rate limit: two
+    lanes firing inside min_dump_interval_s cost two COUNTED incidents
+    but one dump file."""
+    baseline = dict(BASELINE, lanes={
+        "bfs": {"p50_s": 0.01, "p99_s": 0.02},
+        "range": {"p50_s": 0.01, "p99_s": 0.02},
+    })
+    sen, clock, flight, _ = make_sentinel(tmp_path, baseline=baseline)
+    flight.min_dump_interval_s = 3600.0
+    for _ in range(25):
+        clock.advance(1.0)
+        sen.observe("bfs", 0.01)
+        sen.observe("range", 0.01)
+        sen.tick()
+    for _ in range(20):
+        clock.advance(1.0)
+        sen.observe("bfs", 0.2)
+        sen.observe("range", 0.2)
+        sen.tick()
+    assert flight.incidents == 2
+    assert flight.dumps == 1
+
+
+# -------------------------------------------------------------------- skew
+
+
+def test_shard_skew_math_names_the_straggler():
+    skew = shard_skew({"shards": [
+        {"device": 0, "gid_lo": 0, "gid_hi": 100, "hbm_bytes_in_use": 100},
+        {"device": 1, "gid_lo": 100, "gid_hi": 200, "hbm_bytes_in_use": 300},
+    ]})
+    assert skew["hbm_bytes_in_use"]["ratio"] == pytest.approx(1.5)
+    assert skew["hbm_bytes_in_use"]["straggler"] == 1
+    assert skew["gid_span"]["ratio"] == pytest.approx(1.0)
+    assert shard_skew({}) == {}
+    # a CPU mesh (no allocator stats) still reports the structural span
+    cpu = shard_skew({"shards": [{"device": 0, "gid_lo": 0,
+                                  "gid_hi": 128}]})
+    assert "hbm_bytes_in_use" not in cpu
+
+
+def test_skew_violation_is_edge_triggered(tmp_path):
+    report = {"shards": [
+        {"device": 0, "hbm_bytes_in_use": 100},
+        {"device": 1, "hbm_bytes_in_use": 100},
+    ]}
+    sen, clock, flight, _ = make_sentinel(
+        tmp_path, mesh_source=lambda: report, skew_ratio_max=1.5,
+    )
+    for _ in range(3):
+        clock.advance(1.0)
+        sen.tick()
+    assert flight.incidents == 0
+    report["shards"][1]["hbm_bytes_in_use"] = 1000   # 1.82× mean
+    for _ in range(5):
+        clock.advance(1.0)
+        sen.tick()
+    assert flight.incidents == 1                      # edge, not level
+    assert sen.health_summary()["violating"] == ["skew"]
+    window = parse_flight_jsonl(open(flight.last_dump_path).read())
+    inc = [r for r in window if r["kind"] == "incident"][-1]
+    assert inc["reason"] == "perf_skew_hbm_bytes_in_use"
+    assert inc["straggler"] == 1
+    report["shards"][1]["hbm_bytes_in_use"] = 100     # recover → re-arm
+    sen.tick()
+    assert sen.health_summary()["violating"] == []
+    report["shards"][1]["hbm_bytes_in_use"] = 1000
+    sen.tick()
+    assert flight.incidents == 2
+
+
+# -------------------------------------------------- end-to-end runtime drill
+
+
+class SlowFakeExecutor(FakeExecutor):
+    """FakeExecutor whose collect costs ``delay`` seconds on the shared
+    fake clock — the seeded per-lane slowdown injection."""
+
+    def __init__(self, clock):
+        super().__init__()
+        self.clock = clock
+        self.delay = 0.0
+
+    def collect(self, token):
+        self.clock.advance(self.delay)
+        return super().collect(token)
+
+
+def drill_runtime(tmp_path, inject: bool):
+    clock = FakeClock()
+    flight = FlightRecorder(clock=clock, incident_dir=str(tmp_path),
+                            min_dump_interval_s=0.0)
+    profiler = FakeProfiler()
+    sen = PerfSentinel(baseline=BASELINE, clock=clock, flight=flight,
+                       windows=(5.0, 20.0), min_samples=4,
+                       eval_interval_s=0.0, profiler=profiler)
+    ex = SlowFakeExecutor(clock)
+    cfg = ServeConfig(buckets=(4,), max_linger_s=0.0, clock=clock,
+                      manual=True, perf=sen)
+    rt = ServeRuntime(graph=None, config=cfg, executor=ex)
+
+    def soak(n, delay):
+        ex.delay = delay
+        for _ in range(n):
+            clock.advance(1.0)
+            rt.submit_bfs(1)
+            rt.step(drain=True)
+
+    soak(25, 0.005)                       # healthy: inside baseline
+    soak(25, 0.2 if inject else 0.005)    # the seeded lane slowdown
+    return rt, sen, flight, profiler
+
+
+def test_e2e_drill_injected_slowdown(tmp_path):
+    """The acceptance drill: one seeded slow lane → exactly one
+    rate-limited incident, flight window + profiler capture in the dump
+    dir, ``/fleet/perf`` showing that lane and ONLY that lane."""
+    rt, sen, flight, profiler = drill_runtime(tmp_path, inject=True)
+    try:
+        assert flight.incidents == 1
+        lane = sen.snapshot()["lanes"]["bfs"]
+        assert lane["violating"] is True
+        dump, profile_dir = lane["last_incident"], lane["last_profile"]
+        assert dump and os.path.exists(dump)
+        window = parse_flight_jsonl(open(dump).read())
+        assert [r["reason"] for r in window
+                if r["kind"] == "incident"] == ["perf_drift_bfs"]
+        assert profile_dir and os.path.exists(
+            os.path.join(profile_dir, "trace.marker"))
+
+        # the fleet view: the door names the lane, and only the lane
+        collector = FleetCollector(
+            [LocalNodeSource("n1", registries=[sen.registry],
+                             health=runtime_health(rt))],
+            clock=rt.clock, poll_interval_s=0,
+        )
+        collector.poll()
+        fp = collector.fleet_perf()
+        assert fp["violating"] == {"n1": ["bfs"]}
+        assert fp["nodes"]["n1"]["watched"] == ["bfs"]
+        assert fp["alerts_total"] == 1
+        assert fp["nodes_reporting"] == 1
+
+        # the perf-drift error-budget objective sees the violating node
+        mon = fleet_objectives(collector)
+        collector.slo = mon
+        for _ in range(3):
+            rt.clock.advance(1.0)
+            collector.poll()
+        snap = mon.snapshot()["perf_drift"]
+        assert snap["windows"][0]["events"] >= 2
+        assert snap["windows"][0]["error_ratio"] == pytest.approx(1.0)
+    finally:
+        rt.close()
+        sen.close()
+
+
+def test_e2e_drill_undisturbed_soak_is_silent(tmp_path):
+    rt, sen, flight, profiler = drill_runtime(tmp_path, inject=False)
+    try:
+        assert flight.incidents == 0
+        assert profiler.events == []
+        assert sen.health_summary()["violating"] == []
+        assert not [p for p in os.listdir(tmp_path)]
+    finally:
+        rt.close()
+        sen.close()
+
+
+def test_runtime_device_batches_feed_the_sentinel():
+    clock = FakeClock()
+    sen = PerfSentinel(baseline=BASELINE, clock=clock, windows=(5.0,),
+                       eval_interval_s=0.0)
+    cfg = ServeConfig(buckets=(4,), max_linger_s=0.0, clock=clock,
+                      manual=True, perf=sen)
+    rt = ServeRuntime(graph=None, config=cfg, executor=FakeExecutor())
+    rt.submit_bfs(1)
+    clock.advance(0.25)
+    rt.step(drain=True)
+    rt.close()
+    lane = sen.snapshot()["lanes"]["bfs"]
+    assert lane["windows"][0]["n"] == 1
+    assert lane["windows"][0]["p50_s"] == pytest.approx(0.25)
+
+
+def test_outrun_sample_ring_is_unknown_not_degraded(tmp_path):
+    """A burst that fills the WHOLE bounded ring must not impersonate a
+    degraded long window: with history evicted younger than the window
+    start, the window is span-truncated → unknown → no page (size
+    max_samples ≥ qps × longest window to keep long windows live)."""
+    sen, clock, flight, _ = make_sentinel(tmp_path, windows=(5.0, 60.0),
+                                          max_samples=8)
+    feed(sen, clock, 0.01, 100)      # healthy history (long since evicted)
+    # sub-second burst: 8 slow samples fill the ring inside 0.8 s
+    for _ in range(8):
+        clock.advance(0.1)
+        sen.observe("bfs", 0.2)
+    snap = sen.tick()
+    lane = snap["lanes"]["bfs"]
+    assert all(w["span_truncated"] for w in lane["windows"])
+    assert all(w["status"] == "unknown" for w in lane["windows"])
+    assert flight.incidents == 0
+    # a ring that DOES cover the span keeps its verdict power
+    sen2, clock2, flight2, _ = make_sentinel(tmp_path)
+    feed(sen2, clock2, 0.01, 25)
+    feed(sen2, clock2, 0.2, 20)
+    assert flight2.incidents == 1
+
+
+def test_seed_baseline_newest_record_wins(tmp_path):
+    """The documented re-seed flow: a fresh real-hardware sweep under a
+    NEW tag must beat the committed smokes, whatever the tag — and
+    records under a second dir (BENCH_RECORD_DIR) are scanned too."""
+    (tmp_path / "BENCH_C6_smoke.json").write_text(json.dumps({
+        "schema_version": 1, "tag": "smoke", "backend": "cpu",
+        "recorded_unix": 100,
+        "c6_serving": {"batched_vs_unbatched": 2.0,
+                       "latency_ms_p50": 1000.0, "latency_ms_p99": 2000.0,
+                       "served_qps": 1.0},
+    }))
+    rec_dir = tmp_path / "records"
+    rec_dir.mkdir()
+    (rec_dir / "BENCH_C6_tpu.json").write_text(json.dumps({
+        "schema_version": 2, "tag": "tpu", "backend": "tpu",
+        "recorded_unix": 200, "git_rev": "abc",
+        "c6_serving": {"batched_vs_unbatched": 9.0, "latency_ms_p50": 2.0,
+                       "latency_ms_p99": 4.0, "served_qps": 50000.0},
+    }))
+    rec = seed_baseline((str(tmp_path), str(rec_dir)))
+    assert rec["source"] == ["BENCH_C6_tpu.json"]
+    assert rec["backend"] == "tpu"
+    assert rec["lanes"]["bfs"]["p50_s"] == pytest.approx(0.002)
+    # SAME BASENAME in the record dir still competes (dedup is by real
+    # path): a read-only-checkout rerun under the default tag must beat
+    # the committed smoke it shadows by name
+    (rec_dir / "BENCH_C6_smoke.json").write_text(json.dumps({
+        "schema_version": 2, "tag": "smoke", "backend": "tpu",
+        "recorded_unix": 300, "git_rev": "abc",
+        "c6_serving": {"batched_vs_unbatched": 9.0, "latency_ms_p50": 1.0,
+                       "latency_ms_p99": 2.0, "served_qps": 90000.0},
+    }))
+    rec = seed_baseline((str(tmp_path), str(rec_dir)))
+    assert rec["source"] == ["BENCH_C6_smoke.json"]
+    assert rec["lanes"]["bfs"]["p50_s"] == pytest.approx(0.001)
+
+
+def test_min_samples_zero_is_clamped_not_a_crash():
+    sen, clock, flight, _ = make_sentinel(min_samples=0)
+    assert sen.min_samples == 1
+    sen.tick()                      # zero samples: unknown, no division
+    assert flight.incidents == 0
+
+
+def test_undersized_ring_warns_at_construction(caplog):
+    import logging
+
+    with caplog.at_level(logging.WARNING, "hypergraphdb_tpu.obs"):
+        PerfSentinel(baseline={"lanes": {"range": {"p50_s": 0.01,
+                                                   "qps": 12568.0}}},
+                     windows=(30.0, 120.0), max_samples=4096)
+    assert any("span_truncated" in r.message for r in caplog.records)
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, "hypergraphdb_tpu.obs"):
+        PerfSentinel(baseline={"lanes": {"bfs": {"p50_s": 0.01,
+                                                 "qps": 10.0}}},
+                     windows=(30.0, 120.0), max_samples=4096)
+    assert not caplog.records       # ring covers the window: silent
+
+
+def test_broken_sentinel_never_strands_a_batch():
+    """The runtime's perf hooks are guarded: an evaluation bug degrades
+    observability, never a request."""
+    class ExplodingSentinel:
+        def observe(self, *a, **k):
+            raise RuntimeError("boom")
+
+        def observe_batch(self, *a, **k):
+            raise RuntimeError("boom")
+
+        def maybe_tick(self):
+            raise RuntimeError("boom")
+
+    clock = FakeClock()
+    cfg = ServeConfig(buckets=(4,), max_linger_s=0.0, clock=clock,
+                      manual=True, perf=ExplodingSentinel())
+    rt = ServeRuntime(graph=None, config=cfg, executor=FakeExecutor())
+    fut = rt.submit_bfs(1)
+    rt.step(drain=True)
+    assert fut.result(timeout=0).kind == "bfs"   # resolved, not stranded
+    rt.close()
+
+
+def test_seed_baseline_flags_mixed_backends(tmp_path):
+    (tmp_path / "BENCH_C6_tpu.json").write_text(json.dumps({
+        "schema_version": 2, "tag": "tpu", "backend": "tpu",
+        "recorded_unix": 200, "git_rev": "x",
+        "c6_serving": {"batched_vs_unbatched": 9.0, "latency_ms_p50": 2.0,
+                       "latency_ms_p99": 4.0, "served_qps": 50000.0},
+    }))
+    (tmp_path / "BENCH_C9_smoke.json").write_text(json.dumps({
+        "schema_version": 1, "tag": "smoke", "backend": "cpu",
+        "recorded_unix": 100,
+        "c9_value_index": {"latency_ms_p50": 5.0, "latency_ms_p99": 8.0,
+                           "served_qps": 900.0},
+    }))
+    rec = seed_baseline(str(tmp_path))
+    assert rec["backend"] == "mixed"            # loud, not masqueraded
+    assert rec["lanes"]["bfs"]["backend"] == "tpu"
+    assert rec["lanes"]["range"]["backend"] == "cpu"
+
+
+def test_concurrent_alert_edges_open_one_profile_session(tmp_path):
+    """Two lanes firing in the same evaluation reserve ONE bounded
+    session (check-and-reserve is atomic; a racing loser must not leak
+    an unclosed profiler)."""
+    baseline = dict(BASELINE, lanes={
+        "bfs": {"p50_s": 0.01, "p99_s": 0.02},
+        "range": {"p50_s": 0.01, "p99_s": 0.02},
+    })
+    sen, clock, flight, profiler = make_sentinel(tmp_path,
+                                                 baseline=baseline)
+    for _ in range(25):
+        clock.advance(1.0)
+        sen.observe("bfs", 0.01)
+        sen.observe("range", 0.01)
+        sen.tick()
+    for _ in range(20):
+        clock.advance(1.0)
+        sen.observe("bfs", 0.2)
+        sen.observe("range", 0.2)
+        sen.tick()
+    assert flight.incidents == 2                 # both lanes fired...
+    opens = [e for e in profiler.events if e[0] == "open"]
+    closes = [e for e in profiler.events if e[0] == "close"]
+    assert len(opens) == 1                       # ...one session opened
+    assert len(closes) == 1                      # ...and it was closed
+    sen.close()

@@ -9,7 +9,7 @@
 # toy scale asserting the device lane really dispatched, answered
 # identically to the host oracle (differential_equal), ran at least as
 # fast as the host value scan it replaces, and recorded its numbers to
-# BENCH_C9_smoke.json (schema_version 1).
+# BENCH_C9_smoke.json (the shared _record_bench envelope, schema v2).
 #
 # Sits beside lint.sh, verify.sh (the two ops/value_index entries gate
 # there), chaos.sh, obs.sh, perf.sh, replica.sh, join.sh, and shard.sh:

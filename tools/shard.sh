@@ -8,7 +8,7 @@
 # the c8_sharded bench on the forced 8-device CPU mesh, asserting the
 # sharded path really dispatched, answered bit-identically to the
 # single-chip path, and recorded its scaling curve to
-# BENCH_C8_smoke.json (schema_version 1).
+# BENCH_C8_smoke.json (the shared _record_bench envelope, schema v2).
 #
 # Sits beside lint.sh, verify.sh (the two ops/sharded_serving entries
 # gate there), chaos.sh, obs.sh, perf.sh, replica.sh, and join.sh: this
