@@ -194,7 +194,8 @@ class FaultRegistry:
 
     def armed(self) -> list[str]:
         with self._lock:
-            return sorted(self._points)
+            names = list(self._points)
+        return sorted(names)
 
 
 #: the process-wide registry every in-tree site binds at import — a

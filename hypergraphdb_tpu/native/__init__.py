@@ -41,7 +41,10 @@ def build(force: bool = False) -> str:
             "-o", tmp, SRC,
         ]
         try:
-            proc = subprocess.run(
+            # deliberate blocking-under-lock: the lock EXISTS to make
+            # concurrent callers wait for one compile instead of racing
+            # N g++ processes at the same output
+            proc = subprocess.run(  # hglint: disable=HG701
                 cmd, capture_output=True, text=True, timeout=300
             )
         except (OSError, subprocess.TimeoutExpired) as e:
@@ -50,7 +53,9 @@ def build(force: bool = False) -> str:
             raise NativeBuildError(
                 f"native build failed:\n{proc.stderr[-4000:]}"
             )
-        os.replace(tmp, SO)
+        # publish-under-the-same-hold: a waiter must observe the fresh
+        # .so the moment it acquires
+        os.replace(tmp, SO)  # hglint: disable=HG701
         return SO
 
 

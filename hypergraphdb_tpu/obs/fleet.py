@@ -263,7 +263,10 @@ class FleetCollector:
         sweeps WAITS for its turn (bounded by one sweep) rather than
         interleaving with it."""
         with self._poll_gate:
-            return self._poll_once()
+            # deliberate blocking-under-lock: the gate's whole job is to
+            # make a second caller WAIT for the in-progress sweep (which
+            # joins its scrape workers) rather than interleave with it
+            return self._poll_once()  # hglint: disable=HG702
 
     def _poll_once(self) -> dict:
         with self._lock:

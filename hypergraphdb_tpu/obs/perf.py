@@ -570,7 +570,7 @@ class PerfSentinel:
             if mutate:
                 self._last_eval = now
             lane_snaps: dict = {}
-            for kind in sorted(set(self._lanes) | set(base_lanes)):
+            for kind in set(self._lanes) | set(base_lanes):
                 lane = self._lanes.get(kind)
                 if lane is None:
                     lane = self._lanes[kind] = _Lane(self.max_samples)
@@ -578,10 +578,12 @@ class PerfSentinel:
                     lane, list(lane.samples), list(lane.batches),
                     len(lane.samples) == (lane.samples.maxlen or 0),
                 )
-        # phase 2 — window digests (the sorts) OUTSIDE the lock
+        # phase 2 — window digests (and ALL sorting, including the lane
+        # ordering itself) OUTSIDE the lock
         verdicts: list = []
         out_lanes: dict = {}
-        for kind, (lane, samples, batches, ring_full) in lane_snaps.items():
+        for kind in sorted(lane_snaps):
+            lane, samples, batches, ring_full = lane_snaps[kind]
             base = base_lanes.get(kind)
             limits = None
             if base is not None:
@@ -832,19 +834,25 @@ class PerfSentinel:
         ``FleetCollector.fleet_perf`` merges): which lanes are in
         violation, the watched set, total alerts, and the skew ratios.
         A pure read — never drives evaluation."""
+        # snapshot under the lock, sort/shape outside: /healthz must not
+        # queue the dispatch thread's observe() behind a digest
         with self._lock:
-            violating = sorted(k for k, ln in self._lanes.items()
-                               if ln.alerting)
-            if self._skew_alerting:
-                violating.append("skew")
-            return {
-                "violating": violating,
-                "watched": sorted(self.baseline.get("lanes") or ()),
-                "alerts_total": (sum(ln.alerts
-                                     for ln in self._lanes.values())
-                                 + self._skew_alerts),
-                "skew": ({k: d["ratio"] for k, d in self._skew.items()
-                          if isinstance(d, dict)}
-                         if self._skew else None),
-                "profile_open": self._profile is not None,
-            }
+            alerting = [k for k, ln in self._lanes.items() if ln.alerting]
+            skew_alerting = self._skew_alerting
+            watched = list(self.baseline.get("lanes") or ())
+            alerts_total = (sum(ln.alerts for ln in self._lanes.values())
+                            + self._skew_alerts)
+            skew = dict(self._skew) if self._skew else None
+            profile_open = self._profile is not None
+        violating = sorted(alerting)
+        if skew_alerting:
+            violating.append("skew")
+        return {
+            "violating": violating,
+            "watched": sorted(watched),
+            "alerts_total": alerts_total,
+            "skew": ({k: d["ratio"] for k, d in skew.items()
+                      if isinstance(d, dict)}
+                     if skew else None),
+            "profile_open": profile_open,
+        }

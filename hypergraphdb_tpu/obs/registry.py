@@ -172,16 +172,20 @@ class Histogram:
         for p in ps:
             if not 0.0 <= p <= 1.0:
                 raise ValueError(f"percentile {p} outside [0, 1]")
+        # one locked SNAPSHOT (the consistency contract), but the window
+        # sort itself runs outside the lock — a wide window must not
+        # stall concurrent observe() calls
         with self._lock:
-            if self._window:
-                lat = sorted(self._window)
-                return [
-                    lat[min(len(lat) - 1, int(round(p * (len(lat) - 1))))]
-                    for p in ps
-                ]
-            if not self._count:
-                return [None] * len(ps)
-            return [self._bucket_percentile_locked(p) for p in ps]
+            window = list(self._window) if self._window else None
+            if window is None:
+                if not self._count:
+                    return [None] * len(ps)
+                return [self._bucket_percentile_locked(p) for p in ps]
+        lat = sorted(window)
+        return [
+            lat[min(len(lat) - 1, int(round(p * (len(lat) - 1))))]
+            for p in ps
+        ]
 
     def _bucket_percentile_locked(self, p: float) -> float:
         rank = p * self._count
@@ -281,11 +285,13 @@ class Registry:
 
     def names(self) -> list[str]:
         with self._lock:
-            return sorted(self._metrics)
+            names = list(self._metrics)
+        return sorted(names)
 
     def instruments(self) -> list:
         with self._lock:
-            return [self._metrics[k] for k in sorted(self._metrics)]
+            metrics = dict(self._metrics)
+        return [metrics[k] for k in sorted(metrics)]
 
     def snapshot(self) -> dict:
         """{name: scalar | histogram summary} — the debug dump."""

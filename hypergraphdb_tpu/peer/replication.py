@@ -310,7 +310,10 @@ class SeenMap:
         with self._lock:
             ivs = self._ranges.setdefault(pid, [[0, 0]])
             ivs.append([lo, hi])
-            ivs.sort()
+            # the sort-and-merge must stay atomic with the read (interval
+            # invariant), and the list is bounded: it holds MERGED ranges,
+            # so after every _cover it collapses back to a handful
+            ivs.sort()  # hglint: disable=HG703
             merged = [ivs[0][:]]
             for a, b in ivs[1:]:
                 if a <= merged[-1][1] + 1:  # overlapping or adjacent

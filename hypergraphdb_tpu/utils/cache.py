@@ -129,7 +129,14 @@ class MemoryWarningSystem:
 
         def loop():
             while not self._stop.wait(self.interval_s):
-                self.check_now()
+                try:
+                    self.check_now()
+                except Exception:  # the watch must outlive a bad sweep
+                    import logging
+
+                    logging.getLogger("hypergraphdb_tpu.cache").warning(
+                        "memory watch sweep failed", exc_info=True
+                    )
 
         self._thread = threading.Thread(
             target=loop, name="hgdb-memwatch", daemon=True

@@ -1,0 +1,56 @@
+"""Seeded HG7xx hazards — blocking primitives, transitive blocking, and
+O(n) sorts, all while holding a registered lock."""
+
+import queue
+import threading
+import time
+
+lock = threading.Lock()
+state_lock = threading.Lock()
+cv = threading.Condition()
+jobs = queue.Queue()
+
+
+def heartbeat():
+    with lock:
+        time.sleep(0.5)  # HG701: sleep under the module lock
+
+
+def flush(sock, payload):
+    with lock:
+        sock.sendall(payload)  # HG701: socket send under the lock
+
+
+def drain_one():
+    with lock:
+        return jobs.get()  # HG701: bounded-queue get blocks under the lock
+
+
+def wait_holding_other():
+    with state_lock:
+        with cv:
+            cv.wait(1.0)  # HG701: state_lock stays held across the wait
+
+
+def _slow_helper():
+    time.sleep(0.1)
+
+
+def tick():
+    with lock:
+        _slow_helper()  # HG702: transitively reaches time.sleep
+
+
+class Ring:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._members = []
+        self._worker = threading.Thread(target=heartbeat, daemon=True)
+
+    def digest(self):
+        with self._lock:
+            return sorted(self._members)  # HG703: whole-ring sort held
+
+    def stop(self):
+        with self._lock:
+            self._worker.join()  # HG701: thread join under the lock

@@ -1,0 +1,55 @@
+"""Seeded HG8xx hazards — leaked threads/timers, an exception-edge
+resource leak, a racy check-then-act, an unsafe condition wait, an
+unguarded worker loop — plus a stale suppression for HG901."""
+
+import socket
+import threading
+
+_LIMIT = 8  # hglint: disable=HG402  <- stale: HG402 never fired here
+
+
+class Pumper:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._queue = []
+        self._running = True
+        self._thread = None
+
+    def start(self):
+        if self._thread is None:  # HG803: check-then-act without the lock
+            self._thread = threading.Thread(target=self._pump)  # HG801
+            self._thread.start()  # never joined, not daemon
+
+    def push(self, item, handler):
+        with self._cv:
+            self._queue.append((item, handler))
+            self._cv.notify()
+
+    def take(self):
+        with self._cv:
+            if not self._queue:
+                self._cv.wait()  # HG804: untimed wait outside a loop
+            return self._queue.pop(0)
+
+    def _pump(self):
+        while self._running:
+            item, handler = self.take()
+            handler(item)  # HG805: a raising handler strands the queue
+
+
+def probe(host):
+    sock = socket.create_connection((host, 80))
+    banner = sock.recv(64)  # HG802: a raising recv leaks the socket
+    sock.close()
+    return banner
+
+
+def fire_and_forget(fn):
+    t = threading.Thread(target=fn)  # HG801: local thread, never joined
+    t.start()
+
+
+def schedule(cb):
+    t = threading.Timer(5.0, cb)  # HG801: timer never cancelled/joined
+    t.start()

@@ -1,0 +1,57 @@
+"""Blocking-adjacent shapes hglint must NOT flag: snapshot-then-sort, a
+condition wait over its own lock, an audited ``*_locked`` leaf, a
+non-blocking queue op, a blocking target merely PASSED under a lock, and
+a pragma'd deliberate hold (the pragma is exercised, so HG901 stays
+quiet)."""
+
+import queue
+import threading
+import time
+
+lock = threading.Lock()
+events = queue.Queue()
+
+
+def digest(items):
+    with lock:
+        snap = list(items)
+    return sorted(snap)  # the sort runs OUTSIDE the lock
+
+
+def poll():
+    with lock:
+        return events.get(block=False)  # non-blocking get is fine
+
+
+def deliberate_pause():
+    with lock:
+        time.sleep(0.01)  # hglint: disable=HG701
+
+
+class Batcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._items = []
+        self._worker = None
+
+    def wait_items(self):
+        with self._lock:
+            while not self._items:
+                self._cv.wait()  # releases its OWN lock while waiting
+            return self._items.pop(0)
+
+    def _write_metric_locked(self, value):
+        self._items.append(value)  # audited caller-holds-the-lock leaf
+
+    def record(self, value):
+        with self._lock:
+            self._write_metric_locked(value)
+
+    def spawn(self):
+        with self._lock:
+            self._worker = threading.Thread(target=time.sleep, daemon=True)
+        # a blocking TARGET handed to a thread does not run under the
+        # caller's hold — only the ctor call happened there
+        self._worker.start()
+        self._worker.join()

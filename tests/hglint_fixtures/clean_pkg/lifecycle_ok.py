@@ -1,0 +1,120 @@
+"""Lifecycle shapes hglint must NOT flag: double-checked locking, daemon
+and joined threads, a cancelled timer, finally/with-managed resources,
+timed parks, predicate-loop waits, guarded worker loops, and threads
+that escape to a caller who owns the join."""
+
+import socket
+import threading
+
+
+def _noop():
+    return None
+
+
+class Launcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = None
+
+    def start(self):
+        if self._thread is None:  # benign: the ACT is under the lock
+            with self._lock:
+                if self._thread is None:
+                    self._thread = threading.Thread(
+                        target=_noop, daemon=True
+                    )
+                    self._thread.start()
+
+    def stop(self):
+        if self._thread is not None:  # check-then-JOIN races harmlessly
+            self._thread.join()
+
+
+class Pump:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._queue = []
+        self._running = True
+        self._thread = None
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._run)
+            self._thread.start()
+
+    def stop(self):
+        with self._cv:
+            self._running = False
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join()  # join-reachable from the stop path
+
+    def submit(self, item):
+        with self._cv:
+            self._queue.append(item)
+            self._cv.notify()
+
+    def park(self, timeout):
+        with self._cv:
+            self._cv.wait(timeout)  # timed park: the caller re-checks
+
+    def _run(self):
+        while True:
+            with self._cv:
+                while self._running and not self._queue:
+                    self._cv.wait()  # predicate re-check loop
+                if not self._running:
+                    return
+                item = self._queue.pop(0)
+            try:
+                _handle(item)
+            except Exception:  # a bad item must not kill the pump
+                continue
+
+
+def _handle(item):
+    return item
+
+
+class Ticker:
+    def __init__(self):
+        self._timer = None
+
+    def arm(self, cb):
+        self._timer = threading.Timer(1.0, cb)
+        self._timer.start()
+
+    def disarm(self):
+        if self._timer is not None:
+            self._timer.cancel()  # cancel-reachable: no leak
+
+
+def fetch(host):
+    sock = socket.create_connection((host, 80))
+    try:
+        return sock.recv(64)
+    finally:
+        sock.close()  # closed on the exception edge
+
+
+def fetch_managed(host):
+    with socket.create_connection((host, 80)) as sock:
+        return sock.recv(64)
+
+
+def ping(host):
+    sock = socket.create_connection((host, 80))
+    sock.close()  # nothing risky in between: straight-line close is fine
+    return True
+
+
+def spawn_daemon():
+    t = threading.Thread(target=_handle, daemon=True)
+    t.start()
+
+
+def spawn_tracked(registry):
+    t = threading.Thread(target=_handle)
+    t.start()
+    registry.append(t)  # escapes: the registry's owner joins it
+    return t

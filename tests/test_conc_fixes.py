@@ -1,0 +1,122 @@
+"""Behavior pins for the concurrency fixes the hgconc sweep forced.
+
+Every HG7xx/HG8xx finding on the real tree was FIXED (not baselined) —
+mostly by restructuring hot read paths to snapshot-under-lock /
+sort-outside, and by guarding the memory-watch worker loop. These tests
+pin the observable contracts of the restructured code so a future edit
+can't quietly revert a fix while the analyzer happens to stay green.
+"""
+
+import threading
+import time
+
+from hypergraphdb_tpu.fault.registry import FaultRegistry
+from hypergraphdb_tpu.obs.registry import Histogram, Registry
+from hypergraphdb_tpu.utils.cache import MemoryWarningSystem
+
+
+# ------------------------------------------------- snapshot-then-sort reads
+
+
+def test_histogram_windowed_percentiles_stay_consistent_under_writes():
+    """percentiles() snapshots the window under the lock and sorts
+    OUTSIDE it — the result must still be one consistent cut (monotone
+    across the requested ps) even while another thread observes."""
+    h = Histogram("lat", window=512)
+    for v in (5.0, 1.0, 3.0, 2.0, 4.0):
+        h.observe(v)
+    stop = threading.Event()
+
+    def writer():
+        v = 0.0
+        while not stop.is_set():
+            v += 1.0
+            h.observe(v % 100.0)
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    try:
+        for _ in range(200):
+            p25, p50, p99 = h.percentiles((0.25, 0.5, 0.99))
+            assert p25 is not None
+            assert p25 <= p50 <= p99, "percentile cut tore across updates"
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
+def test_histogram_windowed_percentiles_match_oracle():
+    h = Histogram("lat", window=128)
+    vals = [float(v) for v in (9, 1, 8, 2, 7, 3, 6, 4, 5)]
+    for v in vals:
+        h.observe(v)
+    lat = sorted(vals)
+    got = h.percentiles((0.0, 0.5, 1.0))
+    assert got == [lat[0], lat[len(lat) // 2], lat[-1]]
+
+
+def test_registry_names_and_instruments_sorted_and_aligned():
+    reg = Registry("t")
+    reg.counter("zeta")
+    reg.gauge("alpha")
+    reg.histogram("mid")
+    assert reg.names() == ["alpha", "mid", "zeta"]
+    assert [m.name for m in reg.instruments()] == ["alpha", "mid", "zeta"]
+
+
+def test_fault_registry_armed_is_sorted():
+    f = FaultRegistry()
+    f.arm("z.point", times=1)
+    f.arm("a.point", times=1)
+    f.arm("m.point", times=1)
+    assert f.armed() == ["a.point", "m.point", "z.point"]
+
+
+def test_perf_sentinel_health_summary_is_a_pure_sorted_read():
+    from hypergraphdb_tpu.obs.perf import PerfSentinel
+
+    sen = PerfSentinel(baseline={"lanes": {"write": {}, "read": {}}})
+    out = sen.health_summary()
+    assert set(out) == {"violating", "watched", "alerts_total", "skew",
+                        "profile_open"}
+    assert out["violating"] == []
+    assert out["watched"] == sorted(out["watched"])
+    assert out["alerts_total"] == 0
+    # a pure read: calling it again changes nothing
+    assert sen.health_summary() == out
+
+
+# ------------------------------------------------- guarded worker loop
+
+
+def test_memwatch_thread_survives_a_raising_sweep():
+    """The memwatch loop guards check_now(): one bad sweep must not kill
+    the watcher (the HG805 fix in utils/cache.py)."""
+    ws = MemoryWarningSystem(threshold_bytes=1, interval_s=0.01)
+    sweeps = []
+    twice = threading.Event()
+
+    def boom():
+        sweeps.append(1)
+        if len(sweeps) >= 2:
+            twice.set()
+        raise RuntimeError("sweep bug")
+
+    ws.check_now = boom
+    ws.start()
+    try:
+        assert twice.wait(5.0), "watch thread died after the first raise"
+        assert ws._thread.is_alive()
+    finally:
+        ws.stop()
+    assert len(sweeps) >= 2
+
+
+def test_memwatch_stop_joins_the_thread():
+    ws = MemoryWarningSystem(threshold_bytes=0, interval_s=0.01)
+    ws.start()
+    t = ws._thread
+    time.sleep(0.03)
+    ws.stop()
+    assert ws._thread is None
+    assert not t.is_alive()

@@ -401,7 +401,7 @@ def test_only_typo_refuses_silent_green():
     # a prefix matching no rule must raise, not skip every runner and
     # report a clean run
     with pytest.raises(ValueError, match="matches no known rule"):
-        run_lint([str(FIXTURES / "bad_pkg")], only="HG7")
+        run_lint([str(FIXTURES / "bad_pkg")], only="HG0")
     with pytest.raises(ValueError, match="matches no known rule"):
         run_lint([str(FIXTURES / "bad_pkg")], only="hg5")  # case-sensitive
 

@@ -1,0 +1,314 @@
+"""Precision pins for the hgconc rule families (HG7xx blocking-under-lock,
+HG8xx thread/resource lifecycle, HG901 analyzer hygiene) plus the
+``--diff-base`` scoped-report lane and the README docs-drift gate.
+
+Three jobs:
+
+1. pin the seeded fixtures exactly — rule AND line — so a precision
+   regression in either direction (missed hazard, new false positive)
+   fails loudly;
+2. exercise the escape hatches (``*_locked`` leaves, used pragmas, the
+   HG901 stale-suppression audit's carve-outs) and the changed-files
+   report scoping;
+3. act as the zero-baseline gate: ``hypergraphdb_tpu`` must carry NO
+   HG7xx/HG8xx/HG9xx findings — concurrency hazards get fixed, not
+   baselined.
+"""
+
+import json
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.hglint import run_lint  # noqa: E402
+from tools.hglint.model import DOC_ANCHORS, RULES  # noqa: E402
+
+FIXTURES = Path(__file__).parent / "hglint_fixtures"
+
+
+def _pins(findings):
+    return sorted((f.rule, f.line) for f in findings)
+
+
+# ------------------------------------------------------- blocking fixtures
+
+
+def test_blocking_bad_exact_rule_and_line():
+    findings = run_lint([str(FIXTURES / "bad_pkg" / "blocking_bad.py")])
+    assert _pins(findings) == [
+        ("HG701", 16),   # time.sleep under the module lock
+        ("HG701", 21),   # sock.sendall under the lock
+        ("HG701", 26),   # Queue.get under the lock
+        ("HG701", 32),   # cv.wait while ANOTHER lock stays held
+        ("HG701", 56),   # Thread.join under the instance lock
+        ("HG702", 41),   # transitive: tick -> _slow_helper -> time.sleep
+        ("HG703", 52),   # sorted() under the instance lock
+    ], "\n".join(f.render() for f in findings)
+
+
+def test_blocking_transitive_names_the_witness_chain():
+    findings = run_lint([str(FIXTURES / "bad_pkg" / "blocking_bad.py")])
+    (hit,) = [f for f in findings if f.rule == "HG702"]
+    assert "_slow_helper" in hit.message
+    assert "time.sleep" in hit.message
+
+
+def test_blocking_clean_shapes_are_silent():
+    findings = run_lint([str(FIXTURES / "clean_pkg" / "blocking_ok.py")])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# ------------------------------------------------------ lifecycle fixtures
+
+
+def test_lifecycle_bad_exact_rule_and_line():
+    findings = run_lint([str(FIXTURES / "bad_pkg" / "lifecycle_bad.py")])
+    assert _pins(findings) == [
+        ("HG402", 21),   # the racy assign is ALSO an unlocked mutation
+        ("HG801", 21),   # worker thread never joined, not daemon
+        ("HG801", 49),   # fire-and-forget local thread
+        ("HG801", 54),   # timer never cancelled
+        ("HG802", 42),   # raising recv leaks the socket
+        ("HG803", 20),   # check-then-act start() without the lock
+        ("HG804", 32),   # untimed cv.wait outside a predicate loop
+        ("HG805", 37),   # raising handler kills the pump loop
+        ("HG901", 8),    # stale disable=HG402 on a bare constant
+    ], "\n".join(f.render() for f in findings)
+
+
+def test_lifecycle_clean_shapes_are_silent():
+    findings = run_lint([str(FIXTURES / "clean_pkg" / "lifecycle_ok.py")])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# ------------------------------------------------- HG901 suppression audit
+
+
+def _pkg(tmp_path, src):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "m.py").write_text(src)
+    return pkg
+
+
+_STALE = "import threading\n\n_CAP = 4  # hglint: disable=HG402\n"
+
+_HAZARD_PLUS_STALE = (
+    "import jax\n\n\n"
+    "@jax.jit\n"
+    "def f(x):\n"
+    "    return x.item()\n\n\n"
+    "_CAP = 4  # hglint: disable=HG402\n"
+)
+
+
+def test_stale_pragma_fires_hg901(tmp_path):
+    findings = run_lint([str(_pkg(tmp_path, _STALE))])
+    assert [(f.rule, f.line) for f in findings] == [("HG901", 3)]
+    assert "stale suppression" in findings[0].message
+    assert "disable=HG402" in findings[0].message
+
+
+def test_used_pragma_is_not_stale(tmp_path):
+    pkg = _pkg(tmp_path, (
+        "import jax\n\n\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x.item()  # hglint: disable=HG101\n"
+    ))
+    assert run_lint([str(pkg)]) == []
+
+
+def test_unknown_rule_id_is_not_audited(tmp_path):
+    # disable=HG999 names no rule: useless but not "stale" — HG901 only
+    # audits suppressions the analyzer could ever have honored
+    pkg = _pkg(tmp_path, "_CAP = 4  # hglint: disable=HG999\n")
+    assert run_lint([str(pkg)]) == []
+
+
+def test_scoped_run_skips_the_audit(tmp_path):
+    # `--only HG1` never ran HG402, so the pragma CAN'T be judged stale —
+    # a scoped run must not spray HG901 noise
+    pkg = _pkg(tmp_path, _HAZARD_PLUS_STALE)
+    findings = run_lint([str(pkg)], only="HG1")
+    assert [f.rule for f in findings] == ["HG101"]
+
+
+def test_only_hg9_still_audits(tmp_path):
+    # `--only HG9` has no runner of its own: every family runs for audit
+    # material, but only the HG901 verdicts are reported
+    pkg = _pkg(tmp_path, _HAZARD_PLUS_STALE)
+    findings = run_lint([str(pkg)], only="HG9")
+    assert [(f.rule, f.line) for f in findings] == [("HG901", 9)]
+
+
+def test_disable_hg901_silences_the_audit(tmp_path):
+    pkg = _pkg(tmp_path,
+               "_CAP = 4  # hglint: disable=HG402,HG901\n")
+    assert run_lint([str(pkg)]) == []
+
+
+# --------------------------------------------------- changed-files scoping
+
+
+_HOT_BAD = (
+    "import threading\n\n"
+    "lock = threading.Lock()\n\n\n"
+    "def spin():\n"
+    "    import time\n"
+    "    with lock:\n"
+    "        time.sleep(1)\n"
+)
+
+
+def test_run_lint_changed_files_scopes_the_report(tmp_path, monkeypatch):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "stable.py").write_text(
+        "import socket\n\n\n"
+        "def probe(host):\n"
+        "    s = socket.create_connection((host, 80))\n"
+        "    data = s.recv(8)\n"
+        "    s.close()\n"
+        "    return data\n"
+    )
+    (pkg / "hot.py").write_text(_HOT_BAD)
+    monkeypatch.chdir(tmp_path)
+    full = run_lint(["pkg"])
+    assert {f.path.replace("\\", "/") for f in full} == {
+        "pkg/stable.py", "pkg/hot.py",
+    }
+    scoped = run_lint(["pkg"], changed_files=["pkg/hot.py"])
+    assert scoped and all(
+        f.path.replace("\\", "/") == "pkg/hot.py" for f in scoped
+    )
+
+
+def _git(cwd, *argv):
+    out = subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t", *argv],
+        cwd=cwd, capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stderr
+    return out.stdout
+
+
+def test_cli_diff_base_reports_only_changed_files(tmp_path):
+    import os
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "stable.py").write_text(_HOT_BAD)       # pre-existing hazard
+    (pkg / "hot.py").write_text("VALUE = 1\n")
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+    (pkg / "hot.py").write_text(_HOT_BAD)          # the NEW hazard
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO)
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.hglint", "pkg",
+         "--diff-base", "HEAD", "--output", "json"],
+        cwd=tmp_path, capture_output=True, text=True, env=env,
+    )
+    assert out.returncode == 1, out.stderr
+    report = json.loads(out.stdout)
+    assert report["diff_base"] == "HEAD"
+    assert report["changed_files"] == ["pkg/hot.py"]
+    paths = {f["path"].replace("\\", "/") for f in report["findings"]}
+    assert paths == {"pkg/hot.py"}, "stable.py leaked into the scoped lane"
+
+    # the full run still sees the pre-existing hazard: scoping narrows
+    # the REPORT, never the analysis
+    full = subprocess.run(
+        [sys.executable, "-m", "tools.hglint", "pkg", "--json"],
+        cwd=tmp_path, capture_output=True, text=True, env=env,
+    )
+    full_paths = {f["path"].replace("\\", "/")
+                  for f in json.loads(full.stdout)}
+    assert full_paths == {"pkg/hot.py", "pkg/stable.py"}
+
+
+def test_cli_diff_base_usage_errors(tmp_path):
+    import os
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO)
+    # scoped run must never become the whole-tree baseline
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.hglint", "--diff-base", "HEAD",
+         "--write-baseline", str(tmp_path / "b.json")],
+        cwd=REPO, capture_output=True, text=True, env=env,
+    )
+    assert out.returncode == 2
+    assert "--write-baseline" in out.stderr
+    # a rev git can't resolve is a usage error (exit 2), not a crash (3)
+    bad = subprocess.run(
+        [sys.executable, "-m", "tools.hglint", "--diff-base",
+         "no-such-rev-xyz"],
+        cwd=REPO, capture_output=True, text=True, env=env,
+    )
+    assert bad.returncode == 2
+
+
+# ------------------------------------------------------- docs-drift gate
+
+
+def _heading_slug(text):
+    """GitHub-style anchor slug: lowercase, drop punctuation, spaces to
+    hyphens (`&` vanishes, leaving a double hyphen)."""
+    text = text.lower()
+    text = "".join(c for c in text if c.isalnum() or c in " -_")
+    return text.replace(" ", "-")
+
+
+def test_readme_documents_every_rule_and_vice_versa():
+    text = (REPO / "README.md").read_text()
+    row_re = re.compile(
+        r"^\|\s*\[[^\]]+\]\(#(hg\d[^)]*)\)\s*\|\s*"
+        r"(HG\d{3})(?:–(HG\d{3}))?\s*\|", re.M,
+    )
+    documented, row_anchors = set(), {}
+    for m in row_re.finditer(text):
+        anchor, lo, hi = m.group(1), m.group(2), m.group(3) or m.group(2)
+        for n in range(int(lo[2:]), int(hi[2:]) + 1):
+            documented.add(f"HG{n}")
+        row_anchors[lo[:3]] = anchor
+
+    missing = set(RULES) - documented
+    assert not missing, f"rules with no README table row: {sorted(missing)}"
+    phantom = documented - set(RULES)
+    assert not phantom, f"README table rows for unknown rules: {sorted(phantom)}"
+
+    # every family's table row links the anchor the diagnostics print...
+    assert row_anchors == DOC_ANCHORS
+
+    # ...and every anchor resolves to a real `### HGNxx:` section heading
+    headings = {
+        _heading_slug(m.group(1))
+        for m in re.finditer(r"^### (.+)$", text, re.M)
+    }
+    dangling = set(DOC_ANCHORS.values()) - headings
+    assert not dangling, f"anchors with no section heading: {sorted(dangling)}"
+
+
+# ------------------------------------------------------ zero-baseline gate
+
+
+def test_repo_carries_zero_concurrency_findings(monkeypatch):
+    """The hgconc acceptance bar: HG7xx/HG8xx/HG9xx hold a ZERO baseline
+    on the real tree — a new blocking-under-lock or lifecycle hazard (or
+    a suppression going stale) fails tier-1 outright, no baselining."""
+    monkeypatch.chdir(REPO)
+    findings = run_lint(["hypergraphdb_tpu"], only="HG7,HG8,HG9")
+    assert findings == [], (
+        "concurrency findings must be FIXED, not baselined:\n"
+        + "\n".join(f.render() for f in findings)
+    )

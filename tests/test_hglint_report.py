@@ -135,7 +135,7 @@ def test_lint_sh_reports_crash_distinctly(tmp_path):
 
 
 def test_cli_only_typo_is_usage_error():
-    out = _cli(str(FIXTURES / "clean_pkg"), "--only", "HG7")
+    out = _cli(str(FIXTURES / "clean_pkg"), "--only", "HG0")
     assert out.returncode == 2          # argparse usage error, not clean
     assert "matches no known rule" in out.stderr
 

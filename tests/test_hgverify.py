@@ -220,6 +220,27 @@ def test_concordance_runs_cleanly(production_run):
     assert concord_mod.render(table).startswith("hgverify concordance")
 
 
+def test_committed_concord_record_is_not_stale(production_run):
+    """The ROADMAP maintenance invariant: the committed concord record
+    (``tools/hgverify/concord.json``) must match a live re-mine — a PR
+    that adds a kernel with callbacks/collectives/donation has to re-run
+    ``python -m tools.hgverify --concord`` and commit the new record."""
+    findings, meta = production_run
+    record = json.loads(
+        (REPO / "tools" / "hgverify" / "concord.json").read_text()
+    )
+    live = concord_mod.concord(meta["traces"], findings,
+                               ["hypergraphdb_tpu"])
+    assert record["concordance"]["summary"] == live["summary"], (
+        "committed concord record is stale — re-run "
+        "`python -m tools.hgverify --concord --output json` and refresh "
+        "tools/hgverify/concord.json"
+    )
+    assert record["entries"]["traced"] == len(meta["traces"])
+    # zero AST-layer blind spots on the committed kernel surface
+    assert "hglint_false_negative" not in record["concordance"]["summary"]
+
+
 def test_report_shape_matches_hglint_envelope(production_run):
     findings, meta = production_run
     report = build_report(findings, meta)

@@ -315,10 +315,13 @@ def test_replication_lag_tracks_peer_head():
         pa.graph.add("lag-1")
         pa.graph.add("lag-2")
         assert pa.replication.flush()
+        # wait on the advertised head, not on lag == 0: lag reads 0
+        # vacuously while peer_heads has no entry yet (the push carrying
+        # the head may still be in flight on the apply thread)
+        assert wait_for(lambda: pb.replication.peer_heads.get("peer-a")
+                        == pa.replication.log.head)
         assert wait_for(lambda: pb.replication.replication_lag("peer-a")
                         == 0)
-        assert (pb.replication.peer_heads.get("peer-a")
-                == pa.replication.log.head)
     finally:
         pa.stop()
         pb.stop()

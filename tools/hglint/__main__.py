@@ -9,10 +9,32 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import traceback
 
 from tools.hglint import engine
+
+
+def _changed_files(rev: str) -> set:
+    """Files changed vs ``rev`` plus untracked files, as cwd-relative
+    paths (module paths in findings are cwd-relative too)."""
+    def git(*argv, cwd=None):
+        out = subprocess.run(
+            ["git", *argv], cwd=cwd, capture_output=True, text=True,
+        )
+        if out.returncode != 0:
+            raise RuntimeError(out.stderr.strip() or f"git {argv[0]} failed")
+        return out.stdout
+    top = git("rev-parse", "--show-toplevel").strip()
+    names = git("diff", "--name-only", rev, "--", cwd=top).splitlines()
+    names += git("ls-files", "--others", "--exclude-standard",
+                 cwd=top).splitlines()
+    return {
+        os.path.relpath(os.path.join(top, n))
+        for n in names if n.strip()
+    }
 
 
 def main(argv=None) -> int:
@@ -34,6 +56,12 @@ def main(argv=None) -> int:
                    help="comma-separated rule-id prefixes to run "
                         "(e.g. 'HG5' or 'HG5,HG601') — skips other rule "
                         "families entirely for fast local runs")
+    p.add_argument("--diff-base", metavar="REV", default=None,
+                   help="report only findings in files changed vs this "
+                        "git rev (plus untracked files); the WHOLE package "
+                        "is still analyzed so call-graph edges stay "
+                        "whole-program — this scopes the report, not the "
+                        "analysis")
     p.add_argument("--vmem-budget", metavar="BYTES", type=int, default=None,
                    help="per-core VMEM budget for HG501 "
                         "(default 16 MiB = 16777216)")
@@ -53,9 +81,21 @@ def main(argv=None) -> int:
     except ValueError as e:
         p.error(str(e))                # usage error: exit 2
 
+    if args.diff_base and args.write_baseline:
+        p.error("--diff-base cannot be combined with --write-baseline: a "
+                "scoped run must never become the whole-tree baseline")
+
+    changed = None
+    if args.diff_base:
+        try:
+            changed = _changed_files(args.diff_base)
+        except Exception as e:
+            p.error(f"--diff-base {args.diff_base!r}: {e}")
+
     try:
         findings = engine.run_lint(
-            args.paths, only=args.only, vmem_budget=args.vmem_budget
+            args.paths, only=args.only, vmem_budget=args.vmem_budget,
+            changed_files=changed,
         )
 
         if args.write_baseline:
@@ -86,7 +126,8 @@ def main(argv=None) -> int:
         print(json.dumps(engine.build_report(
             findings, args.paths, baseline_path=args.baseline,
             suppressed=suppressed, only=args.only,
-            vmem_budget=args.vmem_budget,
+            vmem_budget=args.vmem_budget, diff_base=args.diff_base,
+            changed_files=changed,
         ), indent=2))
     elif args.as_json:
         print(json.dumps(

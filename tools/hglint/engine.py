@@ -9,9 +9,11 @@ from collections import Counter, defaultdict
 
 from tools.hglint import (
     absint,
+    rules_blocking,
     rules_collectives,
     rules_donation,
     rules_hostsync,
+    rules_lifecycle,
     rules_locks,
     rules_pallas,
     rules_retrace,
@@ -22,7 +24,7 @@ from tools.hglint.loader import discover_modules
 from tools.hglint.model import RULES, Finding, doc_anchor, sort_findings
 
 BASELINE_VERSION = 1
-REPORT_VERSION = 2
+REPORT_VERSION = 3
 
 
 def _runners(cg, modules, interp, vmem_budget):
@@ -43,6 +45,10 @@ def _runners(cg, modules, interp, vmem_budget):
          lambda: rules_vmem.check(cg, modules, interp, vmem_budget)),
         (("HG601", "HG602", "HG603", "HG604"),
          lambda: rules_collectives.check(cg, modules, interp)),
+        (("HG701", "HG702", "HG703"),
+         lambda: rules_blocking.check(cg, modules)),
+        (("HG801", "HG802", "HG803", "HG804", "HG805"),
+         lambda: rules_lifecycle.check(cg, modules)),
     ]
 
 
@@ -65,13 +71,17 @@ def parse_only(only) -> tuple:
     return prefixes
 
 
-def run_lint(paths: list, only=None, vmem_budget: int = None) -> list:
+def run_lint(paths: list, only=None, vmem_budget: int = None,
+             changed_files=None) -> list:
     """Analyze every ``*.py`` under the given paths (analyzed together so
     cross-module call edges resolve) and return sorted findings.
 
     ``only`` restricts to rule-id prefixes (e.g. ``"HG5"`` or
     ``["HG5", "HG601"]``); ``vmem_budget`` overrides the default per-core
-    VMEM budget for HG501."""
+    VMEM budget for HG501; ``changed_files`` (an iterable of paths, from
+    ``--diff-base``) keeps only findings located in those files — the
+    whole package is still loaded and analyzed so interprocedural edges
+    (HG702 taint, HG401 cycles) stay whole-program."""
     modules = []
     for p in paths:
         modules.extend(discover_modules(p))
@@ -79,34 +89,87 @@ def run_lint(paths: list, only=None, vmem_budget: int = None) -> list:
     interp = absint.Interp(cg, modules)
     budget = vmem_budget or rules_vmem.DEFAULT_VMEM_BUDGET
     prefixes = parse_only(only)
+    # the HG901 stale-suppression audit needs the findings OTHER rules
+    # would have produced — when it's selected, every runner still runs
+    # (its findings are filtered back out below)
+    audit_on = not prefixes or any("HG901".startswith(p) for p in prefixes)
     findings = []
+    ran_rules: set = set()
     for rules, thunk in _runners(cg, modules, interp, budget):
-        if prefixes and not any(
+        if prefixes and not audit_on and not any(
             r.startswith(p) for p in prefixes for r in rules
         ):
             continue
+        ran_rules.update(rules)
         findings += thunk()
+    findings, used = _apply_pragmas(findings, modules)
+    if audit_on:
+        findings += _stale_pragmas(modules, ran_rules, used,
+                                   full_run=not prefixes)
     if prefixes:
         findings = [
             f for f in findings
             if any(f.rule.startswith(p) for p in prefixes)
         ]
-    findings = _apply_pragmas(findings, modules)
+    if changed_files is not None:
+        keep = {_slash(p) for p in changed_files}
+        findings = [f for f in findings if _slash(f.path) in keep]
     return sort_findings(findings)
 
 
-def _apply_pragmas(findings: list, modules: list) -> list:
+def _slash(path: str) -> str:
+    return os.path.normpath(path).replace(os.sep, "/")
+
+
+def _apply_pragmas(findings: list, modules: list) -> tuple:
     """Drop findings whose line carries ``# hglint: disable=<rule>``
-    (or ``disable=all``) in the module source."""
+    (or ``disable=all``) in the module source. Returns the kept findings
+    plus the set of exercised pragmas — ``(path, line, rule-or-"all")``
+    triples — which feeds the HG901 stale-suppression audit."""
     by_path = {m.path: m.pragmas for m in modules if m.pragmas}
+    used: set = set()
     if not by_path:
-        return findings
+        return findings, used
     out = []
     for f in findings:
         rules = by_path.get(f.path, {}).get(f.line, ())
-        if f.rule in rules or "all" in rules:
+        if f.rule in rules:
+            used.add((f.path, f.line, f.rule))
+            continue
+        if "all" in rules:
+            used.add((f.path, f.line, "all"))
             continue
         out.append(f)
+    return out, used
+
+
+def _stale_pragmas(modules: list, ran_rules: set, used: set,
+                   full_run: bool) -> list:
+    """HG901: a ``# hglint: disable=HGnnn`` whose rule no longer fires on
+    that line. Only rules that actually RAN this invocation are audited
+    (a scoped ``--only`` run can't prove an un-run rule's pragma dead);
+    ``disable=all`` is audited only on full runs for the same reason.
+    Unknown ids are ignored (they may name a future rule), and HG901
+    does not audit its own suppressions — an HG901 finding is silenced
+    only by an explicit ``disable=HG901`` on the pragma's line."""
+    out = []
+    for m in modules:
+        for line, rules in sorted(m.pragmas.items()):
+            if "HG901" in rules:
+                continue
+            for r in sorted(rules):
+                if r == "all":
+                    if not full_run or (m.path, line, "all") in used:
+                        continue
+                elif r == "HG901" or r not in RULES or r not in ran_rules \
+                        or (m.path, line, r) in used:
+                    continue
+                out.append(Finding(
+                    rule="HG901", path=m.path, line=line,
+                    message=f"stale suppression: `disable={r}` but {r} no "
+                            f"longer fires on this line — delete the "
+                            f"pragma (it would hide a future regression)",
+                ))
     return out
 
 
@@ -173,7 +236,8 @@ def finding_dict(f: Finding) -> dict:
 
 def build_report(findings: list, paths: list, *, baseline_path=None,
                  suppressed: int = 0, only=None,
-                 vmem_budget: int = None) -> dict:
+                 vmem_budget: int = None, diff_base=None,
+                 changed_files=None) -> dict:
     """Machine-readable run report for CI (``--output json``): stable
     envelope, per-rule/severity counts, findings with doc anchors."""
     by_rule = Counter(f.rule for f in findings)
@@ -183,6 +247,9 @@ def build_report(findings: list, paths: list, *, baseline_path=None,
         "report_version": REPORT_VERSION,
         "paths": list(paths),
         "only": list(parse_only(only)),
+        "diff_base": diff_base,
+        "changed_files": (sorted(_slash(p) for p in changed_files)
+                          if changed_files is not None else None),
         "vmem_budget_bytes": vmem_budget or rules_vmem.DEFAULT_VMEM_BUDGET,
         "baseline": {
             "path": baseline_path,

@@ -44,6 +44,23 @@ RULES = {
              "(divergent-program deadlock)",
     "HG603": "collective axis mismatch between shard_map caller and callee",
     "HG604": "lax.cond/switch branches carry mismatched collectives",
+    # -- family 7: blocking work under a held lock ----------------------------
+    "HG701": "blocking call while holding a lock (stalls every waiter)",
+    "HG702": "call while holding a lock transitively reaches a blocking "
+             "primitive",
+    "HG703": "O(n) work (sort) while holding a lock",
+    # -- family 8: thread / resource lifecycle --------------------------------
+    "HG801": "thread/timer started but neither daemon nor join/cancel-"
+             "reachable",
+    "HG802": "closeable resource not closed on the exception edge",
+    "HG803": "check-then-act lifecycle transition without a lifecycle lock",
+    "HG804": "Condition.wait outside a predicate re-check loop "
+             "(spurious wakeup unsafe)",
+    "HG805": "worker loop can exit on an unguarded exception, stranding "
+             "in-flight work",
+    # -- family 9: analyzer hygiene -------------------------------------------
+    "HG901": "stale `# hglint: disable` suppression — the named rule no "
+             "longer fires on that line",
 }
 
 #: rule id -> default severity
@@ -72,6 +89,15 @@ RULE_SEVERITY = {
     "HG602": "error",
     "HG603": "error",
     "HG604": "error",
+    "HG701": "error",
+    "HG702": "error",
+    "HG703": "warning",
+    "HG801": "error",
+    "HG802": "error",
+    "HG803": "warning",
+    "HG804": "error",
+    "HG805": "warning",
+    "HG901": "warning",
 }
 
 #: family prefix -> README.md section anchor (rule docs live there); HG106
@@ -83,6 +109,9 @@ DOC_ANCHORS = {
     "HG4": "hg4xx-lock-order",
     "HG5": "hg5xx-vmem-budgets",
     "HG6": "hg6xx-shard_map-collective-consistency",
+    "HG7": "hg7xx-blocking-under-lock",
+    "HG8": "hg8xx-thread--resource-lifecycle",
+    "HG9": "hg9xx-analyzer-hygiene",
 }
 
 

@@ -1,0 +1,395 @@
+"""HG7xx — blocking work while holding a lock.
+
+A lock held across a blocking call stalls every thread that needs the
+lock for as long as the call takes: the dispatch thread behind a sentinel
+digest, every submit behind a router health probe, the apply worker
+behind a peer send.  The reviews that shaped this family kept finding the
+same shapes by hand (digest sorts under the sentinel lock, health-probe
+timeouts stacking under the router lock) — this rule family finds them at
+lint time.
+
+Mechanics: a *blocking taint set* (``time.sleep``, socket/HTTP sends,
+``Thread.join``, ``fsync``/``os.replace``, ``block_until_ready``/device
+syncs, bounded-queue get/put, ...) is seeded from direct calls, propagated
+backwards through the resolved call graph, and intersected with the
+held-lock contexts the HG4xx lock engine already tracks
+(``rules_locks.function_held_sites``).
+
+HG701  a direct blocking call while at least one registered lock is held.
+HG702  a call while holding a lock whose callee *transitively* reaches a
+       blocking primitive (the witness chain is named in the message).
+HG703  O(n) work (``sorted(...)`` / ``.sort()``) while holding a lock —
+       a whole-ring sort under the hot-path lock is a stall, not a
+       deadlock, so this is a warning.
+
+Escape hatches (both kept honest elsewhere):
+
+- functions named ``*_locked`` are audited under-lock leaves (the HG402
+  naming contract): findings inside them are suppressed and they do not
+  propagate blocking taint to callers — the suffix is an audit marker for
+  leaf instrument writes, not a free pass for real sleeps;
+- ``# hglint: disable=HG70x`` on the offending line, which the HG901
+  stale-suppression audit deletes the moment the rule stops firing.
+
+``Condition.wait`` releases the condition's *own* lock while waiting, so
+a wait on a condition constructed over lock L is not a hold of L — but
+every OTHER held lock stays held across the wait and is flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tools.hglint.callgraph import CallGraph, CallSite
+from tools.hglint.loader import resolve_fqn
+from tools.hglint.model import Finding
+from tools.hglint.rules_locks import _collect_locks, function_held_sites
+
+#: fully-qualified callables that block, no matter the receiver
+BLOCKING_FQNS = {
+    "time.sleep": "time.sleep",
+    "os.fsync": "os.fsync (disk barrier)",
+    "os.fdatasync": "os.fdatasync (disk barrier)",
+    "os.replace": "os.replace (durable rename)",
+    "select.select": "select.select",
+    "socket.create_connection": "socket.create_connection",
+    "urllib.request.urlopen": "urllib.request.urlopen (HTTP round trip)",
+    "subprocess.run": "subprocess.run",
+    "subprocess.call": "subprocess.call",
+    "subprocess.check_call": "subprocess.check_call",
+    "subprocess.check_output": "subprocess.check_output",
+    "jax.block_until_ready": "jax.block_until_ready (device sync)",
+    "jax.device_get": "jax.device_get (device sync)",
+}
+
+#: method names that block regardless of receiver type — names specific
+#: enough that a false receiver is vanishingly unlikely in this codebase
+BLOCKING_METHODS = {
+    "sendall": "socket send",
+    "recv": "socket receive",
+    "recv_into": "socket receive",
+    "recvfrom": "socket receive",
+    "accept": "socket accept",
+    "getresponse": "HTTP response wait",
+    "block_until_ready": "device sync",
+}
+
+#: ctor fqns used to type receiver slots for the receiver-restricted
+#: method rules (`.join` on threads, `.wait` on events/conditions,
+#: `.get`/`.put` on bounded queues)
+THREAD_CTORS = {"threading.Thread", "threading.Timer"}
+EVENT_CTORS = {"threading.Event", "threading.Barrier"}
+CONDITION_CTORS = {"threading.Condition"}
+QUEUE_CTORS = {"queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
+               "queue.SimpleQueue"}
+
+_SORT_MSG = ("move the sort outside the critical section (snapshot under "
+             "the lock, digest outside)")
+
+
+def check(cg: CallGraph, modules: list) -> list:
+    reg = _collect_locks(modules)
+    if not reg.kinds:
+        return []
+    slots = _SlotRegistry(cg, modules)
+    edges = _direct_call_edges(cg)
+    blocked = _propagate_blocking(cg, slots, edges)
+    findings = []
+    for key, sites in sorted(function_held_sites(cg, reg).items()):
+        fi = cg.functions[key]
+        if _is_locked_leaf(fi):
+            continue
+        for held, node in sites:
+            desc = _classify_blocking(node, fi, slots, held)
+            if desc is not None:
+                findings.append(_f("HG701", fi, node,
+                                   f"blocking {desc} while holding "
+                                   f"{_fmt_locks(held)}"))
+                continue
+            if _is_sort(node, fi):
+                findings.append(_f(
+                    "HG703", fi, node,
+                    f"`{_spelling(node.func)}` while holding "
+                    f"{_fmt_locks(held)} — {_SORT_MSG}",
+                ))
+                continue
+            callee = cg.resolve_callable(
+                node.func, CallSite(node=node, fn_key=fi.key, mod=fi.mod)
+            )
+            if callee is not None and callee in blocked:
+                chain = _witness_chain(callee, blocked)
+                findings.append(_f(
+                    "HG702", fi, node,
+                    f"`{_spelling(node.func)}` called while holding "
+                    f"{_fmt_locks(held)} reaches blocking "
+                    f"{blocked[callee][0]} (via {chain})",
+                ))
+    return findings
+
+
+# --------------------------------------------------------------- slot typing
+
+
+class _SlotRegistry:
+    """Types the receiver slots the receiver-restricted rules need:
+    thread/timer slots (``.join`` blocks), event/condition slots
+    (``.wait`` blocks), bounded-queue slots (``.get``/``.put`` block).
+    Slots are ``mod.Cls.attr`` for ``self.attr = ctor()``, ``mod.name``
+    for module-level, plus per-function locals."""
+
+    def __init__(self, cg: CallGraph, modules: list):
+        self.kinds: dict = {}        # slot id -> "thread"|"event"|...
+        self.cond_locks: dict = {}   # condition slot id -> bound lock id
+        self._locals: dict = {}      # fn key -> {name: kind}
+        self._local_cond_locks: dict = {}  # (fn key, name) -> lock id
+        for mod in modules:
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Assign) or \
+                        not isinstance(node.value, ast.Call):
+                    continue
+                kind = _ctor_kind(node.value, mod)
+                if kind is None:
+                    continue
+                for tgt in node.targets:
+                    slot = _slot_id(tgt, mod)
+                    if slot is None:
+                        continue
+                    self.kinds[slot] = kind
+                    if kind == "condition":
+                        lk = _condition_lock(node.value, mod)
+                        if lk is not None:
+                            self.cond_locks[slot] = lk
+        for key, fi in cg.functions.items():
+            loc: dict = {}
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call) and \
+                        len(node.targets) == 1 and \
+                        isinstance(node.targets[0], ast.Name):
+                    kind = _ctor_kind(node.value, fi.mod)
+                    if kind is not None:
+                        name = node.targets[0].id
+                        loc[name] = kind
+                        if kind == "condition":
+                            lk = _condition_lock(node.value, fi.mod)
+                            if lk is not None:
+                                self._local_cond_locks[(key, name)] = lk
+            if loc:
+                self._locals[key] = loc
+
+    def receiver_kind(self, expr: ast.AST, fi) -> Optional[str]:
+        slot = self._receiver_slot(expr, fi)
+        if slot is None:
+            return None
+        if isinstance(slot, tuple):          # (fn key, local name)
+            return self._locals.get(slot[0], {}).get(slot[1])
+        return self.kinds.get(slot)
+
+    def condition_lock(self, expr: ast.AST, fi) -> Optional[str]:
+        slot = self._receiver_slot(expr, fi)
+        if isinstance(slot, tuple):
+            return self._local_cond_locks.get(slot)
+        if slot is not None:
+            return self.cond_locks.get(slot)
+        return None
+
+    def _receiver_slot(self, expr: ast.AST, fi):
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self" and fi.cls_name:
+            return f"{fi.mod.name}.{fi.cls_name}.{expr.attr}"
+        if isinstance(expr, ast.Name):
+            if expr.id in self._locals.get(fi.key, {}):
+                return (fi.key, expr.id)
+            return f"{fi.mod.name}.{expr.id}"
+        return None
+
+
+def _ctor_kind(call: ast.Call, mod) -> Optional[str]:
+    fqn = resolve_fqn(call.func, mod)
+    if fqn in THREAD_CTORS:
+        return "thread"
+    if fqn in EVENT_CTORS:
+        return "event"
+    if fqn in CONDITION_CTORS:
+        return "condition"
+    if fqn in QUEUE_CTORS:
+        return "queue"
+    return None
+
+
+def _condition_lock(call: ast.Call, mod) -> Optional[str]:
+    """``threading.Condition(self._lock)`` -> the wrapped lock's slot id
+    (resolved textually; precise enough for the wait carve-out)."""
+    args = list(call.args) + [k.value for k in call.keywords
+                              if k.arg in (None, "lock")]
+    for a in args:
+        if isinstance(a, ast.Attribute) and \
+                isinstance(a.value, ast.Name) and a.value.id == "self":
+            return a.attr          # matched by attr suffix against held ids
+        if isinstance(a, ast.Name):
+            return a.id
+    return None
+
+
+def _slot_id(tgt: ast.AST, mod) -> Optional[str]:
+    if isinstance(tgt, ast.Name):
+        return f"{mod.name}.{tgt.id}"
+    if isinstance(tgt, ast.Attribute) and \
+            isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
+        cls = _enclosing_class_of(mod, tgt)
+        if cls:
+            return f"{mod.name}.{cls}.{tgt.attr}"
+    return None
+
+
+def _enclosing_class_of(mod, target: ast.AST) -> Optional[str]:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef):
+            if any(n is target for n in ast.walk(node)):
+                return node.name
+    return None
+
+
+# ----------------------------------------------------------- classification
+
+
+def _classify_blocking(node: ast.Call, fi, slots: _SlotRegistry,
+                       held: tuple) -> Optional[str]:
+    """Human-readable description when this call blocks, else None."""
+    func = node.func
+    fqn = resolve_fqn(func, fi.mod)
+    if fqn in BLOCKING_FQNS:
+        return f"`{BLOCKING_FQNS[fqn]}`"
+    if not isinstance(func, ast.Attribute):
+        return None
+    attr = func.attr
+    if attr in BLOCKING_METHODS:
+        return (f"`{_spelling(func)}` ({BLOCKING_METHODS[attr]})")
+    kind = slots.receiver_kind(func.value, fi)
+    if attr == "join" and kind == "thread":
+        return f"`{_spelling(func)}` (thread join)"
+    if attr == "wait" and kind in ("event", "condition"):
+        if kind == "condition":
+            bound = slots.condition_lock(func.value, fi)
+            # waiting on a condition over lock L releases L — only OTHER
+            # held locks are held across the wait
+            others = [h for h in held
+                      if bound is None or not _lock_matches(h, bound)]
+            if not others:
+                return None
+        return f"`{_spelling(func)}` ({kind} wait)"
+    if kind == "queue" and attr in ("get", "put"):
+        if any(k.arg == "block" and
+               isinstance(k.value, ast.Constant) and k.value.value is False
+               for k in node.keywords):
+            return None
+        return f"`{_spelling(func)}` (queue {attr})"
+    return None
+
+
+def _lock_matches(lock_id: str, bound: str) -> bool:
+    return lock_id == bound or lock_id.rsplit(".", 1)[-1] == bound
+
+
+def _is_sort(node: ast.Call, fi) -> bool:
+    func = node.func
+    if isinstance(func, ast.Name) and func.id == "sorted":
+        return True
+    if isinstance(func, ast.Attribute) and func.attr == "sort" \
+            and not isinstance(func.value, ast.Constant):
+        return True
+    return False
+
+
+# -------------------------------------------------------------- propagation
+
+
+def _direct_call_edges(cg: CallGraph) -> dict:
+    """fn key -> callees invoked by NAME (``f(...)``) — deliberately
+    narrower than ``cg.edges``: a function merely *passed* as an argument
+    (a Thread target, a lax.fori_loop body) runs later, not under the
+    caller's hold, so it must not feed blocking taint back."""
+    edges: dict = {}
+    for site in cg.calls:
+        if site.fn_key is None:
+            continue
+        callee = cg.resolve_callable(site.node.func, site)
+        if callee is not None:
+            edges.setdefault(site.fn_key, set()).add(callee)
+    return edges
+
+
+def _propagate_blocking(cg: CallGraph, slots: _SlotRegistry,
+                        edges: dict) -> dict:
+    """fn key -> (primitive description, next hop key or None) for every
+    function that directly or transitively blocks. ``*_locked`` leaves are
+    excluded as sources (the audited escape hatch)."""
+    blocked: dict = {}
+    for key, fi in cg.functions.items():
+        if _is_locked_leaf(fi):
+            continue
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call):
+                # the sentinel held set makes condition waits count as
+                # blocking sources: from a caller's hold of any OTHER
+                # lock, a helper's cv.wait() is a real stall
+                desc = _classify_blocking(node, fi, slots,
+                                          held=("<caller-held>",))
+                if desc is not None:
+                    blocked[key] = (desc, None)
+                    break
+    rev: dict = {}
+    for caller, callees in edges.items():
+        for c in callees:
+            rev.setdefault(c, set()).add(caller)
+    from collections import deque
+    q = deque(blocked)
+    while q:
+        callee = q.popleft()
+        for caller in rev.get(callee, ()):
+            fi = cg.functions.get(caller)
+            if caller not in blocked and fi is not None and \
+                    not _is_locked_leaf(fi):
+                blocked[caller] = (blocked[callee][0], callee)
+                q.append(caller)
+    return blocked
+
+
+def _witness_chain(key: str, blocked: dict, limit: int = 4) -> str:
+    names = [_short(key)]
+    cur = key
+    while blocked.get(cur, (None, None))[1] is not None and \
+            len(names) < limit:
+        cur = blocked[cur][1]
+        names.append(_short(cur))
+    return " -> ".join(names)
+
+
+# ------------------------------------------------------------------ helpers
+
+
+def _is_locked_leaf(fi) -> bool:
+    return fi.qualpath.rsplit(".", 1)[-1].endswith("_locked")
+
+
+def _fmt_locks(held: tuple) -> str:
+    return " + ".join(f"`{h}`" for h in held)
+
+
+def _spelling(func: ast.AST) -> str:
+    try:
+        return ast.unparse(func)
+    except Exception:  # pragma: no cover
+        return "<call>"
+
+
+def _short(key: str) -> str:
+    return key.rsplit(".", 1)[-1] if "." in key else key
+
+
+def _f(rule: str, fi, node: ast.AST, msg: str) -> Finding:
+    return Finding(rule=rule, path=fi.mod.path,
+                   line=getattr(node, "lineno", fi.lineno),
+                   message=msg, scope=fi.qualpath)

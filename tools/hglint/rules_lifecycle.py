@@ -1,0 +1,673 @@
+"""HG8xx — thread & resource lifecycle analysis.
+
+The distributed runtime is thread-heavy (dispatch thread, apply worker,
+activity ticker, router poll, perf sentinel) and review rounds kept
+hand-finding the same lifecycle bug classes: a leaked profiler session
+from a racing check-then-act, a `pump()` unwound by an unguarded hook
+stranding its tickets, fire-and-forget threads nothing ever joins.  This
+family checks the lifecycle contracts statically:
+
+HG801  every started ``threading.Thread``/``Timer`` must be daemon or
+       join/cancel-reachable (class slots: from *any* method — the
+       stop()/close() path; locals: in the same function unless the
+       thread object escapes).
+HG802  a function-local closeable resource (``x = ctor()`` ...
+       ``x.close()``) whose close is only on the straight-line path leaks
+       on the exception edge — close in a ``finally``/``with``.
+HG803  check-then-act on a lifecycle attribute (``if self._t is None:
+       self._t = Thread(...); self._t.start()``) outside any lock in a
+       lock-owning class — two racing starts leak a thread (the leaked
+       profiler-session shape).
+HG804  ``Condition.wait`` outside an enclosing loop — spurious wakeups
+       and stolen predicates require the while-recheck idiom
+       (``Event.wait`` is a latch and exempt).
+HG805  a thread-target worker loop whose body can exit through an
+       unguarded exception strands every in-flight future/ticket handed
+       to it — guard the body (or the loop) with a broad handler that
+       resolves them (the stranded-ticket shape).
+
+Escape hatches: ``# hglint: disable=HG80x`` on the line (audited by
+HG901), and the ``*_locked`` suffix exempts HG803 like every other
+caller-holds-the-lock contract.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tools.hglint.callgraph import CallGraph
+from tools.hglint.loader import resolve_fqn
+from tools.hglint.model import Finding
+from tools.hglint.rules_blocking import THREAD_CTORS, _SlotRegistry
+from tools.hglint.rules_locks import (
+    EXEMPT_METHODS,
+    _collect_locks,
+    _resolve_lock,
+)
+
+#: receiver methods that count as releasing/terminating a resource
+CLOSE_METHODS = {"close", "stop", "shutdown", "cancel", "terminate"}
+
+#: receiver methods that count as lifecycle transitions for HG803 —
+#: ``join`` is deliberately absent: joining twice (or a dead thread) is
+#: harmless, so check-then-join is not a race worth flagging
+LIFECYCLE_ACTS = {"start", "stop", "close", "cancel", "shutdown"}
+
+#: coordination calls a worker loop is EXPECTED to make between units of
+#: work — waiting, queue/deque/heap shuffling, logging, introspection.
+#: These don't raise in practice and flagging them would bury the real
+#: signal (an unguarded handler/launch call) under noise.
+_COORD_FUNCS = {
+    "len", "list", "dict", "set", "tuple", "min", "max", "sorted",
+    "int", "str", "float", "bool", "repr", "getattr", "hasattr",
+    "isinstance", "enumerate", "zip", "range", "id", "hash", "print",
+}
+_COORD_METHODS = {
+    "wait", "wait_for", "notify", "notify_all", "acquire", "release",
+    "append", "appendleft", "pop", "popleft", "popitem", "add",
+    "discard", "remove", "clear", "extend", "update", "setdefault",
+    "get", "put", "get_nowait", "put_nowait", "items", "keys", "values",
+    "heappush", "heappop", "is_set", "set", "is_alive",
+    "monotonic", "time", "perf_counter", "sleep",
+    "debug", "info", "warning", "error", "exception", "getLogger",
+}
+
+
+def check(cg: CallGraph, modules: list) -> list:
+    slots = _SlotRegistry(cg, modules)
+    reg = _collect_locks(modules)
+    findings = []
+    findings += _thread_lifecycle(cg)
+    findings += _resource_exception_edges(cg)
+    findings += _check_then_act(cg, reg)
+    findings += _condition_wait_loops(cg, slots)
+    findings += _worker_loops(cg)
+    return findings
+
+
+# ------------------------------------------------------------------- HG801
+
+
+def _thread_lifecycle(cg: CallGraph) -> list:
+    # class slots: (cls key, attr) -> state dict
+    cls_slots: dict = {}
+    for key, fi in cg.functions.items():
+        if fi.cls_name is None:
+            continue
+        cls_key = f"{fi.mod.name}.{fi.cls_name}"
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    attr = _self_attr(tgt)
+                    if attr is None:
+                        continue
+                    if isinstance(node.value, ast.Call):
+                        kind = _thread_ctor_kind(node.value, fi.mod)
+                        if kind is not None:
+                            st = cls_slots.setdefault(
+                                (cls_key, attr), _slot_state()
+                            )
+                            st["ctors"].append(
+                                (fi, node.lineno, kind,
+                                 _ctor_daemon(node.value))
+                            )
+                    # self.X.daemon = True
+                    if isinstance(tgt, ast.Attribute) and \
+                            tgt.attr == "daemon":
+                        inner = _self_attr(tgt.value)
+                        if inner is not None and not (
+                            isinstance(node.value, ast.Constant)
+                            and node.value.value is False
+                        ):
+                            cls_slots.setdefault(
+                                (cls_key, inner), _slot_state()
+                            )["daemon"] = True
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute):
+                attr = _self_attr(node.func.value)
+                if attr is None:
+                    continue
+                st = cls_slots.get((cls_key, attr))
+                if st is None:
+                    st = cls_slots.setdefault(
+                        (cls_key, attr), _slot_state()
+                    )
+                if node.func.attr == "start":
+                    st["started"] = True
+                elif node.func.attr == "join":
+                    st["joined"] = True
+                elif node.func.attr == "cancel":
+                    st["cancelled"] = True
+
+    findings = []
+    for (cls_key, attr), st in sorted(cls_slots.items()):
+        if not st["ctors"] or not st["started"]:
+            continue
+        daemon = st["daemon"] or any(d for (_, _, _, d) in st["ctors"])
+        kind = st["ctors"][0][2]
+        ok = daemon or st["joined"] or \
+            (kind == "timer" and st["cancelled"])
+        if ok:
+            continue
+        fi, line, kind, _ = st["ctors"][0]
+        fix = "cancel/join it" if kind == "timer" else "join it"
+        findings.append(Finding(
+            rule="HG801", path=fi.mod.path, line=line, scope=fi.qualpath,
+            message=f"{kind} `self.{attr}` is started but neither daemon "
+                    f"nor join/cancel-reachable from any method of "
+                    f"`{cls_key.rsplit('.', 1)[-1]}` — a stop()/close() "
+                    f"path must {fix} (or mark daemon=True)",
+        ))
+
+    # function-local fire-and-forget threads
+    for key, fi in sorted(cg.functions.items()):
+        findings += _local_threads(fi)
+    return findings
+
+
+def _slot_state() -> dict:
+    return {"ctors": [], "started": False, "joined": False,
+            "cancelled": False, "daemon": False}
+
+
+def _local_threads(fi) -> list:
+    locals_: dict = {}   # name -> (line, kind, daemon)
+    state: dict = {}     # name -> {"started","joined","cancelled","escapes"}
+    for node in _own_scope(fi.node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                isinstance(node.value, ast.Call):
+            kind = _thread_ctor_kind(node.value, fi.mod)
+            if kind is not None:
+                name = node.targets[0].id
+                locals_[name] = (node.lineno, kind,
+                                 _ctor_daemon(node.value))
+                state[name] = {"started": False, "joined": False,
+                               "cancelled": False, "escapes": False}
+    if not locals_:
+        return []
+    safe_attrs = {"start", "join", "cancel", "daemon", "is_alive", "name",
+                  "ident", "setDaemon"}
+    parents = _parent_map(fi.node)
+    for node in _own_scope(fi.node):
+        if not isinstance(node, ast.Name) or node.id not in locals_:
+            continue
+        p = parents.get(id(node))
+        if isinstance(p, ast.Attribute) and p.value is node:
+            if p.attr not in safe_attrs:
+                state[node.id]["escapes"] = True
+            elif p.attr == "join":
+                state[node.id]["joined"] = True
+            elif p.attr == "cancel":
+                state[node.id]["cancelled"] = True
+            elif p.attr == "start":
+                state[node.id]["started"] = True
+        elif isinstance(p, ast.Assign) and node in p.targets:
+            pass  # (re)binding, not a use
+        elif isinstance(node.ctx, ast.Load):
+            # any other load — argument, return, container, comparison —
+            # lets the object escape this function's lifecycle view
+            state[node.id]["escapes"] = True
+    findings = []
+    for name, (line, kind, daemon) in sorted(locals_.items()):
+        st = state[name]
+        if not st["started"] or st["escapes"] or daemon:
+            continue
+        if st["joined"] or (kind == "timer" and st["cancelled"]):
+            continue
+        findings.append(Finding(
+            rule="HG801", path=fi.mod.path, line=line, scope=fi.qualpath,
+            message=f"local {kind} `{name}` is started here but never "
+                    f"joined (and not daemon) — a fire-and-forget "
+                    f"{kind} outlives every shutdown path",
+        ))
+    return findings
+
+
+def _thread_ctor_kind(call: ast.Call, mod) -> Optional[str]:
+    fqn = resolve_fqn(call.func, mod)
+    if fqn == "threading.Thread":
+        return "thread"
+    if fqn == "threading.Timer":
+        return "timer"
+    return None
+
+
+def _ctor_daemon(call: ast.Call) -> bool:
+    for k in call.keywords:
+        if k.arg == "daemon":
+            if isinstance(k.value, ast.Constant):
+                return bool(k.value.value)
+            return True   # computed daemon flag: assume the author chose
+    return False
+
+
+# ------------------------------------------------------------------- HG802
+
+
+def _resource_exception_edges(cg: CallGraph) -> list:
+    findings = []
+    for key, fi in sorted(cg.functions.items()):
+        acquires: dict = {}   # name -> (line, end_line, ctor spelling)
+        closes: dict = {}     # name -> [close Call nodes]
+        for node in _own_scope(fi.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call):
+                name = node.targets[0].id
+                if name not in acquires:
+                    acquires[name] = (
+                        node.lineno,
+                        getattr(node, "end_lineno", node.lineno),
+                        _spelling(node.value.func),
+                    )
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in CLOSE_METHODS and \
+                    isinstance(node.func.value, ast.Name):
+                closes.setdefault(node.func.value.id, []).append(node)
+        if not closes:
+            continue
+        protected_ids = _protected_node_ids(fi.node)
+        with_ctx = _with_context_names(fi.node)
+        for name, close_nodes in sorted(closes.items()):
+            if name not in acquires or name in with_ctx:
+                continue
+            if any(id(c) in protected_ids for c in close_nodes):
+                continue
+            line, end_line, ctor = acquires[name]
+            first_close = min(c.lineno for c in close_nodes)
+            risky = any(
+                isinstance(n, (ast.Call, ast.Raise, ast.Assert))
+                and end_line < getattr(n, "lineno", 0) < first_close
+                and not any(n is c or _contains(c, n)
+                            for c in close_nodes)
+                for n in _own_scope(fi.node)
+            )
+            if not risky:
+                continue
+            findings.append(Finding(
+                rule="HG802", path=fi.mod.path, line=line,
+                scope=fi.qualpath,
+                message=f"resource `{name}` = `{ctor}(...)` is closed at "
+                        f"line {first_close} only on the straight-line "
+                        f"path — an exception in between leaks it; close "
+                        f"in a finally (or use a with block)",
+            ))
+    return findings
+
+
+def _protected_node_ids(fn_node: ast.AST) -> set:
+    """ids of nodes inside any try ``finally`` or ``except`` body — a
+    close there runs on the exception edge."""
+    ids: set = set()
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Try):
+            for s in node.finalbody:
+                ids.update(id(n) for n in ast.walk(s))
+            for h in node.handlers:
+                for s in h.body:
+                    ids.update(id(n) for n in ast.walk(s))
+    return ids
+
+
+def _with_context_names(fn_node: ast.AST) -> set:
+    names: set = set()
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                ce = item.context_expr
+                if isinstance(ce, ast.Name):
+                    names.add(ce.id)
+                elif isinstance(ce, ast.Call):
+                    for a in ce.args:
+                        if isinstance(a, ast.Name):
+                            names.add(a.id)   # closing(x) / ExitStack(x)
+                if isinstance(item.optional_vars, ast.Name):
+                    names.add(item.optional_vars.id)
+    return names
+
+
+def _contains(outer: ast.AST, inner: ast.AST) -> bool:
+    return any(n is inner for n in ast.walk(outer))
+
+
+# ------------------------------------------------------------------- HG803
+
+
+def _check_then_act(cg: CallGraph, reg) -> list:
+    # lifecycle attrs per class: assigned a Thread/Timer ctor anywhere, or
+    # receiver of a .start() call
+    lifecycle: dict = {}   # cls key -> {attr}
+    for key, fi in cg.functions.items():
+        if fi.cls_name is None:
+            continue
+        cls_key = f"{fi.mod.name}.{fi.cls_name}"
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                for tgt in node.targets:
+                    attr = _self_attr(tgt)
+                    if attr and _thread_ctor_kind(node.value, fi.mod):
+                        lifecycle.setdefault(cls_key, set()).add(attr)
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "start":
+                attr = _self_attr(node.func.value)
+                if attr:
+                    lifecycle.setdefault(cls_key, set()).add(attr)
+
+    findings = []
+    for key, fi in sorted(cg.functions.items()):
+        if fi.cls_name is None:
+            continue
+        cls_key = f"{fi.mod.name}.{fi.cls_name}"
+        if cls_key not in reg.class_attrs:
+            continue   # no lifecycle lock exists; HG402 owns that story
+        attrs = lifecycle.get(cls_key)
+        if not attrs:
+            continue
+        method = fi.qualpath.rsplit(".", 1)[-1]
+        if method in EXEMPT_METHODS or method.endswith("_locked"):
+            continue
+        hits: list = []
+        _scan_cta(fi, fi.node, False, attrs, reg, hits)
+        for attr, line in hits:
+            findings.append(Finding(
+                rule="HG803", path=fi.mod.path, line=line,
+                scope=fi.qualpath,
+                message=f"check-then-act on lifecycle attribute "
+                        f"`self.{attr}` outside any lock — two racing "
+                        f"callers both pass the check and double-start / "
+                        f"double-stop; hold the lifecycle lock across "
+                        f"check and act",
+            ))
+    return findings
+
+
+def _scan_cta(fi, node, locked, attrs, reg, hits):
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef, ast.Lambda)) and node is not fi.node:
+        return
+    if isinstance(node, ast.With):
+        now_locked = locked or any(
+            _resolve_lock(item.context_expr, fi, reg) is not None
+            for item in node.items
+        )
+        for stmt in node.body:
+            _scan_cta(fi, stmt, now_locked, attrs, reg, hits)
+        return
+    if not locked and isinstance(node, ast.If):
+        tested = {a for n in ast.walk(node.test)
+                  if (a := _self_attr(n)) is not None} & attrs
+        if tested:
+            acted = _unlocked_acts(fi, node.body + node.orelse, attrs, reg)
+            for attr in sorted(tested & acted):
+                hits.append((attr, node.lineno))
+    for child in ast.iter_child_nodes(node):
+        _scan_cta(fi, child, locked, attrs, reg, hits)
+
+
+def _unlocked_acts(fi, stmts, attrs, reg) -> set:
+    """Lifecycle acts (start/stop/assign-thread) reached from ``stmts``
+    WITHOUT passing a lock — an act under a nested ``with lock`` is the
+    double-checked idiom and stays silent."""
+    acted: set = set()
+
+    def scan(node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            return
+        if isinstance(node, ast.With) and any(
+            _resolve_lock(item.context_expr, fi, reg) is not None
+            for item in node.items
+        ):
+            return   # locked region: safe by construction
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in LIFECYCLE_ACTS:
+            attr = _self_attr(node.func.value)
+            if attr in attrs:
+                acted.add(attr)
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                attr = _self_attr(tgt)
+                if attr in attrs and isinstance(node.value, ast.Call) and \
+                        _thread_ctor_kind(node.value, fi.mod):
+                    acted.add(attr)
+        for child in ast.iter_child_nodes(node):
+            scan(child)
+
+    for s in stmts:
+        scan(s)
+    return acted
+
+
+# ------------------------------------------------------------------- HG804
+
+
+def _condition_wait_loops(cg: CallGraph, slots) -> list:
+    findings = []
+    for key, fi in sorted(cg.functions.items()):
+        hits: list = []
+        _scan_waits(fi, fi.node, False, slots, hits)
+        for node in hits:
+            findings.append(Finding(
+                rule="HG804", path=fi.mod.path, line=node.lineno,
+                scope=fi.qualpath,
+                message=f"`{_spelling(node.func)}` outside a predicate "
+                        f"re-check loop — Condition.wait can wake "
+                        f"spuriously or lose the race for the predicate; "
+                        f"wrap it in `while not <predicate>:`",
+            ))
+    return findings
+
+
+def _scan_waits(fi, node, in_loop, slots, hits):
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef, ast.Lambda)) and node is not fi.node:
+        return
+    if isinstance(node, (ast.While, ast.For)):
+        for child in ast.iter_child_nodes(node):
+            _scan_waits(fi, child, True, slots, hits)
+        return
+    if isinstance(node, ast.Call) and \
+            isinstance(node.func, ast.Attribute) and \
+            node.func.attr == "wait" and not in_loop and \
+            not node.args and not node.keywords:
+        # only the UNTIMED wait: a timed `cv.wait(t)` outside a loop is a
+        # bounded park (the caller re-checks on return by contract); an
+        # untimed one outside a predicate loop hangs on a lost wakeup and
+        # mis-runs on a spurious one
+        if slots.receiver_kind(node.func.value, fi) == "condition":
+            hits.append(node)
+    for child in ast.iter_child_nodes(node):
+        _scan_waits(fi, child, in_loop, slots, hits)
+
+
+# ------------------------------------------------------------------- HG805
+
+
+def _worker_loops(cg: CallGraph) -> list:
+    targets = _thread_targets(cg)
+    if not targets:
+        return []
+    # workers = targets plus everything they reach by direct call from an
+    # UNGUARDED site (the loop often lives one helper down from the
+    # target, but a helper only ever invoked from inside a broad
+    # try/except can't kill the thread — its caller's guard absorbs it)
+    edges = _unguarded_call_edges(cg)
+    workers = set(targets)
+    stack = list(targets)
+    while stack:
+        k = stack.pop()
+        for c in edges.get(k, ()):
+            if c not in workers:
+                workers.add(c)
+                stack.append(c)
+    findings = []
+    seen: set = set()
+    for key in sorted(workers):
+        fi = cg.functions.get(key)
+        if fi is None:
+            continue
+        guarded = _broadly_guarded_ids(fi.node)
+        for node in _own_scope(fi.node):
+            if not isinstance(node, ast.While) or \
+                    not _main_loop_shape(node):
+                continue
+            if id(node) in guarded:
+                continue   # loop exit itself lands in a broad handler
+            bad = _first_unguarded_call(node, guarded)
+            if bad is None or (key, bad.lineno) in seen:
+                continue
+            seen.add((key, bad.lineno))
+            findings.append(Finding(
+                rule="HG805", path=fi.mod.path, line=bad.lineno,
+                scope=fi.qualpath,
+                message=f"worker loop in thread target `{fi.qualpath}` "
+                        f"can exit through an unguarded exception from "
+                        f"`{_spelling(bad.func)}` — in-flight "
+                        f"futures/tickets handed to this loop are "
+                        f"stranded; guard the loop body with a broad "
+                        f"except that resolves them",
+            ))
+    return findings
+
+
+def _unguarded_call_edges(cg: CallGraph) -> dict:
+    """Direct call edges whose call SITE is outside every broad
+    try/except of the caller — the edges an exception can actually
+    travel back across to kill a worker thread."""
+    guarded_by_fn: dict = {}
+    edges: dict = {}
+    for site in cg.calls:
+        if site.fn_key is None:
+            continue
+        fi = cg.functions.get(site.fn_key)
+        if fi is None:
+            continue
+        if site.fn_key not in guarded_by_fn:
+            guarded_by_fn[site.fn_key] = _broadly_guarded_ids(fi.node)
+        if id(site.node) in guarded_by_fn[site.fn_key]:
+            continue
+        callee = cg.resolve_callable(site.node.func, site)
+        if callee is not None:
+            edges.setdefault(site.fn_key, set()).add(callee)
+    return edges
+
+
+def _thread_targets(cg: CallGraph) -> set:
+    targets: set = set()
+    for site in cg.calls:
+        fqn = resolve_fqn(site.node.func, site.mod)
+        if fqn not in THREAD_CTORS:
+            continue
+        cands = [k.value for k in site.node.keywords
+                 if k.arg in ("target", "function")]
+        if fqn == "threading.Timer" and len(site.node.args) >= 2:
+            cands.append(site.node.args[1])
+        for c in cands:
+            k = cg.resolve_callable(c, site)
+            if k is not None:
+                targets.add(k)
+    return targets
+
+
+def _main_loop_shape(node: ast.While) -> bool:
+    """True for the service-loop shapes: ``while True`` and loops whose
+    test reads instance state (``while not self._closed``) — data-drain
+    loops (``while stack:``) are not lifecycle surfaces."""
+    t = node.test
+    if isinstance(t, ast.Constant) and t.value is True:
+        return True
+    if isinstance(t, ast.Compare):
+        return False   # `while len(q) > cap:` — bounded drain, not a loop
+    return any(_self_attr(n) is not None for n in ast.walk(t))
+
+
+def _broadly_guarded_ids(fn_node: ast.AST) -> set:
+    """ids of nodes inside a ``try`` body whose handlers include a broad
+    (bare / Exception / BaseException) except."""
+    ids: set = set()
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Try) and any(
+            _is_broad_handler(h) for h in node.handlers
+        ):
+            # the handlers and finally are the recovery path itself — a
+            # log call there re-raising is not the hazard this rule hunts
+            for s in (node.body + node.finalbody
+                      + [x for h in node.handlers for x in h.body]):
+                ids.update(id(n) for n in ast.walk(s))
+    return ids
+
+
+def _is_broad_handler(h: ast.ExceptHandler) -> bool:
+    if h.type is None:
+        return True
+    types = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+    for t in types:
+        name = t.attr if isinstance(t, ast.Attribute) else \
+            (t.id if isinstance(t, ast.Name) else None)
+        if name in ("Exception", "BaseException"):
+            return True
+    return False
+
+
+def _first_unguarded_call(loop: ast.While, guarded: set):
+    for stmt in loop.body:
+        for n in ast.walk(stmt):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            if isinstance(n, ast.Call) and id(n) not in guarded and \
+                    not _is_coordination(n):
+                return n
+    return None
+
+
+def _is_coordination(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id in _COORD_FUNCS
+    if isinstance(f, ast.Attribute):
+        return f.attr in _COORD_METHODS
+    return False
+
+
+# ------------------------------------------------------------------ helpers
+
+
+def _own_scope(fn_node: ast.AST):
+    """Descendants of a function node excluding nested def/class scopes."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _parent_map(fn_node: ast.AST) -> dict:
+    parents: dict = {}
+    for node in ast.walk(fn_node):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+    return parents
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _spelling(func: ast.AST) -> str:
+    try:
+        return ast.unparse(func)
+    except Exception:  # pragma: no cover
+        return "<call>"

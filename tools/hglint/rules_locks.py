@@ -156,7 +156,7 @@ def _lock_method_stmt(stmt: ast.AST, fi, reg, method: str):
 
 
 def _scan_stmts(cg, fi, stmts, held, direct, held_calls, held_acquires,
-                reg):
+                reg, held_sites=None):
     """Scan a statement list in order, tracking holds from BOTH ``with``
     blocks and bare ``X.acquire()`` statements (held until a matching
     ``X.release()`` in the same list, else to the end of it — the
@@ -177,14 +177,18 @@ def _scan_stmts(cg, fi, stmts, held, direct, held_calls, held_acquires,
                 cur.remove(lock)
             continue
         _scan_body(cg, fi, stmt, cur, direct, held_calls, held_acquires,
-                   reg)
+                   reg, held_sites)
 
 
-def _scan_body(cg, fi, node, held, direct, held_calls, held_acquires, reg):
+def _scan_body(cg, fi, node, held, direct, held_calls, held_acquires, reg,
+               held_sites=None):
     """Walk a function body tracking the held-lock stack. ``node`` itself is
     examined (so directly nested With/Call statements are seen), then its
     children; nested defs are skipped (they run later, not under the
-    current hold)."""
+    current hold). When ``held_sites`` is a list, every call made while at
+    least one registered lock is held is appended as
+    ``(held lock ids tuple, ast.Call)`` — the shared lock-context feed for
+    the HG7xx blocking-under-lock rules."""
     if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                          ast.ClassDef, ast.Lambda)) and node is not fi.node:
         return
@@ -201,7 +205,7 @@ def _scan_body(cg, fi, node, held, direct, held_calls, held_acquires, reg):
                     held_acquires.append((h, lock, site))
                 got.append(lock)
         _scan_stmts(cg, fi, node.body, held + got, direct, held_calls,
-                    held_acquires, reg)
+                    held_acquires, reg, held_sites)
         return
     if isinstance(node, ast.Call):
         # non-statement .acquire() (e.g. ``if lk.acquire(timeout=..)``):
@@ -215,6 +219,11 @@ def _scan_body(cg, fi, node, held, direct, held_calls, held_acquires, reg):
                 for h in held:
                     held_acquires.append((h, lock, site))
         elif held:
+            if held_sites is not None and not (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "release"
+            ):
+                held_sites.append((tuple(held), node))
             site_obj = CallSite(node=node, fn_key=fi.key, mod=fi.mod)
             callee = cg.resolve_callable(node.func, site_obj)
             if callee is not None:
@@ -229,17 +238,31 @@ def _scan_body(cg, fi, node, held, direct, held_calls, held_acquires, reg):
         if isinstance(stmts, list) and stmts and \
                 isinstance(stmts[0], ast.stmt):
             _scan_stmts(cg, fi, stmts, held, direct, held_calls,
-                        held_acquires, reg)
+                        held_acquires, reg, held_sites)
             handled.update(id(s) for s in stmts)
     for h in getattr(node, "handlers", ()) or ():
         _scan_stmts(cg, fi, h.body, held, direct, held_calls,
-                    held_acquires, reg)
+                    held_acquires, reg, held_sites)
         handled.update(id(s) for s in h.body)
     for child in ast.iter_child_nodes(node):
         if id(child) in handled or isinstance(child, ast.ExceptHandler):
             continue
         _scan_body(cg, fi, child, held, direct, held_calls, held_acquires,
-                   reg)
+                   reg, held_sites)
+
+
+def function_held_sites(cg: CallGraph, reg: LockRegistry) -> dict:
+    """Public lock-context feed: fn key -> ``[(held lock ids, ast.Call)]``
+    for every call issued while at least one registered lock is held.
+    Shared by the HG7xx blocking rules so hold tracking has exactly one
+    implementation."""
+    out: dict = {}
+    for key, fi in cg.functions.items():
+        sites: list = []
+        _scan_body(cg, fi, fi.node, [], set(), [], [], reg, sites)
+        if sites:
+            out[key] = sites
+    return out
 
 
 # ------------------------------------------------------------------- HG401

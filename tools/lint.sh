@@ -10,17 +10,51 @@
 # Every diagnostic carries its rule-family docs anchor
 # (e.g. "[README.md#hg5xx-vmem-budgets]") — see the README rule table.
 #
+# After a clean-enough run (exit < 2) the full machine-readable report is
+# written as a CI artifact to $HGLINT_REPORT (default
+# /tmp/hglint_report.json); skipped when the caller already picked an
+# output mode or is writing a baseline.
+#
 # Usage: tools/lint.sh [extra hglint args]
 #   tools/lint.sh --severity error     # only hard errors
 #   tools/lint.sh --only HG5           # one rule family, fast local run
 #   tools/lint.sh --output json        # machine-readable CI report
+#   tools/lint.sh --pre-commit         # fast lane: findings only in files
+#                                      # changed vs HEAD (analysis stays
+#                                      # whole-program)
 set -uo pipefail
 cd "$(dirname "$0")/.."
+
+report="${HGLINT_REPORT:-/tmp/hglint_report.json}"
+emit_artifact=1
+args=()
+for a in "$@"; do
+    case "$a" in
+        --pre-commit) args+=(--diff-base HEAD) ;;
+        --output|--output=*|--json|--write-baseline|--write-baseline=*)
+            emit_artifact=0; args+=("$a") ;;
+        *) args+=("$a") ;;
+    esac
+done
+
 python -m tools.hglint hypergraphdb_tpu \
-    --baseline tools/hglint/baseline.json "$@"
+    --baseline tools/hglint/baseline.json ${args[@]+"${args[@]}"}
 rc=$?
 if [ "$rc" -ge 2 ]; then
     echo "tools/lint.sh: hglint analyzer crashed (exit $rc);" \
          "fix the analyzer before trusting this gate" >&2
+    exit "$rc"
+fi
+
+if [ "$emit_artifact" -eq 1 ]; then
+    python -m tools.hglint hypergraphdb_tpu \
+        --baseline tools/hglint/baseline.json --output json \
+        ${args[@]+"${args[@]}"} > "$report"
+    arc=$?
+    if [ "$arc" -ge 2 ]; then
+        echo "tools/lint.sh: hglint crashed while writing the CI report" \
+             "(exit $arc); fix the analyzer before trusting this gate" >&2
+        exit "$arc"
+    fi
 fi
 exit "$rc"
