@@ -19,6 +19,7 @@ import heapq
 from collections import deque
 from typing import Callable, Iterator, Optional
 
+from hypergraphdb_tpu.core.errors import NotFoundError
 from hypergraphdb_tpu.core.handles import HGHandle
 
 LinkPredicate = Callable[["HyperGraph", HGHandle], bool]  # noqa: F821
@@ -189,8 +190,9 @@ class HyperTraversal:
             try:
                 for t in self.graph.get_targets(node):
                     neighbors.append((node, int(t)))
-            except Exception:
-                pass
+            except NotFoundError:
+                pass  # a plain atom in the frontier has no targets —
+                # anything ELSE (storage fault, evaluation bug) propagates
             for parent, nbr in neighbors:
                 if nbr in visited:
                     continue

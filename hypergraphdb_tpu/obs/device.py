@@ -100,5 +100,5 @@ def profile(logdir: Optional[str]):
         _PROFILING = False
         try:
             jax.profiler.stop_trace()
-        except Exception:  # a torn session must not mask the workload error
-            pass
+        except Exception:  # hglint: disable=HG1005
+            pass  # teardown: a torn session must not mask the workload error

@@ -72,7 +72,9 @@ def runtime_health(rt) -> HealthProbe:
             try:
                 payload["mesh"] = mesh_rep()
             except Exception:  # noqa: BLE001 - health must not 500 on it
-                pass
+                # a silently-missing field is indistinguishable from a
+                # single-chip node; name the torn enrichment instead
+                payload.setdefault("degraded", []).append("mesh")
         perf = getattr(rt, "perf", None)
         if perf is not None:
             # the hgperf sentinel's verdict (violating lanes, alerts,
@@ -82,7 +84,7 @@ def runtime_health(rt) -> HealthProbe:
             try:
                 payload["perf"] = perf.health_summary()
             except Exception:  # noqa: BLE001 - health must not 500 on it
-                pass
+                payload.setdefault("degraded", []).append("perf")
         healthy = (payload["accepting"]
                    and all(v != "open" for v in states.values()))
         return healthy, payload

@@ -247,7 +247,7 @@ def _import_record(graph, w: dict, mapping: dict[int, int]) -> Optional[int]:
             name = graph.typesystem.top.make(base64.b64decode(w["v"]))
             try:
                 mapping[w["h"]] = int(graph.typesystem.handle_of(name))
-            except Exception:
+            except Exception:  # hglint: disable=HG1005
                 pass  # type not registered at the destination; links to it
                 # (rare) will fail loudly at the mapping lookup
         return None

@@ -918,8 +918,9 @@ class Replication:
                 if self.peer.interface.send(pid, message):
                     self._down_until.pop(pid, None)
                     return True
-            except Exception:  # transport failure == unreachable now
-                pass
+            except Exception:  # hglint: disable=HG1005
+                pass  # transport failure == unreachable now; the loop's
+                # fall-through marks the peer down and counts send_failures
         self._down_until[pid] = time.monotonic() + self.down_peer_grace_s
         m.incr("peer.send_failures")
         return False
@@ -1108,7 +1109,8 @@ class Replication:
                 # no catchup-result will ever clear the mark — drop it
                 # so the next apply cycle re-triggers instead of wedging
                 self._gap_repairs.discard(sender)
-        except Exception:  # noqa: BLE001 - retried on the next cycle
+        except Exception:  # noqa: BLE001  # hglint: disable=HG1005
+            # retried on the next cycle; dropping the mark re-arms it
             self._gap_repairs.discard(sender)
 
     def anti_entropy(self, pid: str) -> None:
@@ -1274,7 +1276,7 @@ class Replication:
                 self.peer_acks[sender] = seq
             try:
                 self._maybe_truncate()
-            except Exception:
+            except Exception:  # hglint: disable=HG1005
                 # e.g. the drop transaction kept conflicting with a hot
                 # ingest loop — the push worker retries opportunistically
                 pass
@@ -1407,7 +1409,8 @@ class Replication:
                                 {"what": "ack", "seq": cur},
                             ))
                         except Exception:  # noqa: BLE001 - peer gone
-                            pass
+                            self.peer.graph.metrics.incr(
+                                "peer.ack_send_failures")
                     self._check_gap(sender)
                 # page-limited catch-up: pull the next page now that this
                 # one is applied and acknowledged
@@ -1415,7 +1418,8 @@ class Replication:
                     try:
                         self.catch_up(sender)
                     except Exception:  # noqa: BLE001 - peer may be gone
-                        pass
+                        self.peer.graph.metrics.incr(
+                            "peer.catch_up_failures")
             except Exception:
                 # belt-and-braces: anything unexpected is logged, the
                 # worker loop survives

@@ -518,7 +518,12 @@ class DeviceExecutor:
                 if self.config.join_factorized:
                     factorized_relations_device(self.mgr.base)
             except Exception:  # noqa: BLE001 - never block startup
-                pass
+                import logging
+
+                logging.getLogger("hypergraphdb_tpu.serve").warning(
+                    "join prewarm failed; first join dispatch builds the "
+                    "CSR cold", exc_info=True,
+                )
         range_dims = tuple(self.config.prewarm_range_dims or ())
         if range_dims:
             # the range lane's sorted columns (+ per-bucket executables
@@ -532,7 +537,13 @@ class DeviceExecutor:
                 try:
                     value_index_column(self.mgr.base, int(dim))
                 except Exception:  # noqa: BLE001 - never block startup
-                    pass
+                    import logging
+
+                    logging.getLogger("hypergraphdb_tpu.serve").warning(
+                        "range-column prewarm failed for dim %d; first "
+                        "range dispatch sorts it cold", int(dim),
+                        exc_info=True,
+                    )
         if self.aot is None and not (self.config.use_pallas_bfs
                                      and _pbfs.pallas_bfs_ok()):
             # nothing to warm: no cache to load, and the fused path (the
@@ -641,7 +652,13 @@ class DeviceExecutor:
                         {"max_hops": hops, "top_r": top_r},
                     )
                 except Exception:  # noqa: BLE001 - never block startup
-                    pass
+                    import logging
+
+                    logging.getLogger("hypergraphdb_tpu.serve").warning(
+                        "aot warm failed (bfs_serve_batch, hops=%d); "
+                        "first dispatch compiles cold", hops,
+                        exc_info=True,
+                    )
                 if fkw is None or fkw["overlay"] is not None:
                     continue
                 try:
@@ -1413,7 +1430,15 @@ class DeviceExecutor:
                             with self.tracer.span("join.factorize"):
                                 factorized_relations(base)
                         except Exception:  # noqa: BLE001 - flat serves
-                            pass
+                            import logging
+
+                            logging.getLogger(
+                                "hypergraphdb_tpu.serve"
+                            ).warning(
+                                "trie factorization failed; join plan "
+                                "serves from the flat CSRs",
+                                exc_info=True,
+                            )
             except JoinUnsupported:
                 cache[sig] = None
         return cache[sig]
@@ -1931,7 +1956,7 @@ class ServeRuntime:
                             t=self.clock(),
                         )
                     except Exception:  # noqa: BLE001
-                        pass
+                        self.stats.record_perf_error()
             for ticket, res in results:
                 tr = ticket.trace
                 if tr is None or tr.finished:
@@ -1967,7 +1992,7 @@ class ServeRuntime:
                                               now - ticket.submit_t,
                                               path=path, t=now)
                         except Exception:  # noqa: BLE001
-                            pass
+                            self.stats.record_perf_error()
         if self.perf is not None:
             # rate-limited drift evaluation rides the completion path —
             # the sentinel has no thread of its own. Guarded: an

@@ -82,6 +82,7 @@ DOTTED_NAMES = LANE_NAMES + (
     "serve.cancelled",
     "serve.errors",
     "serve.host_fallbacks",
+    "serve.perf_observe_errors",
     "serve.batches",
     "serve.device_dispatches",
     "serve.sharded_dispatches",
@@ -136,6 +137,7 @@ class ServeStats:
         self._sharded_dispatches = r.counter("serve.sharded_dispatches")
         self._range_dispatches = r.counter("serve.range_dispatches")
         self._retries = r.counter("serve.retries")
+        self._perf_errors = r.counter("serve.perf_observe_errors")
         self._join_hub = r.counter("serve.join.hub_dispatches")
         self._join_partial = r.counter("serve.join.partial_corrections")
         self._breaker_trips = r.counter("serve.breaker_trips")
@@ -165,7 +167,8 @@ class ServeStats:
             self._sharded_dispatches, self._range_dispatches,
             self._device_seconds,
             self._join_hub, self._join_partial,
-            self._retries, self._breaker_trips, self._breaker_state,
+            self._retries, self._perf_errors,
+            self._breaker_trips, self._breaker_state,
             self._lanes_real, self._lanes_padded, self._latency,
             self._queue_depth,
         )
@@ -226,6 +229,14 @@ class ServeStats:
         collect-failure host re-serve)."""
         with self._lock:
             self._retries.inc()
+
+    def record_perf_error(self) -> None:
+        """The hgperf sentinel's ``observe``/``observe_batch`` raised on
+        the completion path. The dispatch loop swallows it (a perf
+        evaluation bug must not fail the request) — this counter is the
+        evidence that observations are being dropped."""
+        with self._lock:
+            self._perf_errors.inc()
 
     def record_join_hub_dispatch(self, n_lanes: int = 1) -> None:
         """``n_lanes`` real join lanes dispatched through the

@@ -54,3 +54,33 @@ class Ring:
     def stop(self):
         with self._lock:
             self._worker.join()  # HG701: thread join under the lock
+
+
+# -- blocking taint smuggled through arguments and dispatch tables -------
+
+
+def run_probe(probe):
+    probe()
+
+
+def prober():
+    run_probe(_slow_helper)  # taint follows the smuggled argument
+
+
+def audit_all():
+    with lock:
+        prober()  # HG702: reaches time.sleep through an arg-passed edge
+
+
+def smuggle(registry):
+    with lock:
+        registry.apply(_slow_helper)  # HG702: blocking callable passed
+        # into an unresolvable receiver that runs it under this hold
+
+
+OPS = {"tick": _slow_helper, "noop": run_probe}
+
+
+def dispatch(kind):
+    with lock:
+        OPS[kind]()  # HG702: a table member reaches time.sleep

@@ -1,0 +1,97 @@
+"""Exception-flow discipline violations (HG10xx family).
+
+Each function below swallows, misdirects, or retries a failure in a way
+the interprocedural raise-set model can prove wrong. Expected findings
+are pinned by line in tests/test_hglint_exc.py.
+"""
+import threading
+
+from hypergraphdb_tpu.fault.errors import PermanentFault, TransientFault
+from hypergraphdb_tpu.fault.registry import FaultRegistry
+
+FAULTS = FaultRegistry()
+
+
+# -- HG1001: a broad handler that eats the drill's simulated kill --------
+
+
+def _arm_fault_point(batch):
+    FAULTS.check("ingest.pump", size=len(batch))
+    return batch
+
+
+def pump_once(batch, stats):
+    try:
+        return _arm_fault_point(batch)
+    except BaseException:   # HG1001: swallows InjectedCrash
+        stats.incr("pump.errors")
+        return None
+
+
+# -- HG1002: a typed fault handler over a body that cannot raise it ------
+
+
+def _decode(blob):
+    if not blob:
+        raise ValueError("empty frame")
+    return blob
+
+
+def parse_frame(blob):
+    try:
+        return _decode(blob)
+    except TransientFault:   # HG1002: _decode only raises ValueError
+        return None
+
+
+# -- HG1003 (explicit): retry loop that re-attempts a permanent fault ----
+
+
+def drain(inbox):
+    while True:
+        try:
+            return inbox.get_nowait()
+        except PermanentFault:   # HG1003: permanent -> retrying is futile
+            continue
+
+
+# -- HG1003 (inferred): broad retry over a provably-permanent raise ------
+
+
+def _submit_once(router, req):
+    if router is None:
+        raise PermanentFault("no route for shard")
+    return router.dispatch(req)
+
+
+def submit_with_retry(router, req):
+    for _ in range(3):
+        try:
+            return _submit_once(router, req)
+        except Exception:   # HG1003: PermanentFault arrives here
+            req.attempts += 1
+    return None
+
+
+# -- HG1004: a thread target whose body can raise straight through -------
+
+
+def crashy_worker(batch):
+    if not batch:
+        raise ValueError("empty ingest batch")
+    batch.clear()
+
+
+def spawn_ingest(batch):
+    return threading.Thread(target=crashy_worker, args=(batch,),
+                            daemon=True)
+
+
+# -- HG1005: swallow with no evidence at all -----------------------------
+
+
+def best_effort_flush(sink):
+    try:
+        sink.flush()
+    except Exception:   # HG1005: no re-raise, log, counter, or fallback
+        pass

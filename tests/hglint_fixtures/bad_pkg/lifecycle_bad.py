@@ -53,3 +53,18 @@ def fire_and_forget(fn):
 def schedule(cb):
     t = threading.Timer(5.0, cb)  # HG801: timer never cancelled/joined
     t.start()
+
+
+def accept_once(server):
+    conn, addr = server.accept()
+    banner = conn.recv(64)  # HG802: a raising recv leaks the accepted conn
+    conn.close()
+    return banner, addr
+
+
+class Channel:
+    def handshake(self, host):
+        self._sock = socket.create_connection((host, 80))
+        self._sock.sendall(b"HELLO\n")  # HG802: a raising send leaks it
+        self._sock.close()
+        self._sock = None

@@ -30,3 +30,21 @@ class Counter:
     def bump(self):
         with self._lock:
             self.value = self.value + 1
+
+
+class Journal:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = []
+
+    def _append_locked(self, entry):
+        self._entries.append(entry)
+
+    def append(self, entry):
+        with self._lock:
+            self._append_locked(entry)
+
+    def drain_fast(self):
+        out = list(self._entries)
+        self._append_locked(("drained", len(out)))  # HG403: no lock held
+        return out

@@ -55,3 +55,23 @@ class Batcher:
         # caller's hold — only the ctor call happened there
         self._worker.start()
         self._worker.join()
+
+
+# -- arg-flow shapes that must stay silent -------------------------------
+
+
+def _count(items):
+    return len(items)
+
+
+SAFE_OPS = {"count": _count}
+
+
+def apply_op(kind, items):
+    with lock:
+        return SAFE_OPS[kind](items)  # every table member is non-blocking
+
+
+def enqueue_probe(registry):
+    with lock:
+        registry.apply(_count)  # a NON-blocking callable smuggled in

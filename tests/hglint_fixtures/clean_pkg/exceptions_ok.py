@@ -1,0 +1,111 @@
+"""Disciplined twins of exceptions_bad.py — every HG10xx rule must stay
+silent on this module (and so must every other family)."""
+import threading
+
+from hypergraphdb_tpu.fault.errors import TransientFault, is_transient
+from hypergraphdb_tpu.fault.registry import FaultRegistry
+
+FAULTS = FaultRegistry()
+
+
+# -- kill-transparent broad handler (HG1001 silent: re-raises kills) -----
+
+
+def _arm_fault_point(batch):
+    FAULTS.check("ingest.pump", size=len(batch))
+    return batch
+
+
+def pump_once(batch, stats):
+    try:
+        return _arm_fault_point(batch)
+    except BaseException as err:
+        if not isinstance(err, Exception):
+            raise   # InjectedCrash / KeyboardInterrupt pass through
+        stats.incr("pump.errors")
+        return None
+
+
+# -- live typed fault handler (HG1002 silent: TransientFault arrives) ----
+
+
+def parse_frame(blob):
+    try:
+        return _arm_fault_point(blob)
+    except TransientFault:
+        return None
+
+
+# -- transient-only retry (HG1003 silent) --------------------------------
+
+
+def drain(inbox):
+    while True:
+        try:
+            return inbox.get_nowait()
+        except TransientFault:
+            continue
+
+
+# -- broad retry with a transience guard (HG1003 silent) -----------------
+
+
+def _submit_once(router, req):
+    if router is None:
+        raise TransientFault("route table still warming")
+    return router.dispatch(req)
+
+
+def submit_with_retry(router, req):
+    for _ in range(3):
+        try:
+            return _submit_once(router, req)
+        except Exception as err:
+            if not is_transient(err):
+                raise
+    return None
+
+
+# -- guarded thread targets (HG1004 silent) ------------------------------
+
+
+def _ingest(batch):
+    if not batch:
+        raise ValueError("empty ingest batch")
+    batch.clear()
+
+
+def guarded_worker(batch, stats):
+    try:
+        _ingest(batch)
+    except Exception:
+        stats.incr("ingest.errors")
+
+
+def drill_worker(stats):
+    # only InjectedCrash escapes the guard — by design, a simulated kill
+    # MUST take the thread down, so HG1004 exempts base-only escapes
+    try:
+        FAULTS.check("ingest.drill")
+    except Exception:
+        stats.incr("drill.faults")
+
+
+def spawn_ingest(batch, stats):
+    return threading.Thread(target=guarded_worker, args=(batch, stats),
+                            daemon=True)
+
+
+def spawn_drill(stats):
+    return threading.Thread(target=drill_worker, args=(stats,),
+                            daemon=True)
+
+
+# -- swallow with evidence (HG1005 silent) -------------------------------
+
+
+def best_effort_flush(sink, log):
+    try:
+        sink.flush()
+    except Exception:
+        log.warning("flush failed; next flush retries", exc_info=True)

@@ -118,3 +118,23 @@ def spawn_tracked(registry):
     t.start()
     registry.append(t)  # escapes: the registry's owner joins it
     return t
+
+
+def accept_once(server):
+    conn, addr = server.accept()
+    try:
+        return conn.recv(64), addr
+    finally:
+        conn.close()  # exception-edge close for the unpacked conn
+
+
+class Channel:
+    def __init__(self):
+        self._sock = None
+
+    def handshake(self, host):
+        self._sock = socket.create_connection((host, 80))
+        try:
+            self._sock.sendall(b"HELLO\n")
+        finally:
+            self._sock.close()

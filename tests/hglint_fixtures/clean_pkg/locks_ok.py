@@ -31,3 +31,10 @@ class Store:
     def reset_locked(self):
         # *_locked suffix documents the caller-holds-the-lock contract
         self._rev = 0
+
+    def reset(self):
+        with self._lock:
+            self.reset_locked()  # the hold satisfies the *_locked contract
+
+    def clear_locked(self):
+        self.reset_locked()  # *_locked -> *_locked: the contract chains

@@ -54,8 +54,8 @@ def test_bad_fixture_flags_every_family():
     assert {"HG201", "HG202", "HG203", "HG204"} <= rules
     # family 3: Pallas contracts
     assert {"HG301", "HG302", "HG303", "HG304"} <= rules
-    # family 4: lock order
-    assert {"HG401", "HG402"} <= rules
+    # family 4: lock order + contract discipline
+    assert {"HG401", "HG402", "HG403"} <= rules
     # family 5: VMEM budgets (incl. scalar-prefetch SMEM)
     assert {"HG501", "HG502", "HG503"} <= rules
     # family 6: shard_map collective consistency (incl. cond branches)
@@ -266,6 +266,16 @@ def test_lock_cycle_flagged():
 def test_clean_two_lock_module_not_flagged():
     findings = run_lint([str(FIXTURES / "clean_pkg" / "locks_ok.py")])
     assert [f for f in findings if f.rule.startswith("HG4")] == []
+
+
+def test_locked_contract_violation_flagged():
+    # inverse *_locked contract: a `_locked` leaf invoked from a caller
+    # that provably holds NO registered lock
+    findings = run_lint([str(FIXTURES / "bad_pkg" / "locks_cycle.py")])
+    (hit,) = [f for f in findings if f.rule == "HG403"]
+    assert hit.line == 49 and hit.scope == "Journal.drain_fast"
+    assert "_append_locked" in hit.message
+    assert "holding no registered lock" in hit.message
 
 
 # ------------------------------------------------------------ clean fixtures

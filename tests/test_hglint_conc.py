@@ -26,7 +26,7 @@ if str(REPO) not in sys.path:
     sys.path.insert(0, str(REPO))
 
 from tools.hglint import run_lint  # noqa: E402
-from tools.hglint.model import DOC_ANCHORS, RULES  # noqa: E402
+from tools.hglint.model import DOC_ANCHORS, RULES, family  # noqa: E402
 
 FIXTURES = Path(__file__).parent / "hglint_fixtures"
 
@@ -47,15 +47,37 @@ def test_blocking_bad_exact_rule_and_line():
         ("HG701", 32),   # cv.wait while ANOTHER lock stays held
         ("HG701", 56),   # Thread.join under the instance lock
         ("HG702", 41),   # transitive: tick -> _slow_helper -> time.sleep
+        ("HG702", 72),   # arg-passed edge: prober(run_probe(_slow_helper))
+        ("HG702", 77),   # blocking callable smuggled into an unresolvable
+                         # receiver under the hold
+        ("HG702", 86),   # dict-dispatch: OPS[kind]() can hit _slow_helper
         ("HG703", 52),   # sorted() under the instance lock
     ], "\n".join(f.render() for f in findings)
 
 
 def test_blocking_transitive_names_the_witness_chain():
     findings = run_lint([str(FIXTURES / "bad_pkg" / "blocking_bad.py")])
-    (hit,) = [f for f in findings if f.rule == "HG702"]
+    (hit,) = [f for f in findings if f.rule == "HG702" and f.line == 41]
     assert "_slow_helper" in hit.message
     assert "time.sleep" in hit.message
+
+
+def test_blocking_taint_follows_arg_passed_edges():
+    # prober() never blocks by name — the taint arrives ONLY through the
+    # callable it smuggles into run_probe's parameter
+    findings = run_lint([str(FIXTURES / "bad_pkg" / "blocking_bad.py")])
+    (hit,) = [f for f in findings if f.rule == "HG702" and f.line == 72]
+    assert "prober" in hit.message and "time.sleep" in hit.message
+    (smuggled,) = [f for f in findings
+                   if f.rule == "HG702" and f.line == 77]
+    assert "_slow_helper" in smuggled.message
+    assert "passed while holding" in smuggled.message
+
+
+def test_blocking_dispatch_table_members_flagged():
+    findings = run_lint([str(FIXTURES / "bad_pkg" / "blocking_bad.py")])
+    (hit,) = [f for f in findings if f.rule == "HG702" and f.line == 86]
+    assert "dispatch" in hit.message and "OPS" in hit.message
 
 
 def test_blocking_clean_shapes_are_silent():
@@ -74,6 +96,8 @@ def test_lifecycle_bad_exact_rule_and_line():
         ("HG801", 49),   # fire-and-forget local thread
         ("HG801", 54),   # timer never cancelled
         ("HG802", 42),   # raising recv leaks the socket
+        ("HG802", 59),   # tuple-unpacked conn from accept() leaks on recv
+        ("HG802", 67),   # self._sock attribute target leaks on sendall
         ("HG803", 20),   # check-then-act start() without the lock
         ("HG804", 32),   # untimed cv.wait outside a predicate loop
         ("HG805", 37),   # raising handler kills the pump loop
@@ -273,14 +297,14 @@ def test_readme_documents_every_rule_and_vice_versa():
     text = (REPO / "README.md").read_text()
     row_re = re.compile(
         r"^\|\s*\[[^\]]+\]\(#(hg\d[^)]*)\)\s*\|\s*"
-        r"(HG\d{3})(?:–(HG\d{3}))?\s*\|", re.M,
+        r"(HG\d{3,4})(?:–(HG\d{3,4}))?\s*\|", re.M,
     )
     documented, row_anchors = set(), {}
     for m in row_re.finditer(text):
         anchor, lo, hi = m.group(1), m.group(2), m.group(3) or m.group(2)
         for n in range(int(lo[2:]), int(hi[2:]) + 1):
             documented.add(f"HG{n}")
-        row_anchors[lo[:3]] = anchor
+        row_anchors[family(lo)] = anchor
 
     missing = set(RULES) - documented
     assert not missing, f"rules with no README table row: {sorted(missing)}"

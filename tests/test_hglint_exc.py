@@ -1,0 +1,152 @@
+"""Precision pins for the hgexc rule family (HG10xx exception flow &
+failure discipline).
+
+Three jobs, mirroring tests/test_hglint_conc.py:
+
+1. pin the seeded exception fixtures exactly — rule AND line — so a
+   precision regression in either direction (missed swallow, new false
+   positive) fails loudly;
+2. pin the diagnostics' CONTENT: the interprocedural witness chain, the
+   fault-point origin, and the inferred raise-set each name the evidence
+   a reviewer needs to judge the finding;
+3. act as the zero-baseline gate: ``hypergraphdb_tpu`` must carry NO
+   HG10xx findings — swallows get fixed (or pragma-audited), never
+   baselined.
+"""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.hglint import run_lint  # noqa: E402
+from tools.hglint.model import rule_matches  # noqa: E402
+
+FIXTURES = Path(__file__).parent / "hglint_fixtures"
+BAD = FIXTURES / "bad_pkg" / "exceptions_bad.py"
+OK = FIXTURES / "clean_pkg" / "exceptions_ok.py"
+
+
+def _pins(findings):
+    return sorted((f.rule, f.line) for f in findings)
+
+
+# ------------------------------------------------------------- exact pins
+
+
+def test_exceptions_bad_exact_rule_and_line():
+    findings = run_lint([str(BAD)])
+    assert _pins(findings) == [
+        ("HG1001", 26),   # except BaseException eats the drill's kill
+        ("HG1002", 43),   # typed fault handler over a ValueError-only body
+        ("HG1003", 54),   # explicit: except PermanentFault -> continue
+        ("HG1003", 71),   # inferred: broad retry over a permanent raise
+        ("HG1004", 79),   # unguarded thread target lets ValueError escape
+        ("HG1005", 96),   # pass-only swallow with no evidence
+    ], "\n".join(f.render() for f in findings)
+
+
+def test_exceptions_clean_shapes_are_silent():
+    # EVERY family must stay silent: the disciplined twins re-raise
+    # kills, catch live types, gate retries on transience, guard thread
+    # bodies, and leave evidence when they swallow
+    findings = run_lint([str(OK)])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# ----------------------------------------------------- diagnostic content
+
+
+def test_swallowed_kill_names_the_interprocedural_witness():
+    findings = run_lint([str(BAD)])
+    (hit,) = [f for f in findings if f.rule == "HG1001"]
+    # the chain walks caller -> callee and lands on the fault point
+    assert "pump_once -> _arm_fault_point" in hit.message
+    assert "fault point 'ingest.pump'" in hit.message
+    assert "InjectedCrash" in hit.message
+
+
+def test_dead_handler_reports_the_inferred_raise_set():
+    findings = run_lint([str(BAD)])
+    (hit,) = [f for f in findings if f.rule == "HG1002"]
+    assert "except TransientFault" in hit.message
+    assert "raise-set" in hit.message
+
+
+def test_retry_findings_distinguish_explicit_and_inferred():
+    findings = run_lint([str(BAD)])
+    explicit, inferred = sorted(
+        (f for f in findings if f.rule == "HG1003"), key=lambda f: f.line
+    )
+    assert "retry loop catches non-transient" in explicit.message
+    assert "broad retry handler" in inferred.message
+    assert "is_transient" in inferred.message
+    assert "PermanentFault" in explicit.message
+    assert "PermanentFault" in inferred.message
+
+
+def test_thread_entry_names_the_escaping_type():
+    findings = run_lint([str(BAD)])
+    (hit,) = [f for f in findings if f.rule == "HG1004"]
+    assert hit.scope == "crashy_worker"
+    assert "ValueError" in hit.message
+    assert "kills the thread" in hit.message
+
+
+def test_injected_crash_passthrough_is_exempt():
+    # clean_pkg drill_worker lets ONLY InjectedCrash escape its guard —
+    # by design a simulated kill must take the thread down, so HG1004
+    # exempts BaseException-only escapes
+    findings = run_lint([str(OK)], only="HG1004")
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# --------------------------------------------------------- family scoping
+
+
+def test_only_hg10_selects_the_family_not_hg1xx():
+    # "HG10" must mean the HG10xx family — HG101/HG102... are HG1xx and
+    # live in a different analyzer generation
+    findings = run_lint([str(FIXTURES / "bad_pkg")], only="HG10")
+    assert findings and all(f.rule.startswith("HG10") for f in findings)
+    assert all(len(f.rule) == 6 for f in findings), _pins(findings)
+    hostsync = run_lint([str(FIXTURES / "bad_pkg")], only="HG1")
+    assert any(len(f.rule) == 5 for f in hostsync)  # HG1xx still reachable
+
+
+def test_rule_matches_is_family_aware():
+    assert rule_matches("HG1001", "HG10")
+    assert not rule_matches("HG101", "HG10")
+    assert rule_matches("HG101", "HG1")
+    assert not rule_matches("HG1001", "HG1")    # HG1 is exactly the HG1xx
+    # family — a four-digit family never aliases into a three-digit one
+    assert rule_matches("HG1003", "HG1003")
+    assert not rule_matches("HG1003", "HG1001")
+
+
+def test_single_rule_scoping():
+    findings = run_lint([str(BAD)], only="HG1005")
+    assert _pins(findings) == [("HG1005", 96)]
+
+
+# ------------------------------------------------------ zero-baseline gate
+
+
+def test_repo_carries_zero_exception_findings(monkeypatch):
+    """The hgexc acceptance bar: HG10xx holds a ZERO baseline on the real
+    tree — every broad swallow either resolves its ticket with evidence
+    or carries an audited pragma that HG901 keeps honest."""
+    monkeypatch.chdir(REPO)
+    findings = run_lint(["hypergraphdb_tpu"], only="HG10")
+    assert findings == [], (
+        "exception-discipline findings must be FIXED, not baselined:\n"
+        + "\n".join(f.render() for f in findings)
+    )
+
+
+def test_repo_carries_zero_lock_contract_findings(monkeypatch):
+    monkeypatch.chdir(REPO)
+    findings = run_lint(["hypergraphdb_tpu"], only="HG403")
+    assert findings == [], "\n".join(f.render() for f in findings)

@@ -10,6 +10,12 @@ Six rule families (see ``tools.hglint.model.RULES``):
 - HG4xx  lock-order cycles and unlocked shared-state mutation
 - HG5xx  static VMEM budgets per pallas_call (abstract interpretation)
 - HG6xx  shard_map collective consistency (mesh axes, divergence)
+- HG7xx  blocking work while holding a lock (interprocedural taint)
+- HG8xx  thread & resource lifecycle contracts
+- HG9xx  analyzer hygiene (stale suppressions)
+- HG10xx exception flow & failure discipline (interprocedural raise-set
+         inference: swallowed kills, dead fault handlers, permanent-fault
+         retries, unguarded worker entry points, evidence-free swallows)
 
 Run ``python -m tools.hglint <paths>``; the repo gate is
 ``tools/lint.sh`` (baseline-filtered, exits nonzero on new findings,

@@ -31,6 +31,10 @@ PALLAS_FQNS = {
 }
 PARTIAL_FQNS = {"functools.partial"}
 WRAPPER_FQNS = JIT_FQNS | SHARD_FQNS | PALLAS_FQNS
+#: thread/timer constructors — a callable passed as their ``target=`` runs
+#: on ANOTHER thread, so it must not feed caller-context taint (blocking,
+#: raise-sets) back through the arg-passed edge
+THREAD_CTORS = {"threading.Thread", "threading.Timer"}
 
 
 @dataclass
@@ -65,6 +69,17 @@ class CallGraph:
         self.calls: list[CallSite] = []
         self.edges: dict[str, set] = {}
         self.traced: dict[str, str] = {}   # fn key -> root key it's traced via
+        #: fn key -> callees invoked by NAME (``f(...)`` / ``self.m(...)``)
+        self.direct_edges: dict[str, set] = {}
+        #: fn key -> callables PASSED as arguments (combinator bodies,
+        #: callbacks smuggled through a parameter) — thread/timer targets
+        #: are excluded: they run on another thread, not in the caller's
+        #: context, so caller-context taint must not follow them
+        self.arg_edges: dict[str, set] = {}
+        #: dispatch-table slot id -> function keys stored as dict values
+        #: (``HANDLERS = {"x": handle_x}`` / ``self._ops = {...}``) — a
+        #: call through ``HANDLERS[kind](...)`` fans out to all of them
+        self.dispatch_tables: dict[str, set] = {}
 
     # -- construction ---------------------------------------------------------
 
@@ -74,6 +89,7 @@ class CallGraph:
         for mod in modules:
             _index_functions(cg, mod)
         cg._mark_wrapper_callsite_roots()
+        cg._index_dispatch_tables(modules)
         cg._build_edges()
         cg._propagate_taint()
         return cg
@@ -138,6 +154,71 @@ class CallGraph:
 
     # -- edges + taint --------------------------------------------------------
 
+    def _index_dispatch_tables(self, modules: list) -> None:
+        """Record dict literals whose values are known functions, keyed by
+        the slot they are stored in: ``HANDLERS = {"x": handle_x}`` at
+        module level -> ``mod.HANDLERS``; ``self._ops = {"a": self._do_a}``
+        inside a method -> ``mod.Cls._ops``."""
+
+        def members(d: ast.Dict, mod, cls_name) -> set:
+            out: set = set()
+            for v in d.values:
+                if isinstance(v, ast.Name):
+                    cand = f"{mod.name}.{v.id}"
+                    if cand in self.functions:
+                        out.add(cand)
+                elif isinstance(v, ast.Attribute) and \
+                        isinstance(v.value, ast.Name) and \
+                        v.value.id in ("self", "cls") and cls_name:
+                    cand = f"{mod.name}.{cls_name}.{v.attr}"
+                    if cand in self.functions:
+                        out.add(cand)
+            return out
+
+        for mod in modules:
+            for stmt in mod.tree.body:
+                if isinstance(stmt, ast.Assign) and \
+                        isinstance(stmt.value, ast.Dict):
+                    for tgt in stmt.targets:
+                        if isinstance(tgt, ast.Name):
+                            ms = members(stmt.value, mod, None)
+                            if ms:
+                                self.dispatch_tables[
+                                    f"{mod.name}.{tgt.id}"] = ms
+        for key, fi in self.functions.items():
+            if fi.cls_name is None:
+                continue
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Dict):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Attribute) and \
+                                isinstance(tgt.value, ast.Name) and \
+                                tgt.value.id == "self":
+                            ms = members(node.value, fi.mod, fi.cls_name)
+                            if ms:
+                                self.dispatch_tables[
+                                    f"{fi.mod.name}.{fi.cls_name}."
+                                    f"{tgt.attr}"] = ms
+
+    def resolve_dispatch(self, expr: ast.AST, site: CallSite) -> set:
+        """``HANDLERS[kind]`` / ``self._ops[op]`` -> the function keys the
+        subscripted dispatch table can fan out to (empty when the receiver
+        is not a known table)."""
+        if not isinstance(expr, ast.Subscript):
+            return set()
+        recv = expr.value
+        fi = self.functions.get(site.fn_key) if site.fn_key else None
+        if isinstance(recv, ast.Name):
+            return self.dispatch_tables.get(
+                f"{site.mod.name}.{recv.id}", set())
+        if isinstance(recv, ast.Attribute) and \
+                isinstance(recv.value, ast.Name) and \
+                recv.value.id == "self" and fi is not None and fi.cls_name:
+            return self.dispatch_tables.get(
+                f"{site.mod.name}.{fi.cls_name}.{recv.attr}", set())
+        return set()
+
     def _build_edges(self) -> None:
         for site in self.calls:
             if site.fn_key is None:
@@ -145,15 +226,25 @@ class CallGraph:
             callee = self.resolve_callable(site.node.func, site)
             if callee is not None:
                 self.edges.setdefault(site.fn_key, set()).add(callee)
+                self.direct_edges.setdefault(site.fn_key, set()).add(callee)
+            for k in self.resolve_dispatch(site.node.func, site):
+                # a call THROUGH a dispatch table really invokes one of its
+                # members in the caller's context — a direct edge to each
+                self.edges.setdefault(site.fn_key, set()).add(k)
+                self.direct_edges.setdefault(site.fn_key, set()).add(k)
             # a function passed as an argument to another *known* function
             # (e.g. a body handed to lax.fori_loop, a predicate to a local
             # combinator) is conservatively reachable from the caller
+            thread_args = _thread_target_args(site)
             for arg in list(site.node.args) + [k.value for k in site.node.keywords]:
                 tgt = _unwrap_partial(arg, site.mod)
                 if isinstance(tgt, (ast.Name, ast.Attribute)):
                     k = self.resolve_callable(tgt, site)
                     if k is not None:
                         self.edges.setdefault(site.fn_key, set()).add(k)
+                        if id(arg) not in thread_args:
+                            self.arg_edges.setdefault(
+                                site.fn_key, set()).add(k)
 
     def _propagate_taint(self) -> None:
         from collections import deque
@@ -284,6 +375,21 @@ def _static_params(call: ast.Call, fi: FunctionInfo) -> set:
             for n in nums:
                 if 0 <= n < len(fi.params):
                     out.add(fi.params[n])
+    return out
+
+
+def _thread_target_args(site: CallSite) -> set:
+    """ids of argument nodes that are thread/timer TARGETS at this call
+    site — the guard that keeps caller-context taint (blocking under the
+    caller's lock, the caller's raise-set) from following a callable that
+    actually runs on another thread."""
+    fqn = resolve_fqn(site.node.func, site.mod)
+    if fqn not in THREAD_CTORS:
+        return set()
+    out = {id(k.value) for k in site.node.keywords
+           if k.arg in ("target", "function")}
+    if fqn == "threading.Timer" and len(site.node.args) >= 2:
+        out.add(id(site.node.args[1]))
     return out
 
 

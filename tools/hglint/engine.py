@@ -12,6 +12,7 @@ from tools.hglint import (
     rules_blocking,
     rules_collectives,
     rules_donation,
+    rules_exceptions,
     rules_hostsync,
     rules_lifecycle,
     rules_locks,
@@ -21,7 +22,14 @@ from tools.hglint import (
 )
 from tools.hglint.callgraph import CallGraph
 from tools.hglint.loader import discover_modules
-from tools.hglint.model import RULES, Finding, doc_anchor, sort_findings
+from tools.hglint.model import (
+    RULES,
+    Finding,
+    doc_anchor,
+    family,
+    rule_matches,
+    sort_findings,
+)
 
 BASELINE_VERSION = 1
 REPORT_VERSION = 3
@@ -39,7 +47,7 @@ def _runners(cg, modules, interp, vmem_budget):
          lambda: rules_retrace.check(cg, modules)),
         (("HG301", "HG302", "HG303", "HG304"),
          lambda: rules_pallas.check(cg, modules)),
-        (("HG401", "HG402"),
+        (("HG401", "HG402", "HG403"),
          lambda: rules_locks.check(cg, modules)),
         (("HG501", "HG502", "HG503"),
          lambda: rules_vmem.check(cg, modules, interp, vmem_budget)),
@@ -49,21 +57,25 @@ def _runners(cg, modules, interp, vmem_budget):
          lambda: rules_blocking.check(cg, modules)),
         (("HG801", "HG802", "HG803", "HG804", "HG805"),
          lambda: rules_lifecycle.check(cg, modules)),
+        (("HG1001", "HG1002", "HG1003", "HG1004", "HG1005"),
+         lambda: rules_exceptions.check(cg, modules)),
     ]
 
 
 def parse_only(only) -> tuple:
     """``--only`` value -> tuple of rule-id prefixes ("HG5" / "HG5,HG601"
-    / already-split sequences all accepted). A prefix matching NO known
-    rule raises: a typo'd ``--only`` must not turn the gate into a silent
-    green no-op."""
+    / "HG10" / already-split sequences all accepted). Matching is
+    family-aware (``model.rule_matches``): ``HG10`` selects the HG10xx
+    exception family WITHOUT aliasing into HG101-HG107. A prefix matching
+    NO known rule raises: a typo'd ``--only`` must not turn the gate into
+    a silent green no-op."""
     if not only:
         return ()
     if isinstance(only, str):
         only = only.split(",")
     prefixes = tuple(p.strip() for p in only if p and p.strip())
     for p in prefixes:
-        if not any(r.startswith(p) for r in RULES):
+        if not any(rule_matches(r, p) for r in RULES):
             raise ValueError(
                 f"--only prefix {p!r} matches no known rule; valid ids are "
                 f"{sorted(RULES)} (prefixes like 'HG5' select a family)"
@@ -92,12 +104,14 @@ def run_lint(paths: list, only=None, vmem_budget: int = None,
     # the HG901 stale-suppression audit needs the findings OTHER rules
     # would have produced — when it's selected, every runner still runs
     # (its findings are filtered back out below)
-    audit_on = not prefixes or any("HG901".startswith(p) for p in prefixes)
+    audit_on = not prefixes or any(
+        rule_matches("HG901", p) for p in prefixes
+    )
     findings = []
     ran_rules: set = set()
     for rules, thunk in _runners(cg, modules, interp, budget):
         if prefixes and not audit_on and not any(
-            r.startswith(p) for p in prefixes for r in rules
+            rule_matches(r, p) for p in prefixes for r in rules
         ):
             continue
         ran_rules.update(rules)
@@ -109,7 +123,7 @@ def run_lint(paths: list, only=None, vmem_budget: int = None,
     if prefixes:
         findings = [
             f for f in findings
-            if any(f.rule.startswith(p) for p in prefixes)
+            if any(rule_matches(f.rule, p) for p in prefixes)
         ]
     if changed_files is not None:
         keep = {_slash(p) for p in changed_files}
@@ -266,7 +280,7 @@ def build_report(findings: list, paths: list, *, baseline_path=None,
 
 
 def summarize(findings: list) -> str:
-    fam = Counter(f.rule[:3] + "xx" for f in findings)
+    fam = Counter(family(f.rule) + "xx" for f in findings)
     rules = Counter(f.rule for f in findings)
     parts = [f"{n} findings" if (n := len(findings)) != 1
              else "1 finding"]

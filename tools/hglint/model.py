@@ -33,6 +33,8 @@ RULES = {
     # -- family 4: lock order -------------------------------------------------
     "HG401": "lock acquisition cycle (potential deadlock)",
     "HG402": "shared attribute mutated outside the instance lock",
+    "HG403": "`*_locked` contract function called from a context that "
+             "holds no lock",
     # -- family 5: VMEM budgets ----------------------------------------------
     "HG501": "pallas_call VMEM working set exceeds the per-core budget",
     "HG502": "pallas_call VMEM working set is not statically resolvable",
@@ -61,6 +63,17 @@ RULES = {
     # -- family 9: analyzer hygiene -------------------------------------------
     "HG901": "stale `# hglint: disable` suppression — the named rule no "
              "longer fires on that line",
+    # -- family 10: exception flow & failure discipline ------------------------
+    "HG1001": "broad handler on an InjectedCrash-carrying path swallows a "
+              "simulated kill (no BaseException re-raise)",
+    "HG1002": "dead typed fault handler — the guarded calls cannot raise "
+              "the caught type",
+    "HG1003": "retry loop re-attempts non-transient failures (retrying a "
+              "PermanentFault burns the deadline for nothing)",
+    "HG1004": "thread/worker entry point without a top-level guard — one "
+              "raise strands the loop's tickets/queue",
+    "HG1005": "exception swallowed without evidence (no re-raise, log, "
+              "counter, or ticket resolution)",
 }
 
 #: rule id -> default severity
@@ -80,6 +93,7 @@ RULE_SEVERITY = {
     "HG304": "error",
     "HG401": "error",
     "HG402": "warning",
+    "HG403": "warning",
     "HG106": "error",
     "HG107": "warning",
     "HG501": "error",
@@ -98,10 +112,23 @@ RULE_SEVERITY = {
     "HG804": "error",
     "HG805": "warning",
     "HG901": "warning",
+    "HG1001": "error",
+    "HG1002": "warning",
+    "HG1003": "error",
+    "HG1004": "warning",
+    "HG1005": "warning",
 }
 
+
+def family(rule: str) -> str:
+    """Rule id -> family prefix: the id minus its two trailing digits
+    (``HG101`` -> ``HG1``, ``HG1001`` -> ``HG10``). Keeps four-digit
+    families from aliasing into three-digit ones under ``startswith``."""
+    return rule[:-2]
+
+
 #: family prefix -> README.md section anchor (rule docs live there); HG106
-#: and HG107 extend family 1, so the 3-char prefix mapping covers them
+#: and HG107 extend family 1, so the family mapping covers them
 DOC_ANCHORS = {
     "HG1": "hg1xx-host-sync-in-traced-code",
     "HG2": "hg2xx-retrace-hazards",
@@ -112,13 +139,24 @@ DOC_ANCHORS = {
     "HG7": "hg7xx-blocking-under-lock",
     "HG8": "hg8xx-thread--resource-lifecycle",
     "HG9": "hg9xx-analyzer-hygiene",
+    "HG10": "hg10xx-exception-flow--failure-discipline",
 }
+
+
+def rule_matches(rule: str, prefix: str) -> bool:
+    """``--only`` selection: a prefix selects an exact rule id, an exact
+    family (``HG10`` selects HG1001-HG1005 but NOT HG101), or — for
+    prefixes shorter than a family id — any rule it is a string prefix of
+    (``HG`` selects everything)."""
+    if rule == prefix or family(rule) == prefix:
+        return True
+    return len(prefix) < 3 and rule.startswith(prefix)
 
 
 def doc_anchor(rule: str) -> str:
     """URL-style pointer to the rule family's README section, printed in
     every rendered diagnostic (``HG5xx`` -> ``README.md#hg5xx-...``)."""
-    slug = DOC_ANCHORS.get(rule[:3], "static-analysis-hglint")
+    slug = DOC_ANCHORS.get(family(rule), "static-analysis-hglint")
     return f"README.md#{slug}"
 
 

@@ -11,9 +11,14 @@ lint time.
 Mechanics: a *blocking taint set* (``time.sleep``, socket/HTTP sends,
 ``Thread.join``, ``fsync``/``os.replace``, ``block_until_ready``/device
 syncs, bounded-queue get/put, ...) is seeded from direct calls, propagated
-backwards through the resolved call graph, and intersected with the
+backwards through the resolved call graph — direct by-name edges PLUS
+arg-passed edges (a callable smuggled through a parameter or a dict
+dispatch table runs in its caller's context) — and intersected with the
 held-lock contexts the HG4xx lock engine already tracks
-(``rules_locks.function_held_sites``).
+(``rules_locks.function_held_sites``).  Thread/timer ``target=``
+callables are the carve-out: they run on ANOTHER thread, not under the
+constructing caller's hold, so they feed no taint back (the call-graph
+arg edges exclude them).
 
 HG701  a direct blocking call while at least one registered lock is held.
 HG702  a call while holding a lock whose callee *transitively* reaches a
@@ -41,7 +46,7 @@ from __future__ import annotations
 import ast
 from typing import Optional
 
-from tools.hglint.callgraph import CallGraph, CallSite
+from tools.hglint.callgraph import CallGraph, CallSite, _thread_target_args
 from tools.hglint.loader import resolve_fqn
 from tools.hglint.model import Finding
 from tools.hglint.rules_locks import _collect_locks, function_held_sites
@@ -93,7 +98,7 @@ def check(cg: CallGraph, modules: list) -> list:
     if not reg.kinds:
         return []
     slots = _SlotRegistry(cg, modules)
-    edges = _direct_call_edges(cg)
+    edges = _taint_edges(cg)
     blocked = _propagate_blocking(cg, slots, edges)
     findings = []
     for key, sites in sorted(function_held_sites(cg, reg).items()):
@@ -114,9 +119,8 @@ def check(cg: CallGraph, modules: list) -> list:
                     f"{_fmt_locks(held)} — {_SORT_MSG}",
                 ))
                 continue
-            callee = cg.resolve_callable(
-                node.func, CallSite(node=node, fn_key=fi.key, mod=fi.mod)
-            )
+            site = CallSite(node=node, fn_key=fi.key, mod=fi.mod)
+            callee = cg.resolve_callable(node.func, site)
             if callee is not None and callee in blocked:
                 chain = _witness_chain(callee, blocked)
                 findings.append(_f(
@@ -125,6 +129,38 @@ def check(cg: CallGraph, modules: list) -> list:
                     f"{_fmt_locks(held)} reaches blocking "
                     f"{blocked[callee][0]} (via {chain})",
                 ))
+                continue
+            hit = next((k for k in sorted(
+                cg.resolve_dispatch(node.func, site)) if k in blocked),
+                None)
+            if hit is not None:
+                chain = _witness_chain(hit, blocked)
+                findings.append(_f(
+                    "HG702", fi, node,
+                    f"dispatch through `{_spelling(node.func)}` while "
+                    f"holding {_fmt_locks(held)} can invoke a member "
+                    f"that reaches blocking {blocked[hit][0]} "
+                    f"(via {chain})",
+                ))
+                continue
+            # a callable SMUGGLED through a parameter runs in the
+            # (unresolvable) receiver's context — under this hold; thread
+            # targets are exempt (they run on another thread)
+            thread_args = _thread_target_args(site)
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if id(arg) in thread_args or \
+                        not isinstance(arg, (ast.Name, ast.Attribute)):
+                    continue
+                k = cg.resolve_callable(arg, site)
+                if k is not None and k in blocked:
+                    chain = _witness_chain(k, blocked)
+                    findings.append(_f(
+                        "HG702", fi, node,
+                        f"callable `{_spelling(arg)}` passed while "
+                        f"holding {_fmt_locks(held)} reaches blocking "
+                        f"{blocked[k][0]} (via {chain})",
+                    ))
+                    break
     return findings
 
 
@@ -306,18 +342,17 @@ def _is_sort(node: ast.Call, fi) -> bool:
 # -------------------------------------------------------------- propagation
 
 
-def _direct_call_edges(cg: CallGraph) -> dict:
-    """fn key -> callees invoked by NAME (``f(...)``) — deliberately
-    narrower than ``cg.edges``: a function merely *passed* as an argument
-    (a Thread target, a lax.fori_loop body) runs later, not under the
-    caller's hold, so it must not feed blocking taint back."""
-    edges: dict = {}
-    for site in cg.calls:
-        if site.fn_key is None:
-            continue
-        callee = cg.resolve_callable(site.node.func, site)
-        if callee is not None:
-            edges.setdefault(site.fn_key, set()).add(callee)
+def _taint_edges(cg: CallGraph) -> dict:
+    """fn key -> callees whose blocking taints the caller: by-name calls
+    and dispatch-table fan-out (``cg.direct_edges``) plus callables
+    passed as arguments (``cg.arg_edges`` — a combinator body or a
+    callback smuggled through a parameter runs in the caller's context).
+    Thread/timer ``target=`` callables are already excluded from
+    ``arg_edges`` at graph build: they run on another thread, not under
+    the caller's hold, so they must not feed blocking taint back."""
+    edges: dict = {k: set(v) for k, v in cg.direct_edges.items()}
+    for k, v in cg.arg_edges.items():
+        edges.setdefault(k, set()).update(v)
     return edges
 
 

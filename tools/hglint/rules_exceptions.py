@@ -1,0 +1,846 @@
+"""HG10xx — exception flow & failure discipline.
+
+The fault vocabulary (``fault/errors.py``) is a contract: ``TransientFault``
+is retry-worthy, ``PermanentFault`` is not, and ``InjectedCrash`` is
+deliberately a ``BaseException`` so that no recovery layer can swallow a
+simulated kill.  The contract was previously enforced only by convention —
+and review rounds kept hand-finding exactly the bug classes a static pass
+catches mechanically (an evaluation bug raising out of a finalizer and
+stranding a pump loop's tickets, a handler quietly eating the error that
+every chaos drill depends on observing).  This family checks the
+discipline with an **interprocedural raise-set inference**:
+
+Per function, the set of exception types it may RAISE is computed from
+
+- explicit ``raise TypeName(...)`` statements (variable re-raises are
+  skipped: the inference is deliberately an under-approximation — it only
+  claims types it can prove, so every finding has a witness);
+- calls into known-raising runtime APIs (``FaultRegistry.check`` fault
+  points — the armed error can be anything up to an ``InjectedCrash`` —
+  ``submit_*`` entry points, socket/HTTP transport sends);
+- calls to other analyzed functions, propagated to a fixpoint over the
+  call graph **including arg-passed call edges** (a callable smuggled
+  through a parameter or a dict dispatch raises in its caller's context)
+  with the thread-target guard: a ``Thread(target=f)`` callable runs on
+  another thread, so ``f``'s raise-set must NOT flow into the
+  constructing caller.
+
+Types escaping a function subtract everything absorbed by enclosing
+``try`` handlers — a handler whose body re-raises (contains any ``raise``)
+is transparent.  A small name-based hierarchy (the tree's fault taxonomy +
+the Python builtins) decides what a handler catches and which types are
+transient.
+
+Rules on top of the inference:
+
+HG1001  a handler that can receive an ``InjectedCrash`` (bare ``except``,
+        ``except BaseException``, or ``except InjectedCrash``) and does
+        not re-raise — a swallowed simulated kill silently invalidates
+        every recovery drill.  The witness chain names the path the crash
+        travels.
+HG1002  dead typed fault handler: ``except TransientFault`` (or any
+        FaultError subtype) around calls whose inferred raise-set is
+        CLOSED and cannot contain the caught type — the handler documents
+        recovery that can never run.
+HG1003  retry loop whose caught set includes provably non-transient types
+        (an explicit ``PermanentFault`` catch that retries, or a broad
+        catch over a body that raises one, with no ``is_transient`` /
+        ``.transient`` guard and no re-raise).
+HG1004  thread/timer target entry point whose body lets an
+        Exception-level raise escape (no top-level guard) — one raise
+        kills the thread and strands the loop's tickets/queue.
+        ``InjectedCrash``-level types are exempt: kills MUST escape.
+HG1005  swallow-without-evidence: a broad handler that neither re-raises,
+        logs, increments a counter, completes a future/ticket
+        (``resolve``/``fail``/``shed``/``set_exception`` sinks), uses the
+        bound exception, nor returns a typed fallback.
+
+Escape hatch: ``# hglint: disable=HG100x`` on the handler's line — audited
+by HG901 the moment the rule stops firing.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tools.hglint.callgraph import (
+    CallGraph,
+    CallSite,
+    _thread_target_args,
+)
+from tools.hglint.loader import resolve_fqn
+from tools.hglint.model import Finding
+
+# --------------------------------------------------------------- type model
+
+#: name-based exception hierarchy: child -> parent.  Short names keep
+#: cross-module matching simple (``errors.TransientFault`` and a bare
+#: ``TransientFault`` import are the same type to the lint).
+BUILTIN_PARENT = {
+    "Exception": "BaseException",
+    "InjectedCrash": "BaseException",
+    "KeyboardInterrupt": "BaseException",
+    "SystemExit": "BaseException",
+    "GeneratorExit": "BaseException",
+    "FaultError": "Exception",
+    "TransientFault": "FaultError",
+    "PermanentFault": "FaultError",
+    "OSError": "Exception",
+    "IOError": "OSError",
+    "TimeoutError": "OSError",
+    "ConnectionError": "OSError",
+    "ConnectionResetError": "ConnectionError",
+    "ConnectionRefusedError": "ConnectionError",
+    "ConnectionAbortedError": "ConnectionError",
+    "BrokenPipeError": "ConnectionError",
+    "ArithmeticError": "Exception",
+    "ZeroDivisionError": "ArithmeticError",
+    "OverflowError": "ArithmeticError",
+    "LookupError": "Exception",
+    "KeyError": "LookupError",
+    "IndexError": "LookupError",
+    "RuntimeError": "Exception",
+    "NotImplementedError": "RuntimeError",
+    "RecursionError": "RuntimeError",
+    "ValueError": "Exception",
+    "UnicodeDecodeError": "ValueError",
+    "TypeError": "Exception",
+    "AttributeError": "Exception",
+    "AssertionError": "Exception",
+    "MemoryError": "Exception",
+    "StopIteration": "Exception",
+    "FileNotFoundError": "OSError",
+    "PermissionError": "OSError",
+    "InterruptedError": "OSError",
+}
+
+#: transience roots beyond an explicit ``transient =`` class attribute
+#: (mirrors ``fault.errors.DEFAULT_TRANSIENT``)
+TRANSIENT_ROOTS = {"TransientFault", "TimeoutError", "ConnectionError"}
+NON_TRANSIENT_ROOTS = {"PermanentFault"}
+
+#: socket/HTTP primitives whose failure mode is a dropped/timed-out wire
+TRANSPORT_METHODS = {
+    "sendall", "recv", "recv_into", "recvfrom", "accept",
+    "create_connection", "getresponse", "urlopen",
+}
+TRANSPORT_RAISES = frozenset({"ConnectionError", "TimeoutError"})
+
+#: fault-point sites raise whatever error the drill armed — up to a kill
+FAULT_POINT_RAISES = frozenset(
+    {"TransientFault", "PermanentFault", "InjectedCrash"}
+)
+
+#: serve/peer submit entry points shed or fault-type their admission
+#: failures; modeled for receiver-typed calls the graph cannot resolve
+SUBMIT_RAISES = frozenset({"TransientFault", "PermanentFault"})
+
+#: calls that are closed-world no-raise for HG1002's purposes: builtins
+#: and container/str/coordination methods that cannot produce the fault
+#: types a typed handler catches
+CLOSED_FUNCS = {
+    "len", "int", "str", "float", "bool", "repr", "min", "max", "abs",
+    "sum", "sorted", "list", "dict", "set", "tuple", "frozenset", "range",
+    "enumerate", "zip", "isinstance", "issubclass", "getattr", "hasattr",
+    "setattr", "id", "hash", "print", "format", "iter", "next", "any",
+    "all", "callable", "vars", "type", "round", "divmod", "map", "filter",
+}
+CLOSED_METHODS = {
+    "append", "appendleft", "extend", "extendleft", "add", "discard",
+    "remove", "clear", "pop", "popleft", "popitem", "setdefault",
+    "update", "items", "keys", "values", "get", "copy", "sort",
+    "reverse", "index", "count", "split", "rsplit", "strip", "lstrip",
+    "rstrip", "startswith", "endswith", "lower", "upper", "replace",
+    "format", "encode", "decode", "is_set", "set", "clear", "acquire",
+    "release", "notify", "notify_all", "debug", "info", "warning",
+    "error", "exception", "critical", "getLogger", "monotonic", "time",
+    "perf_counter", "is_alive", "incr", "observe", "record",
+}
+
+#: handler-body calls that count as EVIDENCE the failure was handled:
+#: logging, counters, future/ticket resolution, rollback/abort paths
+EVIDENCE_METHODS = {
+    # logging
+    "debug", "info", "warning", "error", "exception", "critical", "log",
+    # counters / registries
+    "incr", "inc", "increment", "observe", "record", "record_failure",
+    "record_retry", "note", "mark", "bump", "add",
+    # future / ticket sinks
+    "resolve", "fail", "shed", "set_result", "set_exception", "cancel",
+    "fail_batch", "abort", "rollback", "rollback_mem", "finish_error",
+    "force_sample", "put", "append", "appendleft", "extendleft", "extend",
+    "send", "respond", "reject", "retry", "close", "stop", "shutdown",
+}
+
+_F = Finding
+
+
+def check(cg: CallGraph, modules: list) -> list:
+    model = RaiseModel(cg, modules)
+    findings = []
+    findings += _swallowed_kills(cg, model)          # HG1001
+    findings += _dead_typed_handlers(cg, model)      # HG1002
+    findings += _retry_discipline(cg, model)         # HG1003
+    findings += _entry_point_guards(cg, model)       # HG1004
+    findings += _swallow_evidence(cg, model)         # HG1005
+    return findings
+
+
+# ---------------------------------------------------------------- the model
+
+
+class _Ev:
+    """One exception-producing event inside a function body."""
+
+    __slots__ = ("node", "guards", "kind", "types", "callee", "desc",
+                 "unknown")
+
+    def __init__(self, node, guards, kind, types=frozenset(), callee=None,
+                 desc="", unknown=False):
+        self.node = node
+        self.guards = guards      # tuple of _Guard, outermost first
+        self.kind = kind          # "raise" | "api" | "call"
+        self.types = types        # for raise/api
+        self.callee = callee      # for call
+        self.desc = desc          # human label for api events
+        self.unknown = unknown    # unresolvable non-closed call
+
+
+class _Guard:
+    """One enclosing ``try`` whose handlers may absorb an event."""
+
+    __slots__ = ("try_id", "handlers")
+
+    def __init__(self, try_id, handlers):
+        self.try_id = try_id
+        #: [(catch name set, reraises, handler node)]
+        self.handlers = handlers
+
+
+class RaiseModel:
+    """Interprocedural raise-set inference over the hglint call graph."""
+
+    def __init__(self, cg: CallGraph, modules: list):
+        self.cg = cg
+        self.parent = dict(BUILTIN_PARENT)
+        self.transient_attr: dict = {}
+        #: mod name -> alias -> type names, for module-level exception
+        #: tuples (``_PERMANENT = (Unservable, PermanentFault, ...)``)
+        #: spliced into catch clauses (``except (Deadline, *_PERMANENT)``)
+        self.catch_aliases: dict = {}
+        self._index_classes(modules)
+        self.events: dict = {}    # fn key -> [_Ev]
+        self.tries: dict = {}     # fn key -> [(Try node, [_Guard.handlers])]
+        self.open_direct: dict = {}   # fn key -> bool (has unknown call)
+        for key, fi in cg.functions.items():
+            self._walk_function(fi)
+        #: fn key -> {type: (lineno, via callee key or None, desc)}
+        self.escapes: dict = {key: {} for key in cg.functions}
+        #: fn key -> transitively open (unknown call anywhere reachable)
+        self.open: dict = dict(self.open_direct)
+        self._fixpoint()
+
+    # -- class / type hierarchy ----------------------------------------------
+
+    def _index_classes(self, modules: list) -> None:
+        for mod in modules:
+            aliases = self.catch_aliases.setdefault(mod.name, {})
+            for stmt in mod.tree.body:
+                if isinstance(stmt, ast.Assign) and \
+                        len(stmt.targets) == 1 and \
+                        isinstance(stmt.targets[0], ast.Name) and \
+                        isinstance(stmt.value, ast.Tuple):
+                    names = [_type_name(e) for e in stmt.value.elts]
+                    if names and all(n is not None for n in names):
+                        aliases[stmt.targets[0].id] = frozenset(names)
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                base = None
+                for b in node.bases:
+                    name = _type_name(b)
+                    if name is not None:
+                        base = name
+                        break
+                if base is not None and node.name not in BUILTIN_PARENT:
+                    self.parent[node.name] = base
+                for stmt in node.body:
+                    if isinstance(stmt, ast.Assign) and \
+                            len(stmt.targets) == 1 and \
+                            isinstance(stmt.targets[0], ast.Name) and \
+                            stmt.targets[0].id == "transient" and \
+                            isinstance(stmt.value, ast.Constant):
+                        self.transient_attr[node.name] = bool(
+                            stmt.value.value
+                        )
+
+    def ancestry(self, t: str):
+        seen = []
+        cur = t
+        while cur is not None and cur not in seen:
+            seen.append(cur)
+            if cur == "BaseException":
+                break
+            if cur == "Exception":
+                cur = "BaseException"
+            else:
+                cur = self.parent.get(cur, "Exception")
+        return seen
+
+    def catches(self, catch_set, t: str) -> bool:
+        return any(a in catch_set for a in self.ancestry(t))
+
+    def base_only(self, t: str) -> bool:
+        """True when ``t`` derives from BaseException WITHOUT passing
+        through Exception (kills: InjectedCrash, KeyboardInterrupt...)."""
+        anc = self.ancestry(t)
+        return "Exception" not in anc and "BaseException" in anc
+
+    def transience(self, t: str) -> Optional[bool]:
+        """True transient / False provably non-transient / None unknown.
+        An explicit ``transient =`` class attribute wins (the runtime's
+        ``is_transient`` order), then the ancestry roots."""
+        for a in self.ancestry(t):
+            if a in self.transient_attr:
+                return self.transient_attr[a]
+            if a in TRANSIENT_ROOTS:
+                return True
+            if a in NON_TRANSIENT_ROOTS:
+                return False
+        return None
+
+    # -- per-function event collection ---------------------------------------
+
+    def _walk_function(self, fi) -> None:
+        events: list = []
+        tries: list = []
+        self.open_direct.setdefault(fi.key, False)
+
+        aliases = self.catch_aliases.get(fi.mod.name, {})
+
+        def resolve_catch(e):
+            if isinstance(e, ast.Starred):      # except (A, *_PERMANENT)
+                e = e.value
+            if isinstance(e, ast.Name) and e.id in aliases:
+                return set(aliases[e.id])
+            n = _type_name(e)
+            return {n} if n is not None else set()
+
+        def handler_info(try_node):
+            handlers = []
+            for h in try_node.handlers:
+                if h.type is None:
+                    names = frozenset({"BaseException"})
+                else:
+                    elts = h.type.elts if isinstance(h.type, ast.Tuple) \
+                        else [h.type]
+                    resolved: set = set()
+                    for e in elts:
+                        resolved |= resolve_catch(e)
+                    names = frozenset(resolved) or \
+                        frozenset({"BaseException"})
+                reraises = any(
+                    isinstance(n, ast.Raise) for s in h.body
+                    for n in ast.walk(s)
+                )
+                handlers.append((names, reraises, h))
+            return handlers
+
+        def classify_call(node: ast.Call, guards) -> None:
+            site = CallSite(node=node, fn_key=fi.key, mod=fi.mod)
+            callee = self.cg.resolve_callable(node.func, site)
+            fanout = self.cg.resolve_dispatch(node.func, site)
+            if callee is not None:
+                events.append(_Ev(node, guards, "call", callee=callee))
+            elif fanout:
+                for k in sorted(fanout):
+                    events.append(_Ev(node, guards, "call", callee=k))
+            else:
+                api = _known_api(node, fi)
+                if api is not None:
+                    types, desc = api
+                    events.append(_Ev(node, guards, "api", types=types,
+                                      desc=desc))
+                elif not _closed_call(node) and \
+                        _type_name(node.func) not in self.parent:
+                    # an exception CONSTRUCTOR (`raise ValueError(...)`)
+                    # is not a raising call — the enclosing Raise event
+                    # already carries its type
+                    events.append(_Ev(node, guards, "call", unknown=True))
+                    self.open_direct[fi.key] = True
+            # a callable passed as an argument may raise in the caller's
+            # context — except thread/timer targets, which run elsewhere
+            thread_args = _thread_target_args(site)
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if id(arg) in thread_args:
+                    continue
+                if isinstance(arg, (ast.Name, ast.Attribute)):
+                    k = self.cg.resolve_callable(arg, site)
+                    if k is not None and k != callee:
+                        events.append(_Ev(node, guards, "call", callee=k))
+
+        def walk(node, guards) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)) and \
+                    node is not fi.node:
+                return
+            if isinstance(node, ast.Try):
+                handlers = handler_info(node)
+                tries.append((node, handlers))
+                inner = guards + (_Guard(id(node), handlers),)
+                for s in node.body:
+                    walk(s, inner)
+                # handler bodies, else, and finally are covered only by
+                # OUTER tries (standard propagation semantics)
+                for _, _, h in handlers:
+                    for s in h.body:
+                        walk(s, guards)
+                for s in node.orelse + node.finalbody:
+                    walk(s, guards)
+                return
+            if isinstance(node, ast.Raise):
+                t = _raised_type(node)
+                if t is not None:
+                    events.append(_Ev(node, guards, "raise",
+                                      types=frozenset({t})))
+            if isinstance(node, ast.Call):
+                classify_call(node, guards)
+            for child in ast.iter_child_nodes(node):
+                walk(child, guards)
+
+        body = fi.node.body if isinstance(
+            fi.node, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ) else [fi.node]
+        for stmt in body:
+            walk(stmt, ())
+        self.events[fi.key] = events
+        self.tries[fi.key] = tries
+
+    # -- fixpoint ------------------------------------------------------------
+
+    def event_types(self, ev: _Ev) -> dict:
+        """Types an event may produce -> (via callee or None, desc)."""
+        if ev.kind in ("raise", "api"):
+            return {t: (None, ev.desc) for t in ev.types}
+        if ev.callee is not None:
+            esc = self.escapes.get(ev.callee, {})
+            return {t: (ev.callee, "") for t in esc}
+        return {}
+
+    def absorbed(self, t: str, guards) -> bool:
+        """True when some enclosing non-reraising handler catches ``t``
+        (a first-matching handler that re-raises stays transparent)."""
+        for g in guards:
+            for names, reraises, _ in g.handlers:
+                if self.catches(names, t):
+                    if not reraises:
+                        return True
+                    break   # first match re-raises: continue outward
+        return False
+
+    def _fixpoint(self) -> None:
+        changed = True
+        while changed:
+            changed = False
+            for key, events in self.events.items():
+                esc = self.escapes[key]
+                opened = self.open_direct.get(key, False)
+                for ev in events:
+                    if ev.kind == "call" and ev.callee is not None and \
+                            self.open.get(ev.callee, False):
+                        opened = True
+                    for t, (via, desc) in self.event_types(ev).items():
+                        if t in esc or self.absorbed(t, ev.guards):
+                            continue
+                        esc[t] = (ev.node.lineno, via, desc)
+                        changed = True
+                if opened and not self.open.get(key, False):
+                    self.open[key] = True
+                    changed = True
+
+    # -- queries -------------------------------------------------------------
+
+    def arrivals(self, fn_key: str, try_node, handlers) -> dict:
+        """Types arriving AT a given try's handler clause from its body:
+        event types surviving guards INSIDE the try, keyed to the handler
+        index that first matches (or absorbed earlier -> dropped)."""
+        out: dict = {}   # type -> (handler index, ev)
+        tid = id(try_node)
+        for ev in self.events.get(fn_key, ()):
+            pos = next((i for i, g in enumerate(ev.guards)
+                        if g.try_id == tid), None)
+            if pos is None:
+                continue
+            inner = ev.guards[pos + 1:]
+            for t, (via, desc) in self.event_types(ev).items():
+                if self.absorbed(t, inner):
+                    continue
+                for hi, (names, _, _) in enumerate(handlers):
+                    if self.catches(names, t):
+                        if t not in out:
+                            out[t] = (hi, ev, via, desc)
+                        break
+        return out
+
+    def try_is_closed(self, fn_key: str, try_node) -> bool:
+        """Closed-world test for HG1002: every call event under this try
+        resolves to a known raiser or a transitively-closed function."""
+        tid = id(try_node)
+        for ev in self.events.get(fn_key, ()):
+            if not any(g.try_id == tid for g in ev.guards):
+                continue
+            if ev.kind != "call":
+                continue
+            if ev.unknown:
+                return False
+            if ev.callee is not None and self.open.get(ev.callee, False):
+                return False
+        return True
+
+    def witness(self, fn_key: str, t: str, via, desc, limit: int = 5) -> str:
+        """``caller -> callee -> ... -> origin`` chain for type ``t``."""
+        names = [_short(fn_key)]
+        cur = via
+        tail = desc
+        while cur is not None and len(names) < limit:
+            names.append(_short(cur))
+            ln, nxt, d = self.escapes.get(cur, {}).get(t, (0, None, ""))
+            tail = d or tail
+            cur = nxt
+        chain = " -> ".join(names)
+        if tail:
+            chain += f" ({tail})"
+        return chain
+
+
+# ------------------------------------------------------------------- HG1001
+
+
+def _swallowed_kills(cg: CallGraph, model: RaiseModel) -> list:
+    findings = []
+    for key, fi in sorted(cg.functions.items()):
+        for try_node, handlers in model.tries.get(key, ()):
+            arrivals = None
+            for hi, (names, reraises, h) in enumerate(handlers):
+                if reraises:
+                    continue
+                if not model.catches(names, "InjectedCrash"):
+                    continue
+                if arrivals is None:
+                    arrivals = model.arrivals(key, try_node, handlers)
+                hit = arrivals.get("InjectedCrash")
+                if hit is None or hit[0] != hi:
+                    continue
+                _, ev, via, desc = hit
+                chain = model.witness(key, "InjectedCrash", via, desc)
+                spelled = "bare except" if h.type is None else \
+                    f"except {_spell(h.type)}"
+                findings.append(Finding(
+                    rule="HG1001", path=fi.mod.path, line=h.lineno,
+                    scope=fi.qualpath,
+                    message=f"`{spelled}` swallows `InjectedCrash` "
+                            f"(raised at line {ev.node.lineno} via "
+                            f"{chain}) without re-raising — a swallowed "
+                            f"simulated kill silently invalidates every "
+                            f"recovery drill; re-raise non-Exception "
+                            f"errors (`if not isinstance(e, Exception): "
+                            f"raise`)",
+                ))
+    return findings
+
+
+# ------------------------------------------------------------------- HG1002
+
+
+def _dead_typed_handlers(cg: CallGraph, model: RaiseModel) -> list:
+    findings = []
+    for key, fi in sorted(cg.functions.items()):
+        for try_node, handlers in model.tries.get(key, ()):
+            arrivals = None
+            for hi, (names, _, h) in enumerate(handlers):
+                # typed FAULT handlers only: every caught name sits in the
+                # FaultError taxonomy (broad/builtin catches are HG1005's
+                # territory, not dead-code candidates)
+                if not names or not all(
+                    "FaultError" in model.ancestry(n) for n in names
+                ):
+                    continue
+                if not model.try_is_closed(key, try_node):
+                    continue
+                if arrivals is None:
+                    arrivals = model.arrivals(key, try_node, handlers)
+                if any(idx == hi for idx, _, _, _ in arrivals.values()):
+                    continue
+                findings.append(Finding(
+                    rule="HG1002", path=fi.mod.path, line=h.lineno,
+                    scope=fi.qualpath,
+                    message=f"dead typed handler `except {_spell(h.type)}`"
+                            f" — the guarded calls' inferred raise-set "
+                            f"{_fmt_types(arrivals) or '(empty)'} cannot "
+                            f"contain it; the recovery it documents can "
+                            f"never run",
+                ))
+    return findings
+
+
+def _fmt_types(arrivals: dict) -> str:
+    if not arrivals:
+        return ""
+    return "{" + ", ".join(sorted(arrivals)) + "}"
+
+
+# ------------------------------------------------------------------- HG1003
+
+
+def _retry_discipline(cg: CallGraph, model: RaiseModel) -> list:
+    findings = []
+    for key, fi in sorted(cg.functions.items()):
+        loops = [n for n in ast.walk(fi.node)
+                 if isinstance(n, (ast.While, ast.For))]
+        if not loops:
+            continue
+        for try_node, handlers in model.tries.get(key, ()):
+            loop = next(
+                (lp for lp in loops
+                 if any(n is try_node for n in ast.walk(lp))), None,
+            )
+            if loop is None:
+                continue
+            arrivals = None
+            for hi, (names, reraises, h) in enumerate(handlers):
+                if reraises or not _handler_retries(h):
+                    continue
+                explicit = sorted(
+                    n for n in names if model.transience(n) is False
+                )
+                if explicit:
+                    findings.append(Finding(
+                        rule="HG1003", path=fi.mod.path, line=h.lineno,
+                        scope=fi.qualpath,
+                        message=f"retry loop catches non-transient "
+                                f"{_fmt_set(explicit)} and re-attempts — "
+                                f"retrying a permanent failure burns the "
+                                f"caller's deadline for nothing; re-raise "
+                                f"or fail the ticket instead",
+                    ))
+                    continue
+                if not _is_broad(names, model) or \
+                        _has_transience_guard(h):
+                    continue
+                if arrivals is None:
+                    arrivals = model.arrivals(key, try_node, handlers)
+                perm = sorted(
+                    t for t, (idx, _, _, _) in arrivals.items()
+                    if idx == hi and model.transience(t) is False
+                )
+                if perm:
+                    findings.append(Finding(
+                        rule="HG1003", path=fi.mod.path, line=h.lineno,
+                        scope=fi.qualpath,
+                        message=f"broad retry handler re-attempts "
+                                f"provably non-transient {_fmt_set(perm)} "
+                                f"raised in the loop body — gate the "
+                                f"retry on `is_transient(e)` (or catch "
+                                f"the transient types only)",
+                    ))
+    return findings
+
+
+def _handler_retries(h: ast.ExceptHandler) -> bool:
+    """True when the handler leads to another loop iteration: an explicit
+    ``continue``, or a fall-through body with no raise/return/break."""
+    for s in h.body:
+        for n in ast.walk(s):
+            if isinstance(n, ast.Continue):
+                return True
+    for s in h.body:
+        for n in ast.walk(s):
+            if isinstance(n, (ast.Raise, ast.Return, ast.Break)):
+                return False
+    return True
+
+
+def _is_broad(names, model: RaiseModel) -> bool:
+    return any(
+        n in ("Exception", "BaseException") or
+        model.catches(frozenset({n}), "PermanentFault")
+        for n in names
+    )
+
+
+def _has_transience_guard(h: ast.ExceptHandler) -> bool:
+    for s in h.body:
+        for n in ast.walk(s):
+            if isinstance(n, ast.Call) and (
+                (isinstance(n.func, ast.Name) and
+                 n.func.id == "is_transient") or
+                (isinstance(n.func, ast.Attribute) and
+                 n.func.attr == "is_transient")
+            ):
+                return True
+            if isinstance(n, ast.Attribute) and n.attr == "transient":
+                return True
+    return False
+
+
+# ------------------------------------------------------------------- HG1004
+
+
+def _entry_point_guards(cg: CallGraph, model: RaiseModel) -> list:
+    from tools.hglint.rules_lifecycle import _thread_targets
+
+    findings = []
+    for key in sorted(_thread_targets(cg)):
+        fi = cg.functions.get(key)
+        if fi is None:
+            continue
+        esc = {
+            t: v for t, v in model.escapes.get(key, {}).items()
+            if not model.base_only(t)
+        }
+        if not esc:
+            continue
+        t = sorted(esc)[0]
+        line, via, desc = esc[t]
+        chain = model.witness(key, t, via, desc)
+        findings.append(Finding(
+            rule="HG1004", path=fi.mod.path, line=fi.lineno,
+            scope=fi.qualpath,
+            message=f"thread target `{fi.qualpath}` lets "
+                    f"{_fmt_set(sorted(esc))} escape (e.g. line {line} "
+                    f"via {chain}) — one raise kills the thread and "
+                    f"strands its tickets/queue; guard the body with a "
+                    f"broad except that resolves them (kills excepted)",
+        ))
+    return findings
+
+
+# ------------------------------------------------------------------- HG1005
+
+
+def _swallow_evidence(cg: CallGraph, model: RaiseModel) -> list:
+    findings = []
+    for key, fi in sorted(cg.functions.items()):
+        for try_node, handlers in model.tries.get(key, ()):
+            for names, reraises, h in handlers:
+                if reraises:
+                    continue
+                if not ("Exception" in names or "BaseException" in names):
+                    continue
+                if _handler_has_evidence(h):
+                    continue
+                spelled = "bare except" if h.type is None else \
+                    f"except {_spell(h.type)}"
+                findings.append(Finding(
+                    rule="HG1005", path=fi.mod.path, line=h.lineno,
+                    scope=fi.qualpath,
+                    message=f"`{spelled}` swallows the error with no "
+                            f"evidence — no re-raise, log, counter, "
+                            f"ticket resolution, or typed fallback; a "
+                            f"silent swallow here turns a failure into "
+                            f"a stuck request",
+                ))
+    return findings
+
+
+def _handler_has_evidence(h: ast.ExceptHandler) -> bool:
+    bound = h.name
+    for s in h.body:
+        for n in ast.walk(s):
+            if isinstance(n, (ast.Continue, ast.Break, ast.Return,
+                              ast.Yield, ast.YieldFrom, ast.Delete)):
+                return True   # loop control / an explicit fallback result
+                # is a DECISION — the caller's contract includes it
+            if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                return True   # fallback binding the fall-through code uses
+            if bound and isinstance(n, ast.Name) and n.id == bound and \
+                    isinstance(n.ctx, ast.Load):
+                return True   # the exception object is captured/used
+            if isinstance(n, ast.Call):
+                f = n.func
+                attr = f.attr if isinstance(f, ast.Attribute) else (
+                    f.id if isinstance(f, ast.Name) else None
+                )
+                if attr is None:
+                    continue
+                if attr in EVIDENCE_METHODS:
+                    return True
+                low = attr.lower()
+                if low.startswith(("log", "fail", "record", "emit")):
+                    return True
+    return False
+
+
+# ------------------------------------------------------------------ helpers
+
+
+def _type_name(node: ast.AST) -> Optional[str]:
+    """``TransientFault`` / ``errors.TransientFault`` -> short type name;
+    None for anything that doesn't look like an exception class."""
+    if isinstance(node, ast.Attribute):
+        name = node.attr
+    elif isinstance(node, ast.Name):
+        name = node.id
+    else:
+        return None
+    return name if name[:1].isupper() else None
+
+
+def _raised_type(node: ast.Raise) -> Optional[str]:
+    exc = node.exc
+    if exc is None:
+        return None           # bare re-raise: handled via guard reraises
+    if isinstance(exc, ast.Call):
+        exc = exc.func
+    return _type_name(exc)
+
+
+def _known_api(node: ast.Call, fi) -> Optional[tuple]:
+    """(raise types, description) for known-raising runtime APIs the call
+    graph cannot resolve (receiver-typed method calls)."""
+    func = node.func
+    fqn = resolve_fqn(func, fi.mod)
+    if fqn in ("urllib.request.urlopen", "socket.create_connection"):
+        return TRANSPORT_RAISES, f"{fqn} (transport)"
+    if not isinstance(func, ast.Attribute):
+        return None
+    attr = func.attr
+    if attr == "check" and node.args and \
+            isinstance(node.args[0], ast.Constant) and \
+            isinstance(node.args[0].value, str) and \
+            "." in node.args[0].value:
+        return FAULT_POINT_RAISES, (
+            f"fault point {node.args[0].value!r}"
+        )
+    if attr in TRANSPORT_METHODS:
+        return TRANSPORT_RAISES, f".{attr} (transport)"
+    if attr.startswith("submit"):
+        return SUBMIT_RAISES, f".{attr} (submit entry)"
+    return None
+
+
+def _closed_call(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id in CLOSED_FUNCS
+    if isinstance(f, ast.Attribute):
+        return f.attr in CLOSED_METHODS
+    return False
+
+
+def _spell(node) -> str:
+    if node is None:
+        return ""
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover
+        return "<type>"
+
+
+def _fmt_set(names) -> str:
+    return "{" + ", ".join(f"`{n}`" for n in names) + "}"
+
+
+def _short(key: str) -> str:
+    return key.rsplit(".", 1)[-1] if "." in key else key

@@ -253,20 +253,22 @@ def _resource_exception_edges(cg: CallGraph) -> list:
         closes: dict = {}     # name -> [close Call nodes]
         for node in _own_scope(fi.node):
             if isinstance(node, ast.Assign) and len(node.targets) == 1 \
-                    and isinstance(node.targets[0], ast.Name) \
                     and isinstance(node.value, ast.Call):
-                name = node.targets[0].id
-                if name not in acquires:
-                    acquires[name] = (
-                        node.lineno,
-                        getattr(node, "end_lineno", node.lineno),
-                        _spelling(node.value.func),
-                    )
+                # single-name, tuple-unpack (``sock, addr = accept()``),
+                # and attribute (``self._sock = socket(...)``) targets
+                for name in _target_names(node.targets[0]):
+                    if name not in acquires:
+                        acquires[name] = (
+                            node.lineno,
+                            getattr(node, "end_lineno", node.lineno),
+                            _spelling(node.value.func),
+                        )
             if isinstance(node, ast.Call) and \
                     isinstance(node.func, ast.Attribute) and \
-                    node.func.attr in CLOSE_METHODS and \
-                    isinstance(node.func.value, ast.Name):
-                closes.setdefault(node.func.value.id, []).append(node)
+                    node.func.attr in CLOSE_METHODS:
+                recv = _receiver_name(node.func.value)
+                if recv is not None:
+                    closes.setdefault(recv, []).append(node)
         if not closes:
             continue
         protected_ids = _protected_node_ids(fi.node)
@@ -298,6 +300,30 @@ def _resource_exception_edges(cg: CallGraph) -> list:
     return findings
 
 
+def _target_names(tgt: ast.AST) -> list:
+    """Assign target -> trackable resource names: ``s`` for a Name,
+    each element of a tuple unpack, ``self._sock`` for an attribute."""
+    if isinstance(tgt, ast.Name):
+        return [tgt.id]
+    if isinstance(tgt, (ast.Tuple, ast.List)):
+        out = []
+        for e in tgt.elts:
+            out.extend(_target_names(e))
+        return out
+    recv = _receiver_name(tgt)
+    return [recv] if recv is not None else []
+
+
+def _receiver_name(expr: ast.AST) -> Optional[str]:
+    """``s`` / ``self._sock`` -> a dotted tracking name (one attribute
+    hop only: deeper chains are another object's lifecycle)."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+        return f"{expr.value.id}.{expr.attr}"
+    return None
+
+
 def _protected_node_ids(fn_node: ast.AST) -> set:
     """ids of nodes inside any try ``finally`` or ``except`` body — a
     close there runs on the exception edge."""
@@ -318,12 +344,14 @@ def _with_context_names(fn_node: ast.AST) -> set:
         if isinstance(node, ast.With):
             for item in node.items:
                 ce = item.context_expr
-                if isinstance(ce, ast.Name):
-                    names.add(ce.id)
+                recv = _receiver_name(ce)
+                if recv is not None:
+                    names.add(recv)
                 elif isinstance(ce, ast.Call):
                     for a in ce.args:
-                        if isinstance(a, ast.Name):
-                            names.add(a.id)   # closing(x) / ExitStack(x)
+                        r = _receiver_name(a)
+                        if r is not None:
+                            names.add(r)   # closing(x) / ExitStack(x)
                 if isinstance(item.optional_vars, ast.Name):
                     names.add(item.optional_vars.id)
     return names

@@ -42,6 +42,7 @@ def check(cg: CallGraph, modules: list) -> list:
     acquires, edges = _acquire_analysis(cg, reg)
     findings = _cycles(edges, reg)
     findings += _unlocked_mutations(cg, reg)
+    findings += _unlocked_contract_calls(cg, reg)
     return findings
 
 
@@ -249,6 +250,60 @@ def _scan_body(cg, fi, node, held, direct, held_calls, held_acquires, reg,
             continue
         _scan_body(cg, fi, child, held, direct, held_calls, held_acquires,
                    reg, held_sites)
+
+
+def _unlocked_contract_calls(cg: CallGraph, reg: LockRegistry) -> list:
+    """HG403 — the INVERSE ``*_locked`` contract: the suffix promises
+    "caller already holds the lock", so a call site where the hold
+    tracker proves NO registered lock is held breaks the promise (the
+    leaf's unsynchronized reads/writes race).  Exempt callers: functions
+    themselves named ``*_locked`` (their OWN caller holds it) and the
+    single-threaded EXEMPT_METHODS (``__init__`` & co.)."""
+    held_sites = function_held_sites(cg, reg)
+    findings = []
+    for key, fi in sorted(cg.functions.items()):
+        caller = fi.qualpath.rsplit(".", 1)[-1]
+        if caller.endswith("_locked") or caller in EXEMPT_METHODS:
+            continue
+        held_ids = {id(node) for _, node in held_sites.get(key, ())}
+        for node in _own_calls(fi.node):
+            if id(node) in held_ids:
+                continue
+            site = CallSite(node=node, fn_key=key, mod=fi.mod)
+            callee = cg.resolve_callable(node.func, site)
+            cfi = cg.functions.get(callee) if callee else None
+            if cfi is None or not \
+                    cfi.qualpath.rsplit(".", 1)[-1].endswith("_locked"):
+                continue
+            findings.append(Finding(
+                rule="HG403", path=fi.mod.path, line=node.lineno,
+                scope=fi.qualpath,
+                message=f"`{cfi.qualpath}` promises caller-held locking "
+                        f"(`*_locked` contract) but `{fi.qualpath}` "
+                        f"calls it holding no registered lock — take the "
+                        f"owning lock (or rename the callee if it truly "
+                        f"needs none)",
+            ))
+    return findings
+
+
+def _own_calls(fn_node: ast.AST):
+    """Call nodes in a function's own scope (nested defs/lambdas are
+    their own functions with their own hold contexts)."""
+    out: list = []
+
+    def walk(node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)) and \
+                node is not fn_node:
+            return
+        if isinstance(node, ast.Call):
+            out.append(node)
+        for child in ast.iter_child_nodes(node):
+            walk(child)
+
+    walk(fn_node)
+    return out
 
 
 def function_held_sites(cg: CallGraph, reg: LockRegistry) -> dict:

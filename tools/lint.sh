@@ -18,6 +18,8 @@
 # Usage: tools/lint.sh [extra hglint args]
 #   tools/lint.sh --severity error     # only hard errors
 #   tools/lint.sh --only HG5           # one rule family, fast local run
+#   tools/lint.sh --only HG10          # exception-flow family only
+#                                      # (family-aware: never HG101-107)
 #   tools/lint.sh --output json        # machine-readable CI report
 #   tools/lint.sh --pre-commit         # fast lane: findings only in files
 #                                      # changed vs HEAD (analysis stays
