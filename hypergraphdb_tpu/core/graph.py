@@ -46,6 +46,9 @@ from hypergraphdb_tpu.utils.cache import LRUCache
 
 _FLAG_LINK = 1
 
+#: partitions.json on-disk layout marker; pre-versioning markers parse as 1
+_PARTITION_MARKER_VERSION = 1
+
 #: index names for the two system indices
 IDX_BY_TYPE = "hg.bytype"
 IDX_BY_VALUE = "hg.byvalue"
@@ -192,11 +195,24 @@ class HyperGraph:
                 marker = os.path.join(loc, "partitions.json")
                 if os.path.exists(marker):
                     with open(marker, encoding="utf-8") as f:
-                        n = int(json.load(f)["n_partitions"])
+                        rec = json.load(f)
+                    # pre-versioning markers (no stamp) parse as 1; an
+                    # UNKNOWN layout version must hard-fail — guessing
+                    # n here would silently mis-route every record
+                    if rec.get("schema_version", 1) != _PARTITION_MARKER_VERSION:
+                        raise HGException(
+                            f"unsupported partition-marker schema in "
+                            f"{marker}; this build reads version "
+                            f"{_PARTITION_MARKER_VERSION}"
+                        )
+                    n = int(rec["n_partitions"])
                 else:
                     n = int(config.n_partitions)
                     with open(marker, "w", encoding="utf-8") as f:
-                        json.dump({"n_partitions": n}, f)
+                        json.dump({
+                            "schema_version": _PARTITION_MARKER_VERSION,
+                            "n_partitions": n,
+                        }, f)
                 return PartitionedStorage(
                     n_partitions=n,
                     factory=lambda i: NativeStorage(

@@ -58,6 +58,9 @@ from hypergraphdb_tpu.obs.registry import Registry
 #: committed baseline file schema (the reader rejects unknown versions)
 BASELINE_SCHEMA_VERSION = 1
 
+#: PROFILE.json capture-manifest format (see _write_manifest)
+MANIFEST_SCHEMA_VERSION = 1
+
 #: default committed baseline filename (next to the repo's BENCH_C* files)
 BASELINE_FILENAME = "PERF_BASELINE.json"
 
@@ -822,6 +825,9 @@ class PerfSentinel:
             rec["t0"] = t0
         if t1 is not None:
             rec["t1"] = t1
+        # stamped AFTER the merge so a pre-versioning manifest on disk
+        # cannot strip the stamp from the rewrite
+        rec["schema_version"] = MANIFEST_SCHEMA_VERSION
         try:
             with open(path, "w") as f:
                 json.dump(rec, f, indent=2, sort_keys=True)

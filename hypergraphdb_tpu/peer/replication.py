@@ -26,6 +26,9 @@ from hypergraphdb_tpu.peer import messages as M
 from hypergraphdb_tpu.peer import transfer
 from hypergraphdb_tpu.query import serialize as qser
 
+#: redelivery-journal record format; pre-versioning journals parse as 1
+JOURNAL_SCHEMA_VERSION = 1
+
 
 class OpLog:
     """Append-only log of local mutations (one per peer).
@@ -995,6 +998,12 @@ class Replication:
                     if not line:
                         continue
                     rec = json.loads(line)
+                    # pre-versioning journals (no stamp) default to 1;
+                    # a FUTURE stamp is skipped, not guessed at — losing
+                    # a redelivery is recoverable (catch-up), a
+                    # mis-parsed one is not
+                    if rec.get("schema_version", 1) != JOURNAL_SCHEMA_VERSION:
+                        continue
                     pid = rec["pid"]
                     q = self._redelivery.get(pid)
                     if q is None:
@@ -1027,7 +1036,8 @@ class Replication:
         for pid, q in self._redelivery.items():
             for msg, attempt in q:
                 lines.append(json.dumps(
-                    {"pid": pid, "attempt": attempt, "message": msg},
+                    {"schema_version": JOURNAL_SCHEMA_VERSION,
+                     "pid": pid, "attempt": attempt, "message": msg},
                     sort_keys=True,
                 ))
         data = "".join(line + "\n" for line in lines).encode("utf-8")

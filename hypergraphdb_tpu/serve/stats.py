@@ -71,6 +71,10 @@ LANE_NAMES = tuple(
 #: breaker instruments are the one DYNAMIC family on top:
 #: ``serve.breaker.state.<key>`` / ``serve.breaker.trips.<key>``
 #: (:data:`BREAKER_KEY_PREFIX`), created on a key's first transition.
+#: BOTH names are load-bearing for static checking: hglint HG1105
+#: evaluates ``DOTTED_NAMES`` (and any ``*_PREFIX`` constant) by AST and
+#: flags literal metric sites outside the registry — renaming either
+#: constant silently drops that coverage.
 DOTTED_NAMES = LANE_NAMES + (
     "serve.join.hub_dispatches",
     "serve.join.partial_corrections",

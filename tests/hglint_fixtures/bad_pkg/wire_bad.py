@@ -1,0 +1,80 @@
+"""Wire-contract violations (HG11xx family), one per rule.
+
+Each section below breaks exactly one cross-boundary contract the hgwire
+producer/consumer model can prove wrong. Expected findings are pinned by
+line in tests/test_hglint_wire.py; the clean twin is
+clean_pkg/wire_ok.py.
+"""
+import json
+
+
+# -- HG1101: payload arity drift on a queue channel ----------------------
+
+
+class Redelivery:
+    def __init__(self):
+        self._q = []
+
+    def enqueue(self, message, attempt):
+        self._q.append((message, attempt))
+
+    def drain(self):
+        out = []
+        # HG1101: unpacks 3 values from a channel packed with 2-tuples
+        for message, attempt, deadline in self._q:
+            out.append(message)
+        return out
+
+
+# -- HG1102: consumer hard-reads a key no producer writes ----------------
+
+
+def ping(link, seq):
+    link.send({"what": "wire-ping", "seq": seq, "host": "a"})
+
+
+def on_message(content):
+    if content.get("what") == "wire-ping":
+        host = content.get("host")
+        deadline = content["deadline"]  # HG1102: never produced
+        return content["seq"], host, deadline
+    return None
+
+
+# -- HG1103: persisted JSON record with no schema-version stamp ----------
+
+
+def save_ledger(path, entries):
+    rec = {"entries": entries, "source": "wire"}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(rec, f)  # HG1103: no schema_version stamp
+
+
+# -- HG1104: wire-table misses a member of the mapped error family -------
+
+
+class WireErr(Exception):
+    pass
+
+
+class WireTimeout(WireErr):
+    pass
+
+
+class WireRefused(WireErr):
+    pass
+
+
+_WIRE_STATUS = (  # HG1104: WireRefused falls through to the generic 500
+    (WireTimeout, 504),
+)
+
+
+# -- HG1105: metric site absent from the governing registry --------------
+
+
+DOTTED_NAMES = ("wire.sent", "wire.acked")
+
+
+def bump(metrics):
+    metrics.incr("wire.sentt")  # HG1105: typo, not in DOTTED_NAMES

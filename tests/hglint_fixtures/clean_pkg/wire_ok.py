@@ -1,0 +1,103 @@
+"""Wire-contract near-misses that must stay silent (HG11xx family).
+
+Mirror of bad_pkg/wire_bad.py: the same shapes with the contracts kept —
+matched arities (including a tolerant starred unpack), consumed envelope
+keys, a stamped and version-checked artifact, a covering error table
+with a faithful round-trip, and registry-vocabulary metric names.
+"""
+import json
+
+LEDGER_SCHEMA_VERSION = 1
+
+DOTTED_NAMES = ("wireok.sent", "wireok.acked")
+WIREOK_LANE_PREFIX = "wireok.lane."
+
+
+# -- HG1101 twin: matched arity + a starred (tolerant) consumer ----------
+
+
+class Redelivery:
+    def __init__(self):
+        self._q = []
+        self._wide = []
+
+    def enqueue(self, message, attempt):
+        self._q.append((message, attempt))
+        self._wide.append((message, attempt, 0.0))
+
+    def drain(self):
+        out = []
+        for message, attempt in self._q:
+            out.append(message)
+        for message, *rest in self._wide:
+            out.append(message)
+        return out
+
+
+# -- HG1102 twin: every hard-read key is produced ------------------------
+
+
+def ping(link, seq):
+    link.send({"what": "wireok-ping", "seq": seq, "note": "n"})
+
+
+def on_message(content):
+    if content.get("what") == "wireok-ping":
+        return content["seq"], content.get("note")
+    return None
+
+
+# -- HG1103 twin: stamped writer, version-checked reader -----------------
+
+
+def save_ledger(path, entries):
+    rec = {"schema_version": LEDGER_SCHEMA_VERSION, "entries": entries}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(rec, f)
+
+
+def load_ledger(path):
+    with open(path, encoding="utf-8") as f:
+        rec = json.load(f)
+    if rec.get("schema_version") != LEDGER_SCHEMA_VERSION:
+        return None
+    return rec["entries"]
+
+
+# -- HG1104 twin: covering table + faithful round-trip -------------------
+
+
+class WireOkErr(Exception):
+    pass
+
+
+class WireOkTimeout(WireOkErr):
+    pass
+
+
+class WireOkRefused(WireOkErr):
+    pass
+
+
+_WIREOK_STATUS = (
+    (WireOkTimeout, 504),
+    (WireOkRefused, 503),
+)
+
+
+def rehydrate(body):
+    kind = body.get("error")
+    if kind == "WireOkTimeout":
+        raise WireOkTimeout(body)
+    if kind == "WireOkRefused":
+        raise WireOkRefused(body)
+    return None
+
+
+# -- HG1105 twin: registry names and a registered dynamic prefix ---------
+
+
+def bump(metrics, lane):
+    metrics.incr("wireok.sent")
+    metrics.incr("wireok.lane.push")
+    metrics.gauge(WIREOK_LANE_PREFIX + lane, 1)

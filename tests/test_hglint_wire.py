@@ -1,0 +1,218 @@
+"""Precision pins for the hgwire rule family (HG11xx wire-contract
+analysis).
+
+Four jobs, mirroring tests/test_hglint_exc.py:
+
+1. pin the seeded wire fixtures exactly — rule AND line — so a
+   precision regression in either direction (missed drift, new false
+   positive) fails loudly;
+2. pin the diagnostics' CONTENT: channel names, producer witnesses,
+   and remediation hints a reviewer needs to judge the finding;
+3. prove HG1105 agrees with the runtime metric-drift gate: the
+   AST-evaluated registry vocabulary equals the imported
+   ``DOTTED_NAMES``, so the static rule and the runtime test can never
+   disagree about what "registered" means;
+4. act as the zero-baseline gate: ``hypergraphdb_tpu`` must carry NO
+   HG11xx findings — wire drift gets fixed (or pragma-audited), never
+   baselined.
+"""
+
+import sys
+import textwrap
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.hglint import run_lint  # noqa: E402
+from tools.hglint.loader import discover_modules  # noqa: E402
+from tools.hglint.model import rule_matches  # noqa: E402
+from tools.hglint.rules_wire import collect_registries  # noqa: E402
+
+FIXTURES = Path(__file__).parent / "hglint_fixtures"
+BAD = FIXTURES / "bad_pkg" / "wire_bad.py"
+OK = FIXTURES / "clean_pkg" / "wire_ok.py"
+
+
+def _pins(findings):
+    return sorted((f.rule, f.line) for f in findings)
+
+
+# ------------------------------------------------------------- exact pins
+
+
+def test_wire_bad_exact_rule_and_line():
+    findings = run_lint([str(BAD)], only="HG11")
+    assert _pins(findings) == [
+        ("HG1101", 24),   # 3-unpack of a channel packed with 2-tuples
+        ("HG1102", 37),   # hard-read of a key no producer writes
+        ("HG1103", 50),   # persisted record with no schema-version stamp
+        ("HG1104", 68),   # WireRefused missing from the status table
+        ("HG1105", 80),   # metric name absent from DOTTED_NAMES
+    ], "\n".join(f.render() for f in findings)
+
+
+def test_each_rule_fires_exactly_once():
+    findings = run_lint([str(BAD)], only="HG11")
+    rules = sorted(f.rule for f in findings)
+    assert rules == ["HG1101", "HG1102", "HG1103", "HG1104", "HG1105"]
+    assert all(f.severity == "error" for f in findings)
+
+
+def test_wire_clean_shapes_are_silent():
+    # EVERY near-miss must stay silent: matched arity, a tolerant
+    # starred unpack, produced keys, a stamped+checked artifact, a
+    # covering table with a faithful round-trip, registry metric names
+    # and a registered dynamic prefix
+    findings = run_lint([str(OK)])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# ----------------------------------------------------- diagnostic content
+
+
+def test_arity_drift_names_channel_and_producer_witness():
+    findings = run_lint([str(BAD)], only="HG1101")
+    (hit,) = findings
+    assert hit.scope == "Redelivery.drain"
+    assert "wire_bad.Redelivery._q" in hit.message       # merged channel
+    assert "needs exactly 3 values" in hit.message
+    assert "`Redelivery.enqueue` packs 2-tuples" in hit.message
+    assert "wire_bad.py:19" in hit.message               # pack-site witness
+
+
+def test_envelope_drift_names_kind_and_key():
+    findings = run_lint([str(BAD)], only="HG1102")
+    (hit,) = findings
+    assert "kind 'wire-ping'" in hit.message
+    assert "'deadline'" in hit.message
+    assert "KeyError in waiting" in hit.message
+    assert "`.get()`" in hit.message                     # the tolerant out
+
+
+def test_dead_field_is_a_warning_not_an_error(tmp_path):
+    # a produced-but-never-read key is drift evidence, not a crash:
+    # severity must stay "warning" so it never trips the error gate
+    mod = tmp_path / "dead_field.py"
+    mod.write_text(textwrap.dedent("""\
+        def ping(link):
+            link.send({"what": "df-ping", "seq": 1, "orphan": 2})
+
+
+        def on_message(content):
+            if content.get("what") == "df-ping":
+                return content["seq"]
+            return None
+    """))
+    findings = run_lint([str(mod)], only="HG1102")
+    (hit,) = findings
+    assert hit.severity == "warning"
+    assert "'orphan'" in hit.message
+
+
+def test_unversioned_artifact_lists_record_keys():
+    findings = run_lint([str(BAD)], only="HG1103")
+    (hit,) = findings
+    assert hit.scope == "save_ledger"
+    assert "'entries'" in hit.message and "'source'" in hit.message
+    assert "schema_version/version/format" in hit.message
+
+
+def test_table_drift_names_the_uncovered_type_and_root():
+    findings = run_lint([str(BAD)], only="HG1104")
+    (hit,) = findings
+    assert hit.scope == "<module>"                       # fires at the table
+    assert "`WireRefused`" in hit.message
+    assert "WireErr" in hit.message                      # the family root
+    assert "wire_bad.py:64" in hit.message               # class-def witness
+
+
+def test_metric_drift_names_registry_and_namespace():
+    findings = run_lint([str(BAD)], only="HG1105")
+    (hit,) = findings
+    assert "'wire.sentt'" in hit.message
+    assert "`DOTTED_NAMES`" in hit.message
+    assert "'wire' namespace" in hit.message
+
+
+# --------------------------------------------------------- family scoping
+
+
+def test_only_hg11_selects_the_family_without_aliasing():
+    # "HG11" must mean HG1101–HG1105 and nothing else: the bad_pkg dir
+    # holds fixtures for ten other families, none of which may leak in
+    findings = run_lint([str(FIXTURES / "bad_pkg")], only="HG11")
+    assert findings and all(f.rule.startswith("HG11") for f in findings)
+    assert sorted({f.rule for f in findings}) == [
+        "HG1101", "HG1102", "HG1103", "HG1104", "HG1105",
+    ]
+
+
+def test_rule_matches_is_family_aware_for_hg11():
+    assert rule_matches("HG1101", "HG11")
+    assert rule_matches("HG1105", "HG11")
+    assert not rule_matches("HG1101", "HG1")   # HG1 is exactly the HG1xx
+    # family — a four-digit family never aliases into a three-digit one
+    assert not rule_matches("HG101", "HG11")
+    assert rule_matches("HG1103", "HG1103")
+    assert not rule_matches("HG1103", "HG1101")
+
+
+def test_single_rule_scoping():
+    findings = run_lint([str(BAD)], only="HG1104")
+    assert _pins(findings) == [("HG1104", 68)]
+
+
+# --------------------------------- HG1105 vs the runtime metric-drift gate
+
+
+def test_static_registry_agrees_with_runtime_dotted_names(monkeypatch):
+    """HG1105's vocabulary is the SAME set the runtime drift gate
+    (tests/test_obs.py::test_serve_stats_namespace_no_drift) checks
+    against: the AST evaluation of ``DOTTED_NAMES`` — including the
+    ``tuple(f"..." ...)`` lane comprehension — must equal the imported
+    constant, or the static and runtime gates could disagree."""
+    monkeypatch.chdir(REPO)
+    mods = discover_modules("hypergraphdb_tpu")
+    vocab, prefixes = collect_registries(mods)
+
+    from hypergraphdb_tpu.serve import stats
+
+    assert set(vocab) == set(stats.DOTTED_NAMES)
+    # the one dynamic family (per-endpoint breaker gauges) is governed
+    # by a registered prefix rather than enumerated names
+    assert "serve.breaker." in prefixes
+
+
+def test_seeded_registry_drift_fires_statically(tmp_path):
+    # the same drift the runtime gate would catch at test time (a site
+    # emitting an unregistered name) must fire at lint time
+    mod = tmp_path / "drifted.py"
+    mod.write_text(textwrap.dedent("""\
+        DOTTED_NAMES = ("gate.sent",)
+
+
+        def bump(metrics):
+            metrics.incr("gate.sent")
+            metrics.incr("gate.recv")
+    """))
+    findings = run_lint([str(mod)], only="HG1105")
+    assert _pins(findings) == [("HG1105", 6)]
+    assert "'gate.recv'" in findings[0].message
+
+
+# ------------------------------------------------------ zero-baseline gate
+
+
+def test_repo_carries_zero_wire_findings(monkeypatch):
+    """The hgwire acceptance bar: HG11xx holds a ZERO baseline on the
+    real tree — every unversioned artifact got a schema stamp (pinned in
+    tests/test_wire_fixes.py) and every envelope/arity/table/metric
+    contract holds."""
+    monkeypatch.chdir(REPO)
+    findings = run_lint(["hypergraphdb_tpu"], only="HG11")
+    assert findings == [], (
+        "wire-contract findings must be FIXED, not baselined:\n"
+        + "\n".join(f.render() for f in findings)
+    )

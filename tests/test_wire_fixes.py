@@ -1,0 +1,164 @@
+"""Behavior pins for the HG1103 (persisted-artifact versioning) runtime
+fixes that took the hgwire family to a zero baseline on the real tree.
+
+Three artifacts gained a ``schema_version`` stamp; each fix has the same
+contract, pinned here per artifact:
+
+- a stamped write round-trips through its own reader;
+- a LEGACY (pre-versioning, unstamped) record still parses — it
+  defaults to version 1, so upgrading never strands existing data;
+- a FUTURE stamp is rejected, not guessed at: the redelivery journal
+  skips the record (losing a redelivery is recoverable via catch-up),
+  the partition marker hard-fails (mis-routing every record is not).
+"""
+
+from __future__ import annotations
+
+import json
+import types
+from collections import deque
+
+import pytest
+
+import hypergraphdb_tpu as hg
+from hypergraphdb_tpu.core.errors import HGException
+from hypergraphdb_tpu.obs.perf import (
+    MANIFEST_SCHEMA_VERSION,
+    PerfSentinel,
+    _ProfileSession,
+)
+from hypergraphdb_tpu.peer.replication import (
+    JOURNAL_SCHEMA_VERSION,
+    Replication,
+)
+
+
+# ---------------------------------------------- redelivery journal (peer)
+
+
+def make_replication(journal_path):
+    r = Replication(types.SimpleNamespace(graph=hg.HyperGraph()))
+    r.journal_path = str(journal_path)
+    return r
+
+
+def journal_records(path):
+    with open(path, encoding="utf-8") as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_journal_save_stamps_and_replay_restores(tmp_path):
+    path = tmp_path / "redelivery.jsonl"
+    r = make_replication(path)
+    r._redelivery["peer-x"] = deque(
+        [({"op": "push", "seq": 1}, 1), ({"op": "push", "seq": 2}, 3)]
+    )
+    r._journal_save()
+    recs = journal_records(path)
+    assert [rec["schema_version"] for rec in recs] == [1, 1]
+    assert recs[0]["schema_version"] == JOURNAL_SCHEMA_VERSION
+
+    r2 = make_replication(path)
+    r2._journal_replay()
+    assert dict(r2._redelivery) == {
+        "peer-x": deque([({"op": "push", "seq": 1}, 1),
+                         ({"op": "push", "seq": 2}, 3)]),
+    }
+    assert r2._redelivery_n == 2
+
+
+def test_journal_legacy_unstamped_record_still_replays(tmp_path):
+    # a journal written by a pre-versioning build has no stamp at all:
+    # it must parse as version 1, not be dropped by the upgrade
+    path = tmp_path / "redelivery.jsonl"
+    path.write_text(json.dumps(
+        {"pid": "peer-y", "attempt": 2, "message": {"op": "push"}}) + "\n")
+    r = make_replication(path)
+    r._journal_replay()
+    assert dict(r._redelivery) == {"peer-y": deque([({"op": "push"}, 2)])}
+
+
+def test_journal_future_version_is_skipped_not_guessed(tmp_path):
+    # a future stamp means a newer build wrote fields this one cannot
+    # interpret — skip the record (catch-up repairs the loss), but keep
+    # replaying the records this build DOES understand
+    path = tmp_path / "redelivery.jsonl"
+    path.write_text(
+        json.dumps({"schema_version": 99, "pid": "peer-z", "attempt": 1,
+                    "message": {"op": "push", "seq": 1}}) + "\n"
+        + json.dumps({"schema_version": 1, "pid": "peer-z", "attempt": 1,
+                      "message": {"op": "push", "seq": 2}}) + "\n")
+    r = make_replication(path)
+    r._journal_replay()
+    assert dict(r._redelivery) == {
+        "peer-z": deque([({"op": "push", "seq": 2}, 1)]),
+    }
+    assert r._redelivery_n == 1
+
+
+# ------------------------------------------ PROFILE.json manifest (hgperf)
+
+
+def test_profile_manifest_carries_schema_version(tmp_path):
+    sen = PerfSentinel(eval_interval_s=0.0)
+    session = _ProfileSession(None, str(tmp_path), "bfs", 0.0, False)
+    sen._write_manifest(session, t0=1.0)
+    rec = json.loads((tmp_path / "PROFILE.json").read_text())
+    assert rec["schema_version"] == MANIFEST_SCHEMA_VERSION == 1
+    assert rec["lane"] == "bfs" and rec["t0"] == 1.0
+
+
+def test_profile_manifest_merge_cannot_strip_the_stamp(tmp_path):
+    # the close path merges the on-disk record back in; a PRE-VERSIONING
+    # manifest on disk (no stamp) must not dilute the rewrite — the
+    # stamp is applied after the merge, and the disk t0 survives
+    (tmp_path / "PROFILE.json").write_text(
+        json.dumps({"lane": "bfs", "t0": 1.0, "profiler_active": True,
+                    "bound_s": 2.0}))
+    sen = PerfSentinel(eval_interval_s=0.0)
+    session = _ProfileSession(None, str(tmp_path), "bfs", 0.0, False)
+    sen._write_manifest(session, t1=3.0)
+    rec = json.loads((tmp_path / "PROFILE.json").read_text())
+    assert rec["schema_version"] == MANIFEST_SCHEMA_VERSION
+    assert rec["t0"] == 1.0 and rec["t1"] == 3.0
+
+
+# --------------------------------------- partitions.json marker (storage)
+
+
+def partitioned_cfg(loc, n):
+    return hg.HGConfiguration(store_backend="partitioned",
+                              location=str(loc), n_partitions=n)
+
+
+def test_partition_marker_is_stamped_on_first_open(tmp_path):
+    pytest.importorskip("hypergraphdb_tpu.storage.native")
+    loc = tmp_path / "grid"
+    g = hg.HyperGraph(partitioned_cfg(loc, 3))
+    g.close()
+    rec = json.loads((loc / "partitions.json").read_text())
+    assert rec == {"schema_version": 1, "n_partitions": 3}
+
+
+def test_partition_marker_legacy_unstamped_is_accepted(tmp_path):
+    # a pre-versioning marker parses as version 1 — and its recorded
+    # count still wins over the config (the whole point of the marker)
+    pytest.importorskip("hypergraphdb_tpu.storage.native")
+    loc = tmp_path / "grid"
+    loc.mkdir()
+    (loc / "partitions.json").write_text(json.dumps({"n_partitions": 3}))
+    g = hg.HyperGraph(partitioned_cfg(loc, 5))
+    assert len(g.backend._parts) == 3
+    g.close()
+
+
+def test_partition_marker_future_version_hard_fails(tmp_path):
+    # handle routing is h % n: guessing n under an unknown layout would
+    # silently mis-route every record, so this one REFUSES to open
+    pytest.importorskip("hypergraphdb_tpu.storage.native")
+    loc = tmp_path / "grid"
+    loc.mkdir()
+    (loc / "partitions.json").write_text(
+        json.dumps({"schema_version": 99, "n_partitions": 3}))
+    with pytest.raises(HGException, match="partition-marker schema"):
+        hg.HyperGraph(partitioned_cfg(loc, 3))

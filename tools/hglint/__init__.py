@@ -1,7 +1,7 @@
 """hglint — AST-based JAX/TPU hazard analyzer for the hypergraphdb_tpu
 codebase.
 
-Six rule families (see ``tools.hglint.model.RULES``):
+Rule families (see ``tools.hglint.model.RULES``):
 
 - HG1xx  host syncs reachable from traced (jit/pjit/shard_map/pallas) code,
          donation lifetimes (HG106), host-numpy uploads (HG107)
@@ -16,6 +16,10 @@ Six rule families (see ``tools.hglint.model.RULES``):
 - HG10xx exception flow & failure discipline (interprocedural raise-set
          inference: swallowed kills, dead fault handlers, permanent-fault
          retries, unguarded worker entry points, evidence-free swallows)
+- HG11xx wire-contract analysis (producer/consumer pairing across the
+         process boundary: payload arity drift, envelope-key drift,
+         unversioned persisted artifacts, typed-error wire-table drift,
+         metric-name drift vs the DOTTED_NAMES registry)
 
 Run ``python -m tools.hglint <paths>``; the repo gate is
 ``tools/lint.sh`` (baseline-filtered, exits nonzero on new findings,

@@ -42,7 +42,9 @@ def main(argv=None) -> int:
         prog="hglint",
         description="AST-based JAX/TPU hazard analyzer "
                     "(host-sync, retrace, Pallas tiling, lock-order, VMEM "
-                    "budgets, shard_map collectives, donation lifetimes)",
+                    "budgets, shard_map collectives, donation lifetimes, "
+                    "blocking-under-lock, thread/resource lifecycle, "
+                    "exception-flow discipline, wire contracts)",
     )
     p.add_argument("paths", nargs="*", default=["hypergraphdb_tpu"],
                    help="package dirs / files to analyze "

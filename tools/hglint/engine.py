@@ -19,6 +19,7 @@ from tools.hglint import (
     rules_pallas,
     rules_retrace,
     rules_vmem,
+    rules_wire,
 )
 from tools.hglint.callgraph import CallGraph
 from tools.hglint.loader import discover_modules
@@ -59,6 +60,8 @@ def _runners(cg, modules, interp, vmem_budget):
          lambda: rules_lifecycle.check(cg, modules)),
         (("HG1001", "HG1002", "HG1003", "HG1004", "HG1005"),
          lambda: rules_exceptions.check(cg, modules)),
+        (("HG1101", "HG1102", "HG1103", "HG1104", "HG1105"),
+         lambda: rules_wire.check(cg, modules)),
     ]
 
 

@@ -74,6 +74,21 @@ RULES = {
               "raise strands the loop's tickets/queue",
     "HG1005": "exception swallowed without evidence (no re-raise, log, "
               "counter, or ticket resolution)",
+    # -- family 11: cross-boundary wire-schema & protocol contracts -------------
+    "HG1101": "payload arity drift — a tuple packed at a send/enqueue site "
+              "is unpacked with a different arity by a consumer of the "
+              "same channel",
+    "HG1102": "envelope-key drift — a consumer reads a key no producer "
+              "writes (KeyError in waiting) or a producer writes a key no "
+              "consumer reads (dead field)",
+    "HG1103": "persisted artifact without a schema-version stamp, a "
+              "stamped writer whose reader never version-checks, or "
+              "writer/reader version skew",
+    "HG1104": "typed-error wire-table drift — an exception family member "
+              "missing from the HTTP status/type table, or a wire kind "
+              "rehydrated as a different type",
+    "HG1105": "metric-name drift — a literal dotted metric site absent "
+              "from the governing DOTTED_NAMES registry",
 }
 
 #: rule id -> default severity
@@ -117,6 +132,11 @@ RULE_SEVERITY = {
     "HG1003": "error",
     "HG1004": "warning",
     "HG1005": "warning",
+    "HG1101": "error",
+    "HG1102": "error",
+    "HG1103": "error",
+    "HG1104": "error",
+    "HG1105": "error",
 }
 
 
@@ -140,6 +160,7 @@ DOC_ANCHORS = {
     "HG8": "hg8xx-thread--resource-lifecycle",
     "HG9": "hg9xx-analyzer-hygiene",
     "HG10": "hg10xx-exception-flow--failure-discipline",
+    "HG11": "hg11xx-wire-contract-analysis",
 }
 
 

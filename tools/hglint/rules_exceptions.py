@@ -229,6 +229,13 @@ class RaiseModel:
         #: tuples (``_PERMANENT = (Unservable, PermanentFault, ...)``)
         #: spliced into catch clauses (``except (Deadline, *_PERMANENT)``)
         self.catch_aliases: dict = {}
+        #: in-tree top-level classes, module-qualified ("pkg.mod.Cls")
+        self.class_fqns: set = set()
+        #: (mod name, class name, attr) -> receiver class fqn; None when
+        #: the attr is rebound to anything other than one in-tree class
+        #: (``self.x = None`` placeholders don't poison — they are
+        #: "unset", and calling through an unset receiver crashes anyway)
+        self.receiver_class: dict = {}
         self._index_classes(modules)
         self.events: dict = {}    # fn key -> [_Ev]
         self.tries: dict = {}     # fn key -> [(Try node, [_Guard.handlers])]
@@ -244,6 +251,10 @@ class RaiseModel:
     # -- class / type hierarchy ----------------------------------------------
 
     def _index_classes(self, modules: list) -> None:
+        for mod in modules:
+            for stmt in mod.tree.body:
+                if isinstance(stmt, ast.ClassDef):
+                    self.class_fqns.add(f"{mod.name}.{stmt.name}")
         for mod in modules:
             aliases = self.catch_aliases.setdefault(mod.name, {})
             for stmt in mod.tree.body:
@@ -274,6 +285,65 @@ class RaiseModel:
                         self.transient_attr[node.name] = bool(
                             stmt.value.value
                         )
+        for mod in modules:
+            for cls in mod.tree.body:
+                if isinstance(cls, ast.ClassDef):
+                    self._index_receivers(mod, cls)
+
+    def _index_receivers(self, mod, cls: ast.ClassDef) -> None:
+        """Class-of-receiver inference: ``self.x = Ctor()`` assignments
+        (across ALL of the class's methods) type the receiver attr, so
+        ``self.x.m()`` resolves to ``Ctor.m``'s raise-set instead of
+        opening the world."""
+        for meth in cls.body:
+            if not isinstance(meth, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            for n in ast.walk(meth):
+                if not (isinstance(n, ast.Assign) and
+                        len(n.targets) == 1):
+                    continue
+                t = n.targets[0]
+                if not (isinstance(t, ast.Attribute) and
+                        isinstance(t.value, ast.Name) and
+                        t.value.id == "self"):
+                    continue
+                if isinstance(n.value, ast.Constant) and \
+                        n.value.value is None:
+                    continue  # unset placeholder, not a retype
+                fqn = None
+                if isinstance(n.value, ast.Call):
+                    f = n.value.func
+                    if isinstance(f, ast.Name) and \
+                            f"{mod.name}.{f.id}" in self.class_fqns:
+                        fqn = f"{mod.name}.{f.id}"
+                    else:
+                        r = resolve_fqn(f, mod)
+                        if r in self.class_fqns:
+                            fqn = r
+                key = (mod.name, cls.name, t.attr)
+                if key not in self.receiver_class:
+                    self.receiver_class[key] = fqn
+                elif self.receiver_class[key] != fqn:
+                    self.receiver_class[key] = None
+
+    def receiver_method(self, func, fi) -> Optional[str]:
+        """``self.<attr>.<m>()`` on a receiver typed by
+        :meth:`_index_receivers` -> the method's function key, when the
+        analyzed class defines it."""
+        if not (isinstance(func, ast.Attribute) and
+                isinstance(func.value, ast.Attribute) and
+                isinstance(func.value.value, ast.Name) and
+                func.value.value.id == "self" and
+                fi.cls_name):
+            return None
+        fqn = self.receiver_class.get(
+            (fi.mod.name, fi.cls_name, func.value.attr)
+        )
+        if not fqn:
+            return None
+        cand = f"{fqn}.{func.attr}"
+        return cand if cand in self.cg.functions else None
 
     def ancestry(self, t: str):
         seen = []
@@ -297,14 +367,20 @@ class RaiseModel:
         anc = self.ancestry(t)
         return "Exception" not in anc and "BaseException" in anc
 
-    def transience(self, t: str) -> Optional[bool]:
+    def transience(self, t: str,
+                   extra: frozenset = frozenset()) -> Optional[bool]:
         """True transient / False provably non-transient / None unknown.
-        An explicit ``transient =`` class attribute wins (the runtime's
-        ``is_transient`` order), then the ancestry roots."""
-        for a in self.ancestry(t):
+        An explicit ``transient =`` class attribute anywhere in the MRO
+        wins — the runtime's ``is_transient`` checks ``getattr`` BEFORE
+        ``isinstance(DEFAULT_TRANSIENT + extra)``, so a ``PermanentFault``
+        subclass stays non-transient even when listed in ``extra`` — then
+        the ancestry roots and the call site's ``extra`` tuple."""
+        anc = self.ancestry(t)
+        for a in anc:
             if a in self.transient_attr:
                 return self.transient_attr[a]
-            if a in TRANSIENT_ROOTS:
+        for a in anc:
+            if a in TRANSIENT_ROOTS or a in extra:
                 return True
             if a in NON_TRANSIENT_ROOTS:
                 return False
@@ -364,11 +440,19 @@ class RaiseModel:
                                       desc=desc))
                 elif not _closed_call(node) and \
                         _type_name(node.func) not in self.parent:
-                    # an exception CONSTRUCTOR (`raise ValueError(...)`)
-                    # is not a raising call — the enclosing Raise event
-                    # already carries its type
-                    events.append(_Ev(node, guards, "call", unknown=True))
-                    self.open_direct[fi.key] = True
+                    rk = self.receiver_method(node.func, fi)
+                    if rk is not None:
+                        # receiver-typed: `self.x.m()` where `self.x` is
+                        # provably one in-tree class — a closed edge, not
+                        # an open world
+                        events.append(_Ev(node, guards, "call", callee=rk))
+                    else:
+                        # an exception CONSTRUCTOR (`raise ValueError(...)`)
+                        # is not a raising call — the enclosing Raise event
+                        # already carries its type
+                        events.append(_Ev(node, guards, "call",
+                                          unknown=True))
+                        self.open_direct[fi.key] = True
             # a callable passed as an argument may raise in the caller's
             # context — except thread/timer targets, which run elsewhere
             thread_args = _thread_target_args(site)
@@ -611,8 +695,17 @@ def _retry_discipline(cg: CallGraph, model: RaiseModel) -> list:
             for hi, (names, reraises, h) in enumerate(handlers):
                 if reraises or not _handler_retries(h):
                     continue
+                extra = _handler_extra(
+                    h, model.catch_aliases.get(fi.mod.name, {})
+                )
+                if extra is None:
+                    # an is_transient(..., extra=<unresolvable>) call:
+                    # the handler's transience contract can't be proved
+                    # either way — stay silent (under-approximation)
+                    continue
                 explicit = sorted(
-                    n for n in names if model.transience(n) is False
+                    n for n in names
+                    if model.transience(n, extra) is False
                 )
                 if explicit:
                     findings.append(Finding(
@@ -667,6 +760,41 @@ def _is_broad(names, model: RaiseModel) -> bool:
         model.catches(frozenset({n}), "PermanentFault")
         for n in names
     )
+
+
+def _handler_extra(h: ast.ExceptHandler,
+                   aliases: dict) -> Optional[frozenset]:
+    """The union of ``extra=`` type tuples passed to ``is_transient``
+    calls in the handler body (the runtime widens its transient set per
+    call site: ``is_transient(e, extra=(CacheMiss,))``). Returns a
+    frozenset of type names — empty when no call passes ``extra`` — or
+    None when any ``extra`` argument is unresolvable."""
+    extra: set = set()
+    for s in h.body:
+        for n in ast.walk(s):
+            if not (isinstance(n, ast.Call) and (
+                (isinstance(n.func, ast.Name) and
+                 n.func.id == "is_transient") or
+                (isinstance(n.func, ast.Attribute) and
+                 n.func.attr == "is_transient")
+            )):
+                continue
+            arg = n.args[1] if len(n.args) >= 2 else None
+            for kw in n.keywords:
+                if kw.arg == "extra":
+                    arg = kw.value
+            if arg is None:
+                continue
+            if isinstance(arg, ast.Name) and arg.id in aliases:
+                extra |= set(aliases[arg.id])
+                continue
+            if not isinstance(arg, (ast.Tuple, ast.List)):
+                return None
+            names = [_type_name(e) for e in arg.elts]
+            if any(nm is None for nm in names):
+                return None
+            extra |= set(names)
+    return frozenset(extra)
 
 
 def _has_transience_guard(h: ast.ExceptHandler) -> bool:

@@ -1,0 +1,1463 @@
+"""hgwire: cross-boundary wire-schema & protocol contract checks (HG11xx).
+
+Every family before this one stops at the process boundary; the bugs that
+actually recurred in this tree crossed it — a producer grew its payload
+tuple and every consumer crashed at unpack, a JSONL artifact gained a new
+schema while old readers kept parsing it, an HTTP error table silently
+stopped covering a newly added exception type. hgwire pairs *pack* sites
+with *unpack* sites across modules and checks the contract between them:
+
+``HG1101``  payload arity drift — a tuple packed at a send/enqueue site is
+            unpacked with a different arity by a consumer of the same
+            channel (the PR-9 push-apply crash class, caught at lint time).
+``HG1102``  envelope-key drift — a consumer of a discriminator-keyed
+            message kind hard-reads a key no producer writes
+            (KeyError-in-waiting, error) or a producer writes a key no
+            consumer ever reads (dead field, warning). Tolerant
+            ``.get(k, default)`` reads satisfy the consumer side without
+            counting as a hard dependency.
+``HG1103``  persisted-artifact versioning — a ``json.dump``/JSONL writer
+            whose record carries no schema-version stamp (error); a module
+            that stamps its persisted records but contains a hard-keyed
+            JSON reader that never version-checks (error); a reader whose
+            accepted-version set rejects a version writers emit (error) or
+            admits versions no writer emits (warning).
+``HG1104``  typed-error wire-table drift — an in-tree exception deriving a
+            wire-mapped family root that no HTTP status-table entry
+            covers, or a client-side kind branch that maps a wire error
+            name back to a *different* exception type.
+``HG1105``  metric-name drift — a literal dotted metric site in a
+            namespace governed by a ``DOTTED_NAMES`` registry whose name
+            is absent from that registry (the static twin of the runtime
+            drift-gate test; fires at edit time instead of test time).
+
+Message kinds are inferred from three sources: envelope discriminator keys
+(``"what"``/``"type"``/``"op"``/``"event"``-keyed dict literals at
+``Activity.send``/``reply`` and other produce sites, paired with
+``content.get("what") == "..."`` dispatch branches), queue/journal
+append↔drain pairs (slot channels over ``self.<attr>``/module globals,
+with alias and carrier tracking through ``q = self._slots[pid]`` and
+``batch.append(q.popleft())`` idioms), and tuple-literal arguments flowing
+into named callee parameters (param channels, merged with slot channels
+when a carrier is passed across a call).
+
+Like every hglint family this is a pure-AST whole-program pass: the
+analyzed tree is never imported. Where a payload or record is not
+statically resolvable the analyzer stays silent rather than guessing
+(under-approximation: no finding is still not a proof of consistency).
+Suppressions use the standard pragma (``# hglint: disable=HG1103``) and
+are subject to the HG901 stale-pragma audit.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .callgraph import CallGraph, CallSite, FunctionInfo
+from .loader import ModuleInfo
+from .model import Finding
+from .rules_exceptions import BUILTIN_PARENT
+
+#: envelope keys whose constant-string value names the message kind
+DISCRIMINATOR_KEYS = ("what", "type", "op", "event")
+
+#: envelope keys exempt from dead-field reporting (routing metadata that
+#: generic middleware reads, not the kind-specific consumer)
+ENVELOPE_EXEMPT_KEYS = frozenset(DISCRIMINATOR_KEYS) | {"trace"}
+
+#: record keys accepted as a schema-version stamp
+VERSION_KEYS = ("schema_version", "version", "format")
+
+#: single-payload container mutators treated as pack sites
+PACK_METHODS = frozenset({"append", "appendleft", "add", "put", "put_nowait"})
+
+#: container accessors peeled while resolving an expression to its slot
+POP_METHODS = frozenset({"pop", "popleft", "get_nowait"})
+CONTAINER_PEELS = frozenset({"get", "setdefault"})
+
+#: metric facade / registry methods taking a literal dotted name
+METRIC_METHODS = frozenset(
+    {"incr", "gauge", "observe", "counter", "histogram", "timer"}
+)
+
+#: wire key carrying the error *type name* in typed-error round-trips
+ERROR_KIND_KEY = "error"
+
+#: open() modes that persist (reading modes never version-drift on write)
+PERSIST_MODES = frozenset({"w", "a", "wb", "ab", "w+", "a+", "x", "xb"})
+
+_HTTP_MIN, _HTTP_MAX = 100, 600
+
+
+# --------------------------------------------------------------- channels
+
+
+@dataclass
+class _Pack:
+    arity: Optional[int]   # None: tuple contains *starred / unknown parts
+    path: str
+    line: int
+    scope: str
+
+
+@dataclass
+class _Unpack:
+    arity: int             # number of unpack targets (incl. the star slot)
+    star: bool             # starred target: arity-1 is the required minimum
+    path: str
+    line: int
+    scope: str
+
+
+@dataclass
+class _Producer:
+    keys: Set[str]
+    dynamic: bool          # non-literal keys present — suppress key errors
+    path: str
+    line: int
+    scope: str
+
+
+@dataclass
+class _Consumer:
+    hard: Set[str]
+    soft: Set[str]
+    dkey: str
+    path: str
+    line: int
+    scope: str
+
+
+@dataclass
+class _Writer:
+    keys: Set[str]
+    stamped: bool
+    stamp_values: Set[object]
+    persisted: bool
+    dynamic: bool          # **-unpack / opaque update: cannot prove either way
+    path: str
+    line: int
+    scope: str
+
+
+@dataclass
+class _Reader:
+    hard: Set[str]
+    version_checked: bool
+    accepted: Set[object]
+    path: str
+    line: int
+    scope: str
+
+
+@dataclass
+class _Table:
+    mod: str
+    path: str
+    line: int
+    types: Set[str]
+
+
+@dataclass
+class _FnScan:
+    fi: FunctionInfo
+    nodes: List[ast.AST]
+    aliases: Dict[str, str] = field(default_factory=dict)
+    key_reads: Dict[str, Tuple[Set[str], Set[str]]] = field(
+        default_factory=dict
+    )
+
+
+class _UnionFind:
+    def __init__(self):
+        self.parent: Dict[str, str] = {}
+
+    def find(self, x: str) -> str:
+        p = self.parent.setdefault(x, x)
+        while p != x:
+            gp = self.parent.setdefault(p, p)
+            self.parent[x] = gp
+            x, p = p, gp
+        return x
+
+    def union(self, a: str, b: str) -> None:
+        ra, rb = self.find(a), self.find(b)
+        if ra != rb:
+            self.parent[ra] = rb
+
+
+def _own_nodes(root: ast.AST) -> List[ast.AST]:
+    """All nodes of *root*'s body in document order, excluding nested
+    function/class scopes (they are separate FunctionInfos)."""
+    out: List[ast.AST] = []
+
+    def rec(n: ast.AST) -> None:
+        for c in ast.iter_child_nodes(n):
+            if isinstance(
+                c, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            out.append(c)
+            rec(c)
+
+    rec(root)
+    return out
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _fmt_keys(keys) -> str:
+    return ", ".join(repr(k) for k in sorted(keys))
+
+
+# -------------------------------------------------------------- registries
+
+
+def collect_registries(
+    modules: Sequence[ModuleInfo],
+) -> Tuple[Set[str], Set[str]]:
+    """Discover ``DOTTED_NAMES``-style metric registries by AST evaluation
+    (the analyzed tree is never imported). Returns ``(vocab, prefixes)``;
+    an unresolvable registry contributes nothing (HG1105 then simply does
+    not govern its namespace — under-approximation, never a guess)."""
+    vocab: Set[str] = set()
+    prefixes: Set[str] = set()
+    for mod in modules:
+        toplevel = {
+            t.targets[0].id: t.value
+            for t in mod.tree.body
+            if isinstance(t, ast.Assign)
+            and len(t.targets) == 1
+            and isinstance(t.targets[0], ast.Name)
+        }
+        if "DOTTED_NAMES" not in toplevel:
+            continue
+        names = _eval_strs(toplevel["DOTTED_NAMES"], mod, toplevel)
+        if names is None:
+            continue
+        vocab.update(names)
+        for name, val in toplevel.items():
+            if name.endswith("_PREFIX"):
+                s = _const_str(val)
+                if s and "." in s:
+                    prefixes.add(s)
+    return vocab, prefixes
+
+
+def _eval_strs(
+    node: ast.AST,
+    mod: ModuleInfo,
+    toplevel: Dict[str, ast.AST],
+    depth: int = 0,
+) -> Optional[Tuple[str, ...]]:
+    """Evaluate an expression to a tuple of strings, or None. Handles the
+    registry idioms: string/tuple literals, ``A + B`` concatenation, names
+    bound at module level, and ``tuple(f"..{k}.." for k in KS for p in PS)``
+    comprehensions over resolvable iterables."""
+    if depth > 8:
+        return None
+    s = _const_str(node)
+    if s is not None:
+        return (s,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for e in node.elts:
+            sub = _eval_strs(e, mod, toplevel, depth + 1)
+            if sub is None:
+                return None
+            out.extend(sub)
+        return tuple(out)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = _eval_strs(node.left, mod, toplevel, depth + 1)
+        right = _eval_strs(node.right, mod, toplevel, depth + 1)
+        if left is None or right is None:
+            return None
+        return left + right
+    if isinstance(node, ast.Name):
+        v = mod.consts.get(node.id)
+        if isinstance(v, str):
+            return (v,)
+        if isinstance(v, tuple) and all(isinstance(x, str) for x in v):
+            return v
+        tnode = toplevel.get(node.id)
+        if tnode is not None and tnode is not node:
+            return _eval_strs(tnode, mod, toplevel, depth + 1)
+        return None
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id in ("tuple", "list", "sorted")
+        and len(node.args) == 1
+        and not node.keywords
+        and isinstance(node.args[0], (ast.GeneratorExp, ast.ListComp))
+    ):
+        comp = node.args[0]
+        envs: List[Dict[str, str]] = [{}]
+        for gen in comp.generators:
+            if gen.ifs or gen.is_async or not isinstance(
+                gen.target, ast.Name
+            ):
+                return None
+            it = _eval_strs(gen.iter, mod, toplevel, depth + 1)
+            if it is None:
+                return None
+            envs = [
+                dict(e, **{gen.target.id: v}) for e in envs for v in it
+            ]
+        out = []
+        for env in envs:
+            s = _eval_fstring(comp.elt, env)
+            if s is None:
+                return None
+            out.append(s)
+        return tuple(out)
+    return None
+
+
+def _eval_fstring(node: ast.AST, env: Dict[str, str]) -> Optional[str]:
+    s = _const_str(node)
+    if s is not None:
+        return s
+    if isinstance(node, ast.Name):
+        return env.get(node.id)
+    if isinstance(node, ast.JoinedStr):
+        parts: List[str] = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            elif (
+                isinstance(v, ast.FormattedValue)
+                and v.format_spec is None
+                and isinstance(v.value, ast.Name)
+                and v.value.id in env
+            ):
+                parts.append(env[v.value.id])
+            else:
+                return None
+        return "".join(parts)
+    return None
+
+
+# -------------------------------------------------------------- wire model
+
+
+class _WireModel:
+    def __init__(self, cg: CallGraph, modules: Sequence[ModuleInfo]):
+        self.cg = cg
+        self.modules = list(modules)
+
+        # HG1101
+        self.uf = _UnionFind()
+        self.packs: Dict[str, List[_Pack]] = {}
+        self.unpacks: Dict[str, List[_Unpack]] = {}
+        # HG1102
+        self.producers: Dict[str, List[_Producer]] = {}
+        self.consumers: Dict[str, List[_Consumer]] = {}
+        # HG1103 (grouped per module name)
+        self.writers: Dict[str, List[_Writer]] = {}
+        self.readers: Dict[str, List[_Reader]] = {}
+        # HG1104
+        self.tables: List[_Table] = []
+        self.class_parent: Dict[str, str] = dict(BUILTIN_PARENT)
+        self.class_site: Dict[str, Tuple[str, int]] = {}
+        self._rt_findings: List[Finding] = []
+        # HG1105
+        self.vocab, self.prefixes = collect_registries(self.modules)
+        self.metric_sites: List[Tuple[str, str, int, str]] = []
+
+        for mod in self.modules:
+            self._scan_module_level(mod)
+
+        self.scans: Dict[str, _FnScan] = {}
+        for key, fi in self.cg.functions.items():
+            sc = _FnScan(fi, _own_nodes(fi.node))
+            sc.aliases = self._alias_pass(sc)
+            sc.key_reads = self._key_read_pass(sc)
+            self.scans[key] = sc
+        for sc in self.scans.values():
+            self._scan_channels(sc)
+            self._scan_envelopes(sc)
+            self._scan_artifacts(sc)
+            self._scan_roundtrip(sc)
+            self._scan_metrics(sc)
+
+    # ------------------------------------------------------ module level
+
+    def _scan_module_level(self, mod: ModuleInfo) -> None:
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.ClassDef):
+                self.class_site[stmt.name] = (mod.path, stmt.lineno)
+                for b in stmt.bases:
+                    base = self._type_name(b)
+                    if base:
+                        self.class_parent.setdefault(stmt.name, base)
+                        break
+            if (
+                isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and isinstance(stmt.value, (ast.Tuple, ast.List))
+            ):
+                types = self._status_table_types(stmt.value)
+                if types:
+                    self.tables.append(
+                        _Table(mod.name, mod.path, stmt.lineno, types)
+                    )
+
+    def _status_table_types(self, node: ast.AST) -> Optional[Set[str]]:
+        """An HTTP status/type table is a tuple/list of 2-tuples mapping
+        exception type(s) to an int HTTP status."""
+        types: Set[str] = set()
+        if not isinstance(node, (ast.Tuple, ast.List)) or not node.elts:
+            return None
+        for e in node.elts:
+            if not (isinstance(e, ast.Tuple) and len(e.elts) == 2):
+                return None
+            spec, status = e.elts
+            if not (
+                isinstance(status, ast.Constant)
+                and isinstance(status.value, int)
+                and _HTTP_MIN <= status.value < _HTTP_MAX
+            ):
+                return None
+            names = []
+            specs = spec.elts if isinstance(spec, ast.Tuple) else [spec]
+            for s in specs:
+                n = self._type_name(s)
+                if not n:
+                    return None
+                names.append(n)
+            types.update(names)
+        return types or None
+
+    @staticmethod
+    def _type_name(node: ast.AST) -> Optional[str]:
+        name = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        if name and name[:1].isupper():
+            return name
+        return None
+
+    def _ancestry(self, t: str) -> List[str]:
+        out, seen = [t], {t}
+        cur = t
+        while cur in self.class_parent:
+            cur = self.class_parent[cur]
+            if cur in seen:
+                break
+            seen.add(cur)
+            out.append(cur)
+        return out
+
+    # --------------------------------------------------------- fn passes
+
+    def _slot_of(
+        self, expr: ast.AST, sc: _FnScan
+    ) -> Optional[str]:
+        """Resolve an expression to the channel it denotes, peeling
+        subscripts and container accessors (``q[pid]``, ``q.get(pid)``,
+        ``q.popleft()`` — dict-of-queues and element extraction share the
+        channel: payload contracts are per-slot, not per-instance)."""
+        cur = expr
+        while True:
+            if isinstance(cur, ast.Subscript):
+                cur = cur.value
+                continue
+            if (
+                isinstance(cur, ast.Call)
+                and isinstance(cur.func, ast.Attribute)
+                and cur.func.attr in (CONTAINER_PEELS | POP_METHODS)
+            ):
+                cur = cur.func.value
+                continue
+            break
+        fi = sc.fi
+        if (
+            isinstance(cur, ast.Attribute)
+            and isinstance(cur.value, ast.Name)
+            and cur.value.id in ("self", "cls")
+            and fi.cls_name
+        ):
+            return f"slot:{fi.mod.name}.{fi.cls_name}.{cur.attr}"
+        if isinstance(cur, ast.Name):
+            if cur.id in sc.aliases:
+                return sc.aliases[cur.id]
+            if cur.id in fi.params:
+                return f"param:{fi.key}:{cur.id}"
+            if cur.id in fi.mod.mutable_globals:
+                return f"slot:{fi.mod.name}.{cur.id}"
+        return None
+
+    def _alias_pass(self, sc: _FnScan) -> Dict[str, str]:
+        sc.aliases = {}
+        for _ in range(2):  # aliases of aliases settle in two passes
+            for n in sc.nodes:
+                if isinstance(n, ast.Assign):
+                    names = [
+                        t.id for t in n.targets if isinstance(t, ast.Name)
+                    ]
+                    tchan = next(
+                        (
+                            c
+                            for c in (
+                                self._slot_of(t, sc)
+                                for t in n.targets
+                                if not isinstance(t, ast.Name)
+                            )
+                            if c
+                        ),
+                        None,
+                    )
+                    if tchan and names:
+                        # q = self._slots[pid] = deque()
+                        for nm in names:
+                            sc.aliases[nm] = tchan
+                        continue
+                    if len(n.targets) == 1 and len(names) == 1:
+                        vchan = self._slot_of(n.value, sc)
+                        if vchan:
+                            sc.aliases[names[0]] = vchan
+                elif isinstance(n, (ast.For, ast.AsyncFor)):
+                    self._alias_for(n, sc)
+                elif (
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in PACK_METHODS
+                    and len(n.args) == 1
+                    and isinstance(n.func.value, ast.Name)
+                    and n.func.value.id not in sc.aliases
+                ):
+                    # carrier: batch.append(self._q.popleft())
+                    chan = self._slot_of(n.args[0], sc)
+                    if chan:
+                        sc.aliases[n.func.value.id] = chan
+        return sc.aliases
+
+    def _alias_for(self, n, sc: _FnScan) -> None:
+        it = n.iter
+        if (
+            isinstance(it, ast.Call)
+            and isinstance(it.func, ast.Attribute)
+            and it.func.attr in ("items", "values")
+        ):
+            chan = self._slot_of(it.func.value, sc)
+            if not chan:
+                return
+            if it.func.attr == "values" and isinstance(n.target, ast.Name):
+                sc.aliases[n.target.id] = chan
+            if (
+                it.func.attr == "items"
+                and isinstance(n.target, ast.Tuple)
+                and len(n.target.elts) == 2
+                and isinstance(n.target.elts[1], ast.Name)
+            ):
+                sc.aliases[n.target.elts[1].id] = chan
+            return
+        if isinstance(n.target, ast.Name):
+            chan = self._slot_of(it, sc)
+            if chan:
+                # element alias: `for t in q` then `a, b = t`
+                sc.aliases[n.target.id] = chan
+
+    def _key_read_pass(
+        self, sc: _FnScan
+    ) -> Dict[str, Tuple[Set[str], Set[str]]]:
+        reads: Dict[str, Tuple[Set[str], Set[str]]] = {}
+        for n in sc.nodes:
+            if (
+                isinstance(n, ast.Subscript)
+                and isinstance(n.value, ast.Name)
+                and isinstance(n.ctx, ast.Load)
+            ):
+                k = _const_str(n.slice)
+                if k is not None:
+                    reads.setdefault(
+                        n.value.id, (set(), set())
+                    )[0].add(k)
+            elif (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "get"
+                and isinstance(n.func.value, ast.Name)
+                and n.args
+            ):
+                k = _const_str(n.args[0])
+                if k is not None:
+                    reads.setdefault(
+                        n.func.value.id, (set(), set())
+                    )[1].add(k)
+        return reads
+
+    # ------------------------------------------------------------ HG1101
+
+    def _scan_channels(self, sc: _FnScan) -> None:
+        fi = sc.fi
+        for n in sc.nodes:
+            if isinstance(n, ast.Call) and isinstance(
+                n.func, ast.Attribute
+            ):
+                if (
+                    n.func.attr in PACK_METHODS
+                    and len(n.args) == 1
+                    and isinstance(n.args[0], ast.Tuple)
+                ):
+                    chan = self._slot_of(n.func.value, sc)
+                    if chan:
+                        self._add_pack(chan, n.args[0], sc, n.lineno)
+                elif (
+                    n.func.attr == "insert"
+                    and len(n.args) == 2
+                    and isinstance(n.args[1], ast.Tuple)
+                ):
+                    chan = self._slot_of(n.func.value, sc)
+                    if chan:
+                        self._add_pack(chan, n.args[1], sc, n.lineno)
+            if isinstance(n, ast.Call):
+                self._scan_call_edges(n, sc)
+            if isinstance(n, ast.Assign) and len(n.targets) == 1:
+                t = n.targets[0]
+                if isinstance(t, ast.Subscript) and isinstance(
+                    n.value, ast.Tuple
+                ):
+                    chan = self._slot_of(t.value, sc)
+                    if chan:
+                        self._add_pack(chan, n.value, sc, n.lineno)
+                elif isinstance(t, ast.Tuple):
+                    chan = self._slot_of(n.value, sc)
+                    if chan:
+                        self._add_unpack(chan, t, sc, n.lineno)
+            if isinstance(n, (ast.For, ast.AsyncFor)) and isinstance(
+                n.target, ast.Tuple
+            ):
+                it = n.iter
+                if (
+                    isinstance(it, ast.Call)
+                    and isinstance(it.func, ast.Attribute)
+                    and it.func.attr in ("items", "keys")
+                ):
+                    continue  # dict iteration, not a payload unpack
+                chan = self._slot_of(it, sc)
+                if chan:
+                    self._add_unpack(chan, n.target, sc, n.lineno)
+
+    def _scan_call_edges(self, call: ast.Call, sc: _FnScan) -> None:
+        """Tuple-literal arguments become packs on the callee's parameter
+        channel; carrier arguments link caller and callee channels."""
+        fi = sc.fi
+        site = CallSite(node=call, fn_key=fi.key, mod=fi.mod)
+        callee = self.cg.resolve_callable(call.func, site)
+        if callee is None or callee not in self.cg.functions:
+            return
+        cfi = self.cg.functions[callee]
+        params = cfi.params
+        offset = (
+            1
+            if params
+            and params[0] in ("self", "cls")
+            and isinstance(call.func, ast.Attribute)
+            else 0
+        )
+
+        def param_chan(name: str) -> str:
+            return f"param:{callee}:{name}"
+
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                break
+            pi = offset + i
+            if pi >= len(params):
+                break
+            self._bind_arg(arg, param_chan(params[pi]), sc)
+        for kw in call.keywords:
+            if kw.arg and kw.arg in params:
+                self._bind_arg(kw.value, param_chan(kw.arg), sc)
+
+    def _bind_arg(self, arg: ast.AST, pchan: str, sc: _FnScan) -> None:
+        if isinstance(arg, ast.Tuple):
+            self._add_pack(pchan, arg, sc, arg.lineno)
+            return
+        elts = None
+        if isinstance(arg, ast.List):
+            elts = arg.elts
+        elif isinstance(arg, ast.ListComp):
+            elts = [arg.elt]
+        if elts is not None:
+            for e in elts:
+                if isinstance(e, ast.Tuple):
+                    self._add_pack(pchan, e, sc, e.lineno)
+            return
+        chan = self._slot_of(arg, sc)
+        if chan:
+            self.uf.union(pchan, chan)
+
+    def _add_pack(
+        self, chan: str, tup: ast.Tuple, sc: _FnScan, line: int
+    ) -> None:
+        arity: Optional[int] = len(tup.elts)
+        if any(isinstance(e, ast.Starred) for e in tup.elts):
+            arity = None
+        self.packs.setdefault(self.uf.find(chan), []).append(
+            _Pack(arity, sc.fi.mod.path, line, sc.fi.qualpath)
+        )
+
+    def _add_unpack(
+        self, chan: str, tgt: ast.Tuple, sc: _FnScan, line: int
+    ) -> None:
+        star = any(isinstance(e, ast.Starred) for e in tgt.elts)
+        self.unpacks.setdefault(self.uf.find(chan), []).append(
+            _Unpack(
+                len(tgt.elts), star, sc.fi.mod.path, line, sc.fi.qualpath
+            )
+        )
+
+    def arity_findings(self) -> List[Finding]:
+        groups: Dict[str, Tuple[List[_Pack], List[_Unpack]]] = {}
+        for chan, ps in self.packs.items():
+            groups.setdefault(
+                self.uf.find(chan), ([], [])
+            )[0].extend(ps)
+        for chan, us in self.unpacks.items():
+            groups.setdefault(
+                self.uf.find(chan), ([], [])
+            )[1].extend(us)
+        out: List[Finding] = []
+        for chan, (ps, us) in sorted(groups.items()):
+            known = [p for p in ps if p.arity is not None]
+            if not known or not us:
+                continue
+            for u in us:
+                need = u.arity - 1 if u.star else u.arity
+                bad = [
+                    p
+                    for p in known
+                    if (p.arity < need if u.star else p.arity != need)
+                ]
+                if not bad:
+                    continue
+                p = bad[0]
+                more = (
+                    f" (+{len(bad) - 1} more pack site(s))"
+                    if len(bad) > 1
+                    else ""
+                )
+                want = (
+                    f"at least {need} values (starred target)"
+                    if u.star
+                    else f"exactly {u.arity} values"
+                )
+                out.append(Finding(
+                    rule="HG1101", path=u.path, line=u.line,
+                    scope=u.scope,
+                    message=f"payload arity drift on channel "
+                            f"`{chan.split(':', 1)[1]}`: this unpack "
+                            f"needs {want} but `{p.scope}` packs "
+                            f"{p.arity}-tuples ({p.path}:{p.line})"
+                            f"{more} — every consumer of this channel "
+                            f"crashes at unpack when the producer "
+                            f"payload changes shape",
+                ))
+        return out
+
+    # ------------------------------------------------------------ HG1102
+
+    def _scan_envelopes(self, sc: _FnScan) -> None:
+        fi = sc.fi
+        # producers: discriminator-keyed dict literals
+        for n in sc.nodes:
+            if not isinstance(n, ast.Dict):
+                continue
+            keys: Set[str] = set()
+            dynamic = False
+            kind = None
+            for k, v in zip(n.keys, n.values):
+                ks = _const_str(k) if k is not None else None
+                if ks is None:
+                    dynamic = True
+                    continue
+                keys.add(ks)
+                if ks in DISCRIMINATOR_KEYS and kind is None:
+                    kind = _const_str(v)
+            if kind is not None:
+                self.producers.setdefault(kind, []).append(_Producer(
+                    keys, dynamic, fi.mod.path, n.lineno, fi.qualpath
+                ))
+        # consumers: kind-dispatch branches
+        dvars: Dict[str, Tuple[str, str]] = {}
+        for n in sc.nodes:
+            if (
+                isinstance(n, ast.Assign)
+                and len(n.targets) == 1
+                and isinstance(n.targets[0], ast.Name)
+            ):
+                dr = self._disc_read(n.value)
+                if dr:
+                    dvars[n.targets[0].id] = dr
+        for n in sc.nodes:
+            if not isinstance(n, ast.If):
+                continue
+            hit = self._kind_test(n.test, dvars)
+            if not hit:
+                continue
+            container, dkey, kinds = hit
+            hard, soft = self._branch_reads(n.body, container, sc)
+            for kind in kinds:
+                self.consumers.setdefault(kind, []).append(_Consumer(
+                    set(hard), set(soft), dkey, fi.mod.path,
+                    n.test.lineno, fi.qualpath,
+                ))
+
+    @staticmethod
+    def _disc_read(expr: ast.AST) -> Optional[Tuple[str, str]]:
+        """``content.get("what")`` / ``content["what"]`` →
+        ``(container var, discriminator key)``."""
+        if (
+            isinstance(expr, ast.Call)
+            and isinstance(expr.func, ast.Attribute)
+            and expr.func.attr == "get"
+            and isinstance(expr.func.value, ast.Name)
+            and expr.args
+        ):
+            k = _const_str(expr.args[0])
+            if k in DISCRIMINATOR_KEYS:
+                return (expr.func.value.id, k)
+        if (
+            isinstance(expr, ast.Subscript)
+            and isinstance(expr.value, ast.Name)
+        ):
+            k = _const_str(expr.slice)
+            if k in DISCRIMINATOR_KEYS:
+                return (expr.value.id, k)
+        return None
+
+    def _kind_test(
+        self, test: ast.AST, dvars: Dict[str, Tuple[str, str]]
+    ) -> Optional[Tuple[str, str, List[str]]]:
+        if not (
+            isinstance(test, ast.Compare)
+            and len(test.ops) == 1
+            and len(test.comparators) == 1
+        ):
+            return None
+        left, op, right = test.left, test.ops[0], test.comparators[0]
+        src = None
+        if isinstance(left, ast.Name) and left.id in dvars:
+            src = dvars[left.id]
+        else:
+            src = self._disc_read(left)
+        if src is None:
+            return None
+        container, dkey = src
+        if isinstance(op, ast.Eq):
+            kind = _const_str(right)
+            if kind is not None:
+                return (container, dkey, [kind])
+        if isinstance(op, ast.In) and isinstance(
+            right, (ast.Tuple, ast.List, ast.Set)
+        ):
+            kinds = [_const_str(e) for e in right.elts]
+            if kinds and all(k is not None for k in kinds):
+                return (container, dkey, list(kinds))
+        return None
+
+    def _branch_reads(
+        self, body: List[ast.stmt], container: str, sc: _FnScan
+    ) -> Tuple[Set[str], Set[str]]:
+        hard: Set[str] = set()
+        soft: Set[str] = set()
+        for stmt in body:
+            for n in ast.walk(stmt):
+                if (
+                    isinstance(n, ast.Subscript)
+                    and isinstance(n.value, ast.Name)
+                    and n.value.id == container
+                    and isinstance(n.ctx, ast.Load)
+                ):
+                    k = _const_str(n.slice)
+                    if k is not None:
+                        hard.add(k)
+                elif isinstance(n, ast.Call):
+                    if (
+                        isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "get"
+                        and isinstance(n.func.value, ast.Name)
+                        and n.func.value.id == container
+                        and n.args
+                    ):
+                        k = _const_str(n.args[0])
+                        if k is not None:
+                            soft.add(k)
+                    else:
+                        h2, s2 = self._hop_reads(n, container, sc)
+                        hard |= h2
+                        soft |= s2
+        return hard, soft
+
+    def _hop_reads(
+        self, call: ast.Call, container: str, sc: _FnScan
+    ) -> Tuple[Set[str], Set[str]]:
+        """One interprocedural hop: the envelope is forwarded to a
+        resolvable callee — that callee's reads on the receiving
+        parameter count as this consumer's reads."""
+        passed = [
+            i
+            for i, a in enumerate(call.args)
+            if isinstance(a, ast.Name) and a.id == container
+        ]
+        if not passed:
+            return set(), set()
+        site = CallSite(node=call, fn_key=sc.fi.key, mod=sc.fi.mod)
+        callee = self.cg.resolve_callable(call.func, site)
+        if callee is None or callee not in self.cg.functions:
+            return set(), set()
+        cfi = self.cg.functions[callee]
+        csc = self.scans.get(callee)
+        if csc is None:
+            return set(), set()
+        params = cfi.params
+        offset = (
+            1
+            if params
+            and params[0] in ("self", "cls")
+            and isinstance(call.func, ast.Attribute)
+            else 0
+        )
+        hard: Set[str] = set()
+        soft: Set[str] = set()
+        for i in passed:
+            pi = offset + i
+            if pi < len(params):
+                h, s = csc.key_reads.get(params[pi], (set(), set()))
+                hard |= h
+                soft |= s
+        return hard, soft
+
+    def envelope_findings(self) -> List[Finding]:
+        out: List[Finding] = []
+        for kind in sorted(self.producers):
+            prods = self.producers[kind]
+            cons = self.consumers.get(kind, [])
+            written: Set[str] = set()
+            for p in prods:
+                written |= p.keys
+            any_dynamic = any(p.dynamic for p in prods)
+            for c in sorted(cons, key=lambda c: (c.path, c.line)):
+                missing = c.hard - written - {c.dkey}
+                if missing and not any_dynamic:
+                    out.append(Finding(
+                        rule="HG1102", path=c.path, line=c.line,
+                        scope=c.scope,
+                        message=f"envelope-key drift: consumer of kind "
+                                f"{kind!r} hard-reads {_fmt_keys(missing)} "
+                                f"but no producer of this kind writes "
+                                f"{'it' if len(missing) == 1 else 'them'} "
+                                f"— a KeyError in waiting; write the key "
+                                f"at every produce site or read it with "
+                                f"`.get()`",
+                    ))
+            if not cons:
+                continue
+            reads: Set[str] = set()
+            for c in cons:
+                reads |= c.hard | c.soft
+            for p in sorted(prods, key=lambda p: (p.path, p.line)):
+                dead = p.keys - reads - ENVELOPE_EXEMPT_KEYS
+                if dead:
+                    out.append(Finding(
+                        rule="HG1102", path=p.path, line=p.line,
+                        scope=p.scope, severity="warning",
+                        message=f"envelope-key drift: producer of kind "
+                                f"{kind!r} writes {_fmt_keys(dead)} but "
+                                f"no consumer of this kind reads "
+                                f"{'it' if len(dead) == 1 else 'them'} — "
+                                f"dead field(s); drop or consume",
+                    ))
+        return out
+
+    # ------------------------------------------------------------ HG1103
+
+    def _scan_artifacts(self, sc: _FnScan) -> None:
+        fi = sc.fi
+        persists = False
+        dicts: Dict[str, _Writer] = {}
+        loads: Dict[str, _Reader] = {}
+        vver: Set[str] = set()
+        writes: List[_Writer] = []
+
+        def record_of(arg: ast.AST) -> Optional[_Writer]:
+            if isinstance(arg, ast.Dict):
+                return self._dict_record(arg, sc)
+            if isinstance(arg, ast.Name):
+                return dicts.get(arg.id)
+            return None
+
+        for n in sc.nodes:
+            if isinstance(n, ast.Call):
+                fname = None
+                if isinstance(n.func, ast.Name):
+                    fname = n.func.id
+                elif isinstance(n.func, ast.Attribute):
+                    fname = n.func.attr
+                if fname == "open":
+                    mode = None
+                    if len(n.args) >= 2:
+                        mode = _const_str(n.args[1])
+                    elif isinstance(n.func, ast.Attribute) and n.args:
+                        mode = _const_str(n.args[0])  # Path.open("w")
+                    for kw in n.keywords:
+                        if kw.arg == "mode":
+                            mode = _const_str(kw.value)
+                    if mode in PERSIST_MODES:
+                        persists = True
+                elif fname and (
+                    "atomic_write" in fname or fname == "replace"
+                ):
+                    persists = True
+                if (
+                    isinstance(n.func, ast.Attribute)
+                    and n.func.attr in ("dump", "dumps")
+                    and isinstance(n.func.value, ast.Name)
+                    and n.func.value.id == "json"
+                    and n.args
+                ):
+                    w = record_of(n.args[0])
+                    if w is not None:
+                        w = _Writer(
+                            set(w.keys), w.stamped, set(w.stamp_values),
+                            n.func.attr == "dump", w.dynamic,
+                            fi.mod.path, n.lineno, fi.qualpath,
+                        )
+                        writes.append(w)
+                if (
+                    isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "update"
+                    and isinstance(n.func.value, ast.Name)
+                    and n.func.value.id in dicts
+                    and n.args
+                    and isinstance(n.args[0], ast.Dict)
+                ):
+                    extra = self._dict_record(n.args[0], sc)
+                    d = dicts[n.func.value.id]
+                    d.keys |= extra.keys
+                    d.stamped = d.stamped or extra.stamped
+                    d.stamp_values |= extra.stamp_values
+                    d.dynamic = d.dynamic or extra.dynamic
+            if (
+                isinstance(n, ast.Assign)
+                and len(n.targets) == 1
+                and isinstance(n.targets[0], ast.Name)
+            ):
+                tname = n.targets[0].id
+                if isinstance(n.value, ast.Dict):
+                    dicts[tname] = self._dict_record(n.value, sc)
+                    dicts[tname].line = n.lineno
+                elif self._is_json_load(n.value):
+                    loads[tname] = _Reader(
+                        set(), False, set(),
+                        fi.mod.path, n.lineno, fi.qualpath,
+                    )
+                elif self._version_read(n.value, loads) is not None:
+                    vver.add(tname)
+            if (
+                isinstance(n, ast.Assign)
+                and len(n.targets) == 1
+                and isinstance(n.targets[0], ast.Subscript)
+                and isinstance(n.targets[0].value, ast.Name)
+                and n.targets[0].value.id in dicts
+            ):
+                k = _const_str(n.targets[0].slice)
+                if k is not None:
+                    d = dicts[n.targets[0].value.id]
+                    d.keys.add(k)
+                    if k in VERSION_KEYS:
+                        d.stamped = True
+                        v = self._const_value(n.value, sc)
+                        if v is not None:
+                            d.stamp_values.add(v)
+            # reader key accesses + version comparisons
+            if (
+                isinstance(n, ast.Subscript)
+                and isinstance(n.value, ast.Name)
+                and n.value.id in loads
+                and isinstance(n.ctx, ast.Load)
+            ):
+                k = _const_str(n.slice)
+                if k is not None:
+                    r = loads[n.value.id]
+                    if k in VERSION_KEYS:
+                        r.version_checked = True
+                    else:
+                        r.hard.add(k)
+            if (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "get"
+                and isinstance(n.func.value, ast.Name)
+                and n.func.value.id in loads
+                and n.args
+            ):
+                k = _const_str(n.args[0])
+                if k in VERSION_KEYS:
+                    loads[n.func.value.id].version_checked = True
+            if isinstance(n, ast.Compare) and len(n.comparators) == 1:
+                self._version_compare(n, loads, vver, sc)
+
+        mkey = fi.mod.name
+        for w in writes:
+            if not w.persisted:
+                w.persisted = persists
+            if w.persisted:
+                self.writers.setdefault(mkey, []).append(w)
+        for r in loads.values():
+            self.readers.setdefault(mkey, []).append(r)
+
+    def _dict_record(self, d: ast.Dict, sc: _FnScan) -> _Writer:
+        keys: Set[str] = set()
+        dynamic = False
+        stamped = False
+        values: Set[object] = set()
+        for k, v in zip(d.keys, d.values):
+            ks = _const_str(k) if k is not None else None
+            if ks is None:
+                dynamic = True
+                continue
+            keys.add(ks)
+            if ks in VERSION_KEYS:
+                stamped = True
+                cv = self._const_value(v, sc)
+                if cv is not None:
+                    values.add(cv)
+        return _Writer(
+            keys, stamped, values, False, dynamic,
+            sc.fi.mod.path, d.lineno, sc.fi.qualpath,
+        )
+
+    @staticmethod
+    def _is_json_load(expr: ast.AST) -> bool:
+        return (
+            isinstance(expr, ast.Call)
+            and isinstance(expr.func, ast.Attribute)
+            and expr.func.attr in ("load", "loads")
+            and isinstance(expr.func.value, ast.Name)
+            and expr.func.value.id == "json"
+        )
+
+    @staticmethod
+    def _version_read(
+        expr: ast.AST, loads: Dict[str, _Reader]
+    ) -> Optional[str]:
+        """``rec["schema_version"]`` / ``rec.get("schema_version")`` on a
+        known json.load() result → the load var name."""
+        if (
+            isinstance(expr, ast.Subscript)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id in loads
+        ):
+            k = _const_str(expr.slice)
+            if k in VERSION_KEYS:
+                return expr.value.id
+        if (
+            isinstance(expr, ast.Call)
+            and isinstance(expr.func, ast.Attribute)
+            and expr.func.attr == "get"
+            and isinstance(expr.func.value, ast.Name)
+            and expr.func.value.id in loads
+            and expr.args
+        ):
+            k = _const_str(expr.args[0])
+            if k in VERSION_KEYS:
+                return expr.func.value.id
+        return None
+
+    def _const_value(self, expr: ast.AST, sc: _FnScan):
+        if isinstance(expr, ast.Constant) and isinstance(
+            expr.value, (int, str)
+        ):
+            return expr.value
+        if isinstance(expr, ast.Name):
+            v = sc.fi.mod.consts.get(expr.id)
+            if isinstance(v, (int, str)):
+                return v
+        return None
+
+    def _version_compare(
+        self,
+        n: ast.Compare,
+        loads: Dict[str, _Reader],
+        vver: Set[str],
+        sc: _FnScan,
+    ) -> None:
+        side = None
+        for expr in (n.left, n.comparators[0]):
+            lv = self._version_read(expr, loads)
+            if lv is not None:
+                side = lv
+            elif isinstance(expr, ast.Name) and expr.id in vver:
+                side = next(iter(loads), None)
+        if side is None or side not in loads:
+            return
+        r = loads[side]
+        r.version_checked = True
+        other = (
+            n.comparators[0]
+            if (
+                self._version_read(n.left, loads) is not None
+                or (isinstance(n.left, ast.Name) and n.left.id in vver)
+            )
+            else n.left
+        )
+        op = n.ops[0]
+        vals: Set[object] = set()
+        if isinstance(op, (ast.Eq, ast.NotEq)):
+            v = self._const_value(other, sc)
+            if v is not None:
+                vals.add(v)
+        elif isinstance(op, (ast.In, ast.NotIn)):
+            elts = None
+            if isinstance(other, (ast.Tuple, ast.List, ast.Set)):
+                elts = other.elts
+            elif isinstance(other, ast.Name):
+                cv = sc.fi.mod.consts.get(other.id)
+                if isinstance(cv, tuple):
+                    vals.update(
+                        v for v in cv if isinstance(v, (int, str))
+                    )
+            if elts is not None:
+                for e in elts:
+                    v = self._const_value(e, sc)
+                    if v is not None:
+                        vals.add(v)
+        r.accepted |= vals
+
+    def artifact_findings(self) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in sorted(set(self.writers) | set(self.readers)):
+            writers = self.writers.get(mod, [])
+            readers = self.readers.get(mod, [])
+            stamped = [w for w in writers if w.stamped]
+            emitted: Set[object] = set()
+            for w in stamped:
+                emitted |= w.stamp_values
+            for w in sorted(writers, key=lambda w: (w.path, w.line)):
+                if not w.stamped and not w.dynamic:
+                    out.append(Finding(
+                        rule="HG1103", path=w.path, line=w.line,
+                        scope=w.scope,
+                        message=f"persisted JSON record (keys "
+                                f"{_fmt_keys(w.keys) or '(none)'}) "
+                                f"carries no schema-version stamp "
+                                f"({'/'.join(VERSION_KEYS)}) — readers "
+                                f"cannot reject a future format change; "
+                                f"stamp it and version-check on read",
+                    ))
+            for r in sorted(readers, key=lambda r: (r.path, r.line)):
+                if stamped and r.hard and not r.version_checked:
+                    out.append(Finding(
+                        rule="HG1103", path=r.path, line=r.line,
+                        scope=r.scope,
+                        message=f"hard-keyed JSON reader (reads "
+                                f"{_fmt_keys(r.hard)}) in a module whose "
+                                f"writers stamp a schema version, but it "
+                                f"never version-checks — a format bump "
+                                f"crashes this reader instead of being "
+                                f"rejected cleanly",
+                    ))
+                if r.accepted and emitted:
+                    rejected = emitted - r.accepted
+                    if rejected:
+                        out.append(Finding(
+                            rule="HG1103", path=r.path, line=r.line,
+                            scope=r.scope,
+                            message=f"schema-version skew: this reader "
+                                    f"accepts {_fmt_keys(r.accepted)} "
+                                    f"but writers in this module emit "
+                                    f"{_fmt_keys(rejected)} — current "
+                                    f"artifacts are rejected on read",
+                        ))
+                    phantom = r.accepted - emitted
+                    if phantom:
+                        out.append(Finding(
+                            rule="HG1103", path=r.path, line=r.line,
+                            scope=r.scope, severity="warning",
+                            message=f"schema-version skew: this reader "
+                                    f"accepts {_fmt_keys(phantom)} "
+                                    f"which no writer in this module "
+                                    f"emits — a legacy-compat window; "
+                                    f"confirm it is intentional or drop "
+                                    f"the dead version(s)",
+                        ))
+        return out
+
+    # ------------------------------------------------------------ HG1104
+
+    def _scan_roundtrip(self, sc: _FnScan) -> None:
+        fi = sc.fi
+        kvars: Set[str] = set()
+        for n in sc.nodes:
+            if (
+                isinstance(n, ast.Assign)
+                and len(n.targets) == 1
+                and isinstance(n.targets[0], ast.Name)
+                and self._error_kind_read(n.value)
+            ):
+                kvars.add(n.targets[0].id)
+        if not kvars:
+            return
+        known = set(self.class_site) | set(self.class_parent)
+        for n in sc.nodes:
+            if not isinstance(n, ast.If):
+                continue
+            t = n.test
+            if not (
+                isinstance(t, ast.Compare)
+                and len(t.ops) == 1
+                and isinstance(t.ops[0], ast.Eq)
+                and isinstance(t.left, ast.Name)
+                and t.left.id in kvars
+            ):
+                continue
+            lit = _const_str(t.comparators[0])
+            if lit is None:
+                continue
+            raised = [
+                self._type_name(
+                    r.exc.func if isinstance(r.exc, ast.Call) else r.exc
+                )
+                for stmt in n.body
+                for r in ast.walk(stmt)
+                if isinstance(r, ast.Raise) and r.exc is not None
+            ]
+            raised = [r for r in raised if r]
+            if not raised:
+                continue
+            if lit not in known:
+                self._rt_findings.append(Finding(
+                    rule="HG1104", path=fi.mod.path, line=t.lineno,
+                    scope=fi.qualpath,
+                    message=f"typed-error round-trip: wire kind {lit!r} "
+                            f"names no known exception class — the "
+                            f"server side can never emit it, so this "
+                            f"branch is dead (typo or removed type?)",
+                ))
+                continue
+            for r in raised:
+                if r != lit:
+                    self._rt_findings.append(Finding(
+                        rule="HG1104", path=fi.mod.path, line=t.lineno,
+                        scope=fi.qualpath,
+                        message=f"typed-error round-trip: wire kind "
+                                f"{lit!r} is mapped back to `{r}` — the "
+                                f"client rehydrates a *different* type "
+                                f"than the server raised, so "
+                                f"typed-error handling (degraded-not-"
+                                f"down semantics) silently breaks",
+                    ))
+
+    @staticmethod
+    def _error_kind_read(expr: ast.AST) -> bool:
+        """``<expr>.get("error")`` / ``<expr>["error"]``."""
+        if (
+            isinstance(expr, ast.Call)
+            and isinstance(expr.func, ast.Attribute)
+            and expr.func.attr == "get"
+            and expr.args
+        ):
+            return _const_str(expr.args[0]) == ERROR_KIND_KEY
+        if isinstance(expr, ast.Subscript):
+            return _const_str(expr.slice) == ERROR_KIND_KEY
+        return False
+
+    def errortable_findings(self) -> List[Finding]:
+        out = list(self._rt_findings)
+        for table in self.tables:
+            roots = {
+                a
+                for t in table.types
+                for a in self._ancestry(t)[1:]
+                if a in self.class_site
+            }
+            if not roots:
+                continue
+            for cls in sorted(self.class_site):
+                if cls in roots or cls in table.types:
+                    continue
+                anc = self._ancestry(cls)[1:]
+                if not any(r in anc for r in roots):
+                    continue
+                if any(t in self._ancestry(cls) for t in table.types):
+                    continue
+                path, line = self.class_site[cls]
+                out.append(Finding(
+                    rule="HG1104", path=table.path, line=table.line,
+                    scope="<module>",
+                    message=f"typed-error wire-table drift: `{cls}` "
+                            f"({path}:{line}) derives wire-mapped "
+                            f"family root "
+                            f"{'/'.join(sorted(roots & set(anc)))} but "
+                            f"no status-table entry covers it — it "
+                            f"falls through to the generic 500 and the "
+                            f"client loses the typed round-trip",
+                ))
+        return out
+
+    # ------------------------------------------------------------ HG1105
+
+    def _scan_metrics(self, sc: _FnScan) -> None:
+        fi = sc.fi
+        for n in sc.nodes:
+            if (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in METRIC_METHODS
+                and n.args
+            ):
+                name = _const_str(n.args[0])
+                if name and "." in name:
+                    self.metric_sites.append(
+                        (name, fi.mod.path, n.lineno, fi.qualpath)
+                    )
+
+    def metric_findings(self) -> List[Finding]:
+        if not self.vocab:
+            return []
+        governed = {n.split(".", 1)[0] for n in self.vocab}
+        governed |= {p.split(".", 1)[0] for p in self.prefixes}
+        out: List[Finding] = []
+        for name, path, line, scope in sorted(self.metric_sites):
+            ns = name.split(".", 1)[0]
+            if ns not in governed:
+                continue
+            if name in self.vocab:
+                continue
+            if any(name.startswith(p) for p in self.prefixes):
+                continue
+            out.append(Finding(
+                rule="HG1105", path=path, line=line, scope=scope,
+                message=f"metric-name drift: {name!r} is absent from "
+                        f"the `DOTTED_NAMES` registry governing the "
+                        f"{ns!r} namespace — the runtime drift gate "
+                        f"will fail; register the name or fix the "
+                        f"typo",
+            ))
+        return out
+
+
+def check(
+    cg: CallGraph, modules: Sequence[ModuleInfo]
+) -> List[Finding]:
+    model = _WireModel(cg, modules)
+    out: List[Finding] = []
+    out.extend(model.arity_findings())
+    out.extend(model.envelope_findings())
+    out.extend(model.artifact_findings())
+    out.extend(model.errortable_findings())
+    out.extend(model.metric_findings())
+    return out

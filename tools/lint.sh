@@ -20,6 +20,8 @@
 #   tools/lint.sh --only HG5           # one rule family, fast local run
 #   tools/lint.sh --only HG10          # exception-flow family only
 #                                      # (family-aware: never HG101-107)
+#   tools/lint.sh --only HG11          # wire-contract family only
+#                                      # (HG1101-1105, zero baseline)
 #   tools/lint.sh --output json        # machine-readable CI report
 #   tools/lint.sh --pre-commit         # fast lane: findings only in files
 #                                      # changed vs HEAD (analysis stays
