@@ -1757,6 +1757,291 @@ def bench_c9():
     return out
 
 
+def bench_c10():
+    """c10_pattern: OPEN-LOOP pattern serving + standing subscriptions
+    (hgsub) — Poisson arrivals of ad-hoc ``submit_pattern`` requests
+    against ``ServeRuntime`` while ingest streams concurrently and N
+    standing pattern/range subscriptions ride the SAME bucketed device
+    programs (``SubscriptionManager`` attached to the runtime's
+    dispatch cycle). Open-loop means arrival times come from the
+    offered rate, not from completions, so queueing delay under the
+    standing-eval background load is measured honestly.
+
+    Two lanes come out of one run: the ad-hoc ``pattern`` percentiles
+    (runtime stats) and the ``sub`` notification-latency percentiles
+    (ingest-dirty → delta-enqueued, via the manager's perf feed) — the
+    pair ``--seed-baseline`` turns into the sentinel's ``pattern`` and
+    ``sub`` contracts. A probe subset of subscriptions is differentially
+    verified the wire way: initial snapshot + folded polled deltas must
+    equal the exact host re-evaluation at settle.
+
+    Env knobs: BENCH_C10_ENTITIES / _LINKS (graph scale), _REQUESTS,
+    _OFFERED_QPS, _DEADLINE_S, _SUBS (standing queries), _HUBS (anchor
+    pool the ingest keeps hitting), _INGEST_BATCHES / _BATCH_LINKS,
+    _BASELINE_N, _TAG."""
+    _bench_entry_env()
+    import threading
+
+    from hypergraphdb_tpu import HyperGraph
+    from hypergraphdb_tpu.query import conditions as qc
+    from hypergraphdb_tpu.serve import DeadlineExceeded, ServeConfig, \
+        ServeRuntime
+    from hypergraphdb_tpu.sub import SubscriptionManager
+
+    _telemetry_begin()
+    n_entities = int(os.environ.get("BENCH_C10_ENTITIES", 200_000))
+    n_links = int(os.environ.get("BENCH_C10_LINKS", 400_000))
+    n_requests = int(os.environ.get("BENCH_C10_REQUESTS", 4096))
+    offered_qps = float(os.environ.get("BENCH_C10_OFFERED_QPS", 1000.0))
+    deadline_s = float(os.environ.get("BENCH_C10_DEADLINE_S", 2.0))
+    n_subs = int(os.environ.get("BENCH_C10_SUBS", 64))
+    n_hubs = int(os.environ.get("BENCH_C10_HUBS", 16))
+    stream_batches = int(os.environ.get("BENCH_C10_INGEST_BATCHES", 8))
+    batch_links = int(os.environ.get("BENCH_C10_BATCH_LINKS", 5_000))
+    base_n = min(int(os.environ.get("BENCH_C10_BASELINE_N", 128)),
+                 n_requests)
+    probe_n = min(16, n_subs)
+
+    g = HyperGraph()
+    r = np.random.default_rng(31)
+    entities = g.bulk_import(values=np.arange(n_entities).tolist())
+    e0 = int(entities[0])
+    for s in range(0, n_links, 100_000):
+        m = min(100_000, n_links - s)
+        subj = r.integers(0, n_entities, size=m)
+        obj = r.integers(0, n_entities, size=m)
+        g.bulk_import(
+            values=[int(1_000_000 + s + x) for x in range(m)],
+            target_lists=[[e0 + int(a), e0 + int(b)]
+                          for a, b in zip(subj, obj)],
+        )
+    g.enable_incremental(
+        headroom=1.8, background=True, delta_bucket_min=1 << 14,
+        pack_pad_multiple=int(os.environ.get("BENCH_C10_PAD", 1 << 17)),
+    )
+
+    # the manager feeds dirty→notified latency to ServeConfig.perf's
+    # observe("sub", ...) — a recording tap keeps the bench independent
+    # of sentinel window spans while exercising the REAL feed path
+    class _PerfTap:
+        def __init__(self):
+            self.lanes: dict = {}
+            self.lock = threading.Lock()
+
+        def observe(self, kind, latency_s, path="device", t=None):
+            with self.lock:
+                self.lanes.setdefault(kind, []).append(float(latency_s))
+
+        def observe_batch(self, *a, **k):
+            pass
+
+        def maybe_tick(self):
+            return None
+
+    tap = _PerfTap()
+    cfg = ServeConfig(
+        buckets=(64, 256, 1024),
+        max_queue=int(os.environ.get("BENCH_C10_QUEUE", 8192)),
+        max_linger_s=float(os.environ.get("BENCH_C10_LINGER_S", 0.002)),
+        top_r=16, prewarm_aot=False, perf=tap,
+    )
+    rt = ServeRuntime(g, cfg)
+    mgr = SubscriptionManager(g, rt)
+    rt.attach_subscriptions(mgr)
+
+    # standing queries: pattern subs anchored on a hub pool the ingest
+    # keeps linking into, range subs whose value windows the ingest's
+    # fresh link values land inside — both kinds receive real deltas
+    hubs = [e0 + int(h) for h in
+            r.integers(0, n_entities, size=n_hubs)]
+    ingest_v0 = 10_000_000
+    ingest_span = stream_batches * batch_links
+    folded: list = []  # (sid, kind, anchor/None, client-folded set)
+    for i in range(n_subs):
+        if i % 2 == 0:
+            anchor = hubs[i % n_hubs]
+            resp = mgr.subscribe("pattern", {"anchors": [anchor]})
+        else:
+            lo = ingest_v0 + (i * ingest_span) // n_subs
+            hi = ingest_v0 + ((i + 2) * ingest_span) // n_subs
+            resp = mgr.subscribe("range", {"lo": lo, "hi": hi})
+        folded.append((resp["id"], resp["kind"],
+                       {int(h) for h in resp["matches"]}))
+
+    seeds = [e0 + int(x) for x in r.integers(0, n_entities,
+                                             size=n_requests)]
+
+    # warm every bucket shape off the clock (compile at deploy time)
+    for b in cfg.buckets:
+        warm = [rt.submit_pattern([seeds[j % n_requests]])
+                for j in range(b)]
+        for f in warm:
+            f.result(timeout=600)
+    rt.stats.reset()
+    ingested = {"done": False, "atoms": 0, "s": 0.0}
+
+    def writer():
+        t0 = time.perf_counter()
+        v = ingest_v0
+        for _ in range(stream_batches):
+            obj = r.integers(0, n_entities, size=batch_links)
+            g.bulk_import(
+                values=[int(v + x) for x in range(batch_links)],
+                target_lists=[[hubs[int(o) % n_hubs], e0 + int(o)]
+                              for o in obj],
+            )
+            v += batch_links
+            ingested["atoms"] += batch_links
+        ingested["s"] = time.perf_counter() - t0
+        ingested["done"] = True
+
+    wt = threading.Thread(target=writer)
+    wt.start()
+    gaps = r.exponential(1.0 / offered_qps, size=n_requests)
+    futs = []
+    t0 = time.perf_counter()
+    next_t = t0
+    for i in range(n_requests):
+        next_t += gaps[i]
+        pause = next_t - time.perf_counter()
+        if pause > 0:
+            time.sleep(pause)
+        futs.append(rt.submit_pattern([seeds[i]],
+                                      deadline_s=deadline_s))
+    served = shed = 0
+    for f in futs:
+        try:
+            res = f.result(timeout=300)
+            assert res.count >= 0
+            served += 1
+        except DeadlineExceeded:
+            shed += 1
+    wall = time.perf_counter() - t0
+    wt.join()
+
+    # settle the standing tier: keep the dispatch cycle turning until
+    # every subscription is clean (bounded — staleness keeps score)
+    settle_t0 = time.perf_counter()
+    while time.perf_counter() - settle_t0 < 120:
+        mgr.pump()
+        with mgr._lock:
+            busy = any(s.dirty or s.inflight is not None
+                       for s in mgr.subs.all())
+        if not busy:
+            break
+        time.sleep(0.01)
+    settle_s = time.perf_counter() - settle_t0
+
+    s = rt.stats_snapshot()
+    sub_snap = mgr.stats.snapshot()
+
+    # -- differential verdict, the WIRE way: initial snapshot + folded
+    # polled deltas must equal the exact host oracle at settle
+    diff_equal = True
+    diffs = []
+    for sid, kind, matches in folded[:probe_n]:
+        while True:
+            env = mgr.poll(sid, max_notes=64, timeout_s=0.0)
+            if env["what"] == "resync":
+                matches = {int(h) for h in env["matches"]}
+                break
+            for note in env["notes"]:
+                matches.difference_update(
+                    int(h) for h in note["removed"])
+                matches.update(int(h) for h in note["added"])
+            if not env["more"] and not env["notes"]:
+                break
+        sub = mgr.subs.get(sid)
+        want = mgr._full_eval(sub)
+        if matches != want:
+            diff_equal = False
+            if len(diffs) < 5:
+                diffs.append([sid, kind, len(matches), len(want)])
+
+    # -- host baseline: the same ad-hoc pattern answered by the by-target
+    # host index walk (what a caller paid without the serving tier)
+    def host_window():
+        t0 = time.perf_counter()
+        for i in range(base_n):
+            g.find_all(qc.Incident(seeds[i]))
+        return base_n / (time.perf_counter() - t0)
+
+    host_qps = best_of(host_window, n=2)
+    mgr.close()
+    rt.close(drain=True, timeout=120)
+
+    with tap.lock:
+        notify_lat = sorted(tap.lanes.get("sub") or ())
+    n_lat = len(notify_lat)
+
+    def pct(q):
+        if not n_lat:
+            return None
+        return round(notify_lat[min(n_lat - 1, (q * n_lat) // 100)]
+                     * 1e3, 2)
+
+    telemetry = _telemetry_dump(
+        "c10", registries=[rt.stats.registry, mgr.stats.registry,
+                           g.metrics.registry]
+    )
+    g.close()
+    served_qps = served / wall if wall else 0.0
+    out = {
+        "entities": n_entities,
+        "links": n_links,
+        "requests": n_requests,
+        "offered_qps": round(offered_qps, 1),
+        "served_qps": round(served_qps, 1),
+        "served": served,
+        "shed_deadline": shed,
+        "deadline_s": deadline_s,
+        "host_pattern_qps": round(host_qps, 1),
+        "device_vs_host": (
+            round(served_qps / host_qps, 2) if host_qps else None
+        ),
+        "batches": s["batches"],
+        "device_dispatches": s["device_dispatches"],
+        "batch_occupancy": (
+            round(s["batch_occupancy"], 3)
+            if s["batch_occupancy"] is not None else None
+        ),
+        "latency_ms_p50": (
+            round(s["latency_ms"]["p50"], 2)
+            if s["latency_ms"]["p50"] is not None else None
+        ),
+        "latency_ms_p99": (
+            round(s["latency_ms"]["p99"], 2)
+            if s["latency_ms"]["p99"] is not None else None
+        ),
+        "host_fallbacks": s["host_fallbacks"],
+        "concurrent_ingest_atoms_per_sec": round(
+            ingested["atoms"] / ingested["s"], 1
+        ) if ingested["s"] else None,
+        "sub": {
+            "subscriptions": n_subs,
+            "eval_rounds": sub_snap["sub.eval_rounds"],
+            "evals": sub_snap["sub.evals"],
+            "dirty_skipped": sub_snap["sub.dirty_skipped"],
+            "notified": sub_snap["sub.notified"],
+            "shed": sub_snap["sub.shed"],
+            "notify_samples": n_lat,
+            "notify_ms_p50": pct(50),
+            "notify_ms_p99": pct(99),
+            "settle_s": round(settle_s, 3),
+        },
+        "differential_probes": probe_n,
+        "differential_equal": diff_equal,
+        "backend": _backend_name(),
+    }
+    if diffs:
+        out["differential_diff"] = diffs
+    if telemetry:
+        out["tracing"] = telemetry["sampling"]
+        out["telemetry"] = telemetry
+    out["recorded_to"] = _record_bench("c10_pattern", out)
+    return out
+
+
 # ------------------------------------------------------------- bench records
 
 #: committed envelope schema for every ``BENCH_C*_<tag>.json`` record.
@@ -1775,6 +2060,7 @@ BENCH_RECORDED = {
     "c7_pattern_join": ("BENCH_C7_TAG", "BENCH_C7"),
     "c8_sharded": ("BENCH_C8_TAG", "BENCH_C8"),
     "c9_value_index": ("BENCH_C9_TAG", "BENCH_C9"),
+    "c10_pattern": ("BENCH_C10_TAG", "BENCH_C10"),
 }
 
 
@@ -2141,6 +2427,11 @@ def _config_c9() -> dict:
     return _with_telemetry("c9", bench_c9)
 
 
+def _config_c10() -> dict:
+    _bench_entry_env()
+    return _with_telemetry("c10", bench_c10)
+
+
 def _run_isolated(name: str) -> dict:
     """Run one config in a FRESH python subprocess.
 
@@ -2204,6 +2495,7 @@ def main() -> None:
         c7 = _run_isolated("c7")
         c8 = _run_isolated("c8")
         c9 = _run_isolated("c9")
+        c10 = _run_isolated("c10")
         graph = c4.pop("_graph")
     else:  # legacy in-process path (BENCH_ISOLATE=0): order still matters
         # c6's cold-start probe BEFORE any config initializes the device
@@ -2224,6 +2516,7 @@ def main() -> None:
         c7 = _with_telemetry("c7", lambda: bench_c7(snap, info))
         c8 = _with_telemetry("c8", bench_c8)
         c9 = _with_telemetry("c9", bench_c9)
+        c10 = _with_telemetry("c10", bench_c10)
         graph = {
             "n_atoms": info["n_atoms"],
             "total_arity": info["total_arity"],
@@ -2243,6 +2536,7 @@ def main() -> None:
             "c7_pattern_join": c7,
             "c8_sharded": c8,
             "c9_value_index": c9,
+            "c10_pattern": c10,
         },
         "graph": graph,
     }))

@@ -162,29 +162,34 @@ def seed_baseline(bench_dirs=".", out_path: Optional[str] = None,
     ``BENCH_C*`` files in ``bench_dirs`` (one dir or a sequence — the
     bench CLI passes both the repo dir and ``BENCH_RECORD_DIR``):
 
-    - ``bfs``   ← ``BENCH_C6_*`` (open-loop serving: real latency
+    - ``bfs``     ← ``BENCH_C6_*`` (open-loop serving: real latency
       percentiles + served qps);
-    - ``range`` ← ``BENCH_C9_*`` (same shape);
-    - ``join``  ← ``BENCH_C7_*`` — c7 is closed-loop THROUGHPUT, so the
-      latency anchor is the per-anchor mean (``1 /
+    - ``range``   ← ``BENCH_C9_*`` (same shape);
+    - ``pattern`` + ``sub`` ← ``BENCH_C10_*`` — one c10 run carries
+      BOTH the ad-hoc open-loop pattern percentiles and the standing
+      tier's notification-latency percentiles (ingest-dirty →
+      delta-enqueued, the ``sub`` lane the manager feeds the sentinel);
+    - ``join``    ← ``BENCH_C7_*`` — c7 is closed-loop THROUGHPUT, so
+      the latency anchor is the per-anchor mean (``1 /
       triangle.device_anchors_per_sec``) with ``p99_s`` a 4× heuristic,
       recorded as such in the lane's ``note``.
 
     Per config the NEWEST record wins (``recorded_unix``): the
     documented re-seed flow — run a real-hardware sweep under a new
     tag, then seed — must pick the fresh run over the committed smokes,
-    whatever its tag. Lanes with no bench record (``pattern``) are
-    omitted — the sentinel only gates lanes the baseline names. Writes
-    ``out_path`` when given; returns the record either way."""
+    whatever its tag. Lanes with no bench record are omitted — the
+    sentinel only gates lanes the baseline names. Writes ``out_path``
+    when given; returns the record either way."""
     if isinstance(bench_dirs, str):
         bench_dirs = (bench_dirs,)
     lanes: dict = {}
     sources: list = []
     backends: list = []
     for prefix, key, build in (
-        ("BENCH_C6", "c6_serving", _lane_from_serving),
-        ("BENCH_C9", "c9_value_index", _lane_from_serving),
-        ("BENCH_C7", "c7_pattern_join", _lane_from_join),
+        ("BENCH_C6", "c6_serving", _lanes_from_serving),
+        ("BENCH_C9", "c9_value_index", _lanes_from_serving),
+        ("BENCH_C10", "c10_pattern", _lanes_from_pattern),
+        ("BENCH_C7", "c7_pattern_join", _lanes_from_join),
     ):
         candidates = sorted(_bench_candidates(bench_dirs, prefix),
                             key=lambda t: t[0], reverse=True)
@@ -192,15 +197,17 @@ def seed_baseline(bench_dirs=".", out_path: Optional[str] = None,
             payload = rec.get(key)
             if not isinstance(payload, dict):
                 continue
-            lane_name, lane = build(payload)
-            if lane:
-                # per-lane provenance: a partial re-record (only c6 on
-                # real hardware, range/join still the CPU smokes) must
-                # not masquerade as a uniform contract
-                lane["backend"] = str(rec.get("backend") or "unknown")
-                lanes[lane_name] = lane
+            built = [(name, lane) for name, lane in build(payload)
+                     if lane]
+            if built:
+                for lane_name, lane in built:
+                    # per-lane provenance: a partial re-record (only c6
+                    # on real hardware, the rest still CPU smokes) must
+                    # not masquerade as a uniform contract
+                    lane["backend"] = str(rec.get("backend") or "unknown")
+                    lanes[lane_name] = lane
+                    backends.append(lane["backend"])
                 sources.append(os.path.basename(path))
-                backends.append(lane["backend"])
                 break
     uniq = sorted(set(backends))
     record = {
@@ -219,10 +226,9 @@ def seed_baseline(bench_dirs=".", out_path: Optional[str] = None,
     return record
 
 
-def _lane_from_serving(payload: dict):
-    """c6/c9 payloads share the open-loop serving shape: latency
+def _serving_lane(payload: dict) -> dict:
+    """The shared open-loop serving shape (c6/c9/c10): latency
     percentiles in ms + served qps."""
-    lane_name = "bfs" if "batched_vs_unbatched" in payload else "range"
     lane = {}
     p50, p99 = payload.get("latency_ms_p50"), payload.get("latency_ms_p99")
     if p50:
@@ -231,22 +237,47 @@ def _lane_from_serving(payload: dict):
         lane["p99_s"] = round(float(p99) / 1e3, 6)
     if payload.get("served_qps"):
         lane["qps"] = float(payload["served_qps"])
-    return lane_name, lane
+    return lane
 
 
-def _lane_from_join(payload: dict):
+def _lanes_from_serving(payload: dict):
+    lane_name = "bfs" if "batched_vs_unbatched" in payload else "range"
+    return [(lane_name, _serving_lane(payload))]
+
+
+def _lanes_from_pattern(payload: dict):
+    """One c10 record seeds TWO lanes: the ad-hoc ``pattern`` serving
+    percentiles and the standing-subscription ``sub`` lane, whose
+    latency samples are notification deliveries (ingest-dirty →
+    delta-enqueued), fed to the sentinel by the SubscriptionManager."""
+    out = [("pattern", _serving_lane(payload))]
+    sub = payload.get("sub") or {}
+    lane = {}
+    p50, p99 = sub.get("notify_ms_p50"), sub.get("notify_ms_p99")
+    if p50:
+        lane["p50_s"] = round(float(p50) / 1e3, 6)
+    if p99:
+        lane["p99_s"] = round(float(p99) / 1e3, 6)
+    if lane:
+        lane["note"] = ("standing-subscription notification latency "
+                        "(dirty -> delta enqueued)")
+    out.append(("sub", lane))
+    return out
+
+
+def _lanes_from_join(payload: dict):
     tri = payload.get("triangle") or {}
     qps = tri.get("device_anchors_per_sec")
     if not qps or qps <= 0:
-        return "join", {}
+        return [("join", {})]
     p50 = 1.0 / float(qps)
-    return "join", {
+    return [("join", {
         "p50_s": round(p50, 6),
         "p99_s": round(4.0 * p50, 6),
         "qps": float(qps),
         "note": "closed-loop c7 throughput proxy (per-anchor mean; "
                 "p99 is a 4x heuristic)",
-    }
+    })]
 
 
 # --------------------------------------------------------- skew attribution

@@ -263,6 +263,7 @@ def fleet_objectives(collector, monitor: Optional[SLOMonitor] = None,
                      lag_target: float = 0.999,
                      availability_target: float = 0.999,
                      perf_target: float = 0.999,
+                     sub_target: float = 0.999,
                      windows: tuple = DEFAULT_WINDOWS) -> SLOMonitor:
     """Wire the standard fleet SLO set over a
     :class:`~hypergraphdb_tpu.obs.fleet.FleetCollector`:
@@ -278,7 +279,11 @@ def fleet_objectives(collector, monitor: Optional[SLOMonitor] = None,
       (``obs.perf.PerfSentinel``, advertised as the ``perf`` healthz
       section) reports ANY lane or skew violation is one bad event —
       the fleet-level error budget over the hgperf verdicts. Nodes
-      without a sentinel don't vote (absent ≠ healthy).
+      without a sentinel don't vote (absent ≠ healthy);
+    - ``sub_staleness`` — per poll, each node whose hgsub subscription
+      tier (the ``sub`` healthz section) reports a standing query dirty
+      past its staleness bound is one bad event — the freshness budget
+      of the streaming tier. Nodes without subscriptions don't vote.
 
     Returns the monitor (created on the collector's clock when not
     passed) — attach it with ``FleetCollector(..., slo=monitor)`` or
@@ -293,7 +298,8 @@ def fleet_objectives(collector, monitor: Optional[SLOMonitor] = None,
 
     # level-triggered objectives accumulate poll verdicts here (sources
     # must yield CUMULATIVE totals)
-    acc = {"lag": [0, 0], "avail": [0, 0], "perf": [0, 0]}
+    acc = {"lag": [0, 0], "avail": [0, 0], "perf": [0, 0],
+           "sub": [0, 0]}
 
     def lag_source():
         good, bad = 0, 0
@@ -340,6 +346,20 @@ def fleet_objectives(collector, monitor: Optional[SLOMonitor] = None,
         acc["perf"][1] += bad
         return tuple(acc["perf"])
 
+    def sub_source():
+        good, bad = 0, 0
+        for scrape in collector.node_scrapes().values():
+            s = (scrape.health or {}).get("sub")
+            if not isinstance(s, dict):
+                continue  # no subscription tier here: it doesn't vote
+            if s.get("violating"):
+                bad += 1
+            else:
+                good += 1
+        acc["sub"][0] += good
+        acc["sub"][1] += bad
+        return tuple(acc["sub"])
+
     mon.add(Objective("serve_deadline", deadline_target,
                       "requests resolved within their deadline",
                       windows), deadline_source)
@@ -352,4 +372,7 @@ def fleet_objectives(collector, monitor: Optional[SLOMonitor] = None,
     mon.add(Objective("perf_drift", perf_target,
                       "nodes with every lane inside its perf baseline",
                       windows), perf_source)
+    mon.add(Objective("sub_staleness", sub_target,
+                      "nodes with every standing query inside its "
+                      "staleness bound", windows), sub_source)
     return mon

@@ -1,4 +1,6 @@
-"""The HTTP skin of the replicated tier: ``POST /submit`` + ``GET /healthz``.
+"""The HTTP skin of the replicated tier: ``POST /submit`` + ``GET /healthz``
+(+ the hgsub subscription surface: ``POST /subscribe``,
+``GET /notifications``).
 
 One tiny stdlib server class worn twice:
 
@@ -8,6 +10,16 @@ One tiny stdlib server class worn twice:
 - the **front door** runs a :class:`SubmitServer` whose submit function
   IS :meth:`~hypergraphdb_tpu.replica.router.FrontDoor.submit` — the
   one URL callers see.
+
+**Subscriptions** ride the same port when a handler is wired
+(``subscribe_fn`` / ``poll_fn``): ``POST /subscribe`` takes the
+``sub/wire`` subscribe/unsubscribe envelopes, ``GET
+/notifications?id=<sid>&timeout_s=<s>&max=<n>`` long-polls one
+subscription's delta queue (the poll parks INSIDE the handler thread —
+``ThreadingHTTPServer`` gives each poll its own; ``sub/wire`` clamps
+the park below the handler's socket timeout). Nodes without the
+subscription tier answer 404, which the front door reads as "route
+elsewhere".
 
 Status mapping (what :class:`~.router.HTTPBackend` keys its typed
 errors off)::
@@ -102,6 +114,25 @@ class _Handler(BaseHTTPRequestHandler):
                 self._respond(500, {"error": type(e).__name__,
                                     "message": str(e)})
             return
+        if path == "/notifications":
+            if srv.poll_fn is None:
+                self._respond(404, {"error": "NotFound",
+                                    "message": "no subscription tier"})
+                return
+            from urllib.parse import parse_qs, urlsplit
+
+            q = parse_qs(urlsplit(self.path).query)
+            params = {k: v[0] for k, v in q.items() if v}
+            try:
+                result = srv.poll_fn(params)
+            except BaseException as e:  # noqa: BLE001 - typed status map
+                self._respond(_status_of(e), {"error": type(e).__name__,
+                                              "message": str(e)})
+                if not isinstance(e, Exception):
+                    raise
+                return
+            self._respond(200, result)
+            return
         if path != "/healthz":
             self._respond(404, {"error": "NotFound", "message": path})
             return
@@ -156,8 +187,16 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self) -> None:  # noqa: N802
         srv: "SubmitServer" = self.server.submit_server  # type: ignore[attr-defined]
         path = self.path.split("?", 1)[0]
-        if path != "/submit":
+        if path == "/subscribe":
+            fn = srv.subscribe_fn
+        elif path == "/submit":
+            fn = srv.submit_fn
+        else:
             self._respond(404, {"error": "NotFound", "message": path})
+            return
+        if fn is None:
+            self._respond(404, {"error": "NotFound",
+                                "message": "no subscription tier"})
             return
         try:
             n = int(self.headers.get("Content-Length", 0))
@@ -169,7 +208,7 @@ class _Handler(BaseHTTPRequestHandler):
                                 "message": str(e)})
             return
         try:
-            result = srv.submit_fn(payload)
+            result = fn(payload)
         except BaseException as e:  # noqa: BLE001 - typed status mapping
             self._respond(_status_of(e), {"error": type(e).__name__,
                                           "message": str(e)})
@@ -194,9 +233,16 @@ class SubmitServer:
     def __init__(self, submit_fn: Callable[[dict], dict],
                  health: Optional[HealthProbe] = None,
                  host: str = "127.0.0.1", port: int = 0,
-                 fleet=None):
+                 fleet=None,
+                 subscribe_fn: Optional[Callable[[dict], dict]] = None,
+                 poll_fn: Optional[Callable[[dict], dict]] = None):
         self.submit_fn = submit_fn
         self.health = health
+        #: hgsub surface: ``POST /subscribe`` body → response envelope,
+        #: and ``GET /notifications`` query params → poll envelope.
+        #: None (the default) answers 404 on both paths.
+        self.subscribe_fn = subscribe_fn
+        self.poll_fn = poll_fn
         #: optional hgobs FleetCollector: serves /fleet/metrics,
         #: /fleet/healthz, /fleet/slo, /fleet/perf,
         #: /fleet/traces[/<tid>] ON this
@@ -255,15 +301,30 @@ def node_server(node, timeout_s: float = 30.0,
     """A replica node's submit endpoint: runtime + health in one call.
     ``authoritative=True`` marks a PRIMARY's endpoint: an unknown gid
     answers 400 (the gid is wrong) instead of 503 (merely not here yet).
-    Explain responses are stamped with the node's peer identity."""
+    Explain responses are stamped with the node's peer identity. When
+    the node's runtime carries an hgsub ``SubscriptionManager``
+    (``runtime.subscriptions``), the subscription surface is served
+    beside ``/submit``."""
     from hypergraphdb_tpu.replica.router import submit_payload
 
     ident = getattr(getattr(node, "peer", None), "identity", None)
+    subscribe_fn = poll_fn = None
+    if getattr(node.runtime, "subscriptions", None) is not None:
+        from hypergraphdb_tpu.sub.wire import (
+            poll_payload,
+            subscribe_payload,
+        )
+
+        subscribe_fn = (
+            lambda p: subscribe_payload(node.runtime.subscriptions, p)
+        )
+        poll_fn = lambda p: poll_payload(node.runtime.subscriptions, p)
     return SubmitServer(
         lambda p: submit_payload(node.runtime, p, timeout_s,
                                  authoritative=authoritative,
                                  node_id=ident),
         health=node.health_probe(), host=host, port=port,
+        subscribe_fn=subscribe_fn, poll_fn=poll_fn,
     )
 
 
@@ -271,6 +332,9 @@ def frontdoor_server(frontdoor, host: str = "127.0.0.1",
                      port: int = 0, fleet=None) -> SubmitServer:
     """The front door's public endpoint; pass a
     :class:`~hypergraphdb_tpu.obs.fleet.FleetCollector` as ``fleet`` to
-    serve the ``/fleet/*`` views beside ``/submit``."""
+    serve the ``/fleet/*`` views beside ``/submit``. Subscriptions are
+    routed (and re-anchored across failover) by the door itself."""
     return SubmitServer(frontdoor.submit, health=frontdoor.health_probe(),
-                        host=host, port=port, fleet=fleet)
+                        host=host, port=port, fleet=fleet,
+                        subscribe_fn=frontdoor.subscribe,
+                        poll_fn=frontdoor.poll)

@@ -73,6 +73,14 @@ class ReplicaConfig:
     #: serving knobs for the node's own runtime (``admission_gate`` is
     #: overwritten with the replica's lag gate)
     serve: ServeConfig = field(default_factory=ServeConfig)
+    #: stand up the node's standing-query tier (a
+    #: :class:`~hypergraphdb_tpu.sub.SubscriptionManager` attached to
+    #: the runtime, anchored at the replication log position so a
+    #: subscription's resume seq is comparable across the tier)
+    subscriptions: bool = True
+    #: :class:`~hypergraphdb_tpu.sub.SubConfig` overrides (None =
+    #: defaults)
+    sub: Optional[object] = None
 
 
 class ReplicaNode:
@@ -87,6 +95,9 @@ class ReplicaNode:
         self.peer = peer
         self.config = config
         self.runtime: Optional[ServeRuntime] = None
+        #: the node's standing-query manager (None until started, or
+        #: when ``config.subscriptions`` is off)
+        self.subscriptions = None
         self.bootstrapped = False
         #: how the last bootstrap ran: "transfer" (full snapshot pull)
         #: or "resume" (incremental catch-up from the persisted clock)
@@ -120,11 +131,31 @@ class ReplicaNode:
             rt = ServeRuntime(self.graph, cfg)
             with self._state_lock:
                 self.runtime = rt
+            if self.config.subscriptions:
+                from hypergraphdb_tpu.sub import SubscriptionManager
+
+                rep = self.peer.replication
+                primary = self.config.primary
+                # anchor standing queries at the REPLICATION log
+                # position: the seq a notification carries is the same
+                # coordinate on every node, which is what lets the
+                # front door resume a subscription on another backend
+                sub = SubscriptionManager(
+                    self.graph, rt, self.config.sub,
+                    seq_source=lambda: rep.last_seen.get(primary),
+                )
+                rt.attach_subscriptions(sub)
+                with self._state_lock:
+                    self.subscriptions = sub
         except BaseException:
             # a failed bootstrap must not leak a started peer (worker
             # threads, transport, a published interest the primary keeps
             # pushing to) — stop() is a no-op until _started flips
             try:
+                if self.subscriptions is not None:
+                    self.subscriptions.close()
+                    with self._state_lock:
+                        self.subscriptions = None
                 if self.runtime is not None:
                     self.runtime.close(drain=False)
                     with self._state_lock:
@@ -162,6 +193,10 @@ class ReplicaNode:
             # transfer that outlives it keeps running on the daemon
             # thread against the stopping peer and fails typed there
             t.join(timeout=5)
+        if self.subscriptions is not None:
+            # before the runtime: close wakes parked polls and stops new
+            # evals, so the runtime's drain isn't fed by a dying tier
+            self.subscriptions.close()
         if self.runtime is not None:
             self.runtime.close(drain=drain)
         self.peer.stop()
@@ -293,6 +328,12 @@ class ReplicaNode:
             }
             if gate is not None:
                 payload["read_gate"] = gate
+            sub = self.subscriptions
+            if sub is not None:
+                # the standing-query story rides the same body the
+                # fleet SLO tier scrapes (the ``sub_staleness``
+                # objective reads ``sub.violating``)
+                payload["sub"] = sub.health_section()
             return gate is None, payload
 
         if self.runtime is None:

@@ -36,6 +36,18 @@ Backends are duck-typed (``id`` / ``submit(payload, timeout)`` /
 ``health()``): :class:`LocalBackend` wraps an in-process runtime (tests,
 single-host tiers), :class:`HTTPBackend` speaks to a
 :class:`~hypergraphdb_tpu.replica.httpd.SubmitServer` over real sockets.
+
+**Standing queries** route through the same door: the FrontDoor owns a
+door-side subscription id (``dsub-<n>``) per standing query, places the
+subscribe like a submit, and MIRRORS the subscription (original
+payload, current match set, anchoring seq, digest) so a backend loss is
+survivable — when a poll finds the owning backend gone, the door
+re-subscribes the original payload on another healthy backend and
+synthesizes the ONE delta notification between the mirror's anchor seq
+and the new snapshot (no loss, no duplicates; seqs are comparable
+across backends because every node's subscription manager anchors at
+the replication log position). Consumers never see backend identity:
+subscription ids are rewritten both ways.
 """
 
 from __future__ import annotations
@@ -44,6 +56,7 @@ import json
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from dataclasses import dataclass
 from typing import Callable, Optional, Sequence
@@ -201,6 +214,22 @@ class LocalBackend:
                               authoritative=self.role == "primary",
                               node_id=self.id)
 
+    def _sub_manager(self):
+        m = getattr(self.runtime, "subscriptions", None)
+        if m is None:
+            raise Unservable(f"{self.id} has no subscription tier")
+        return m
+
+    def subscribe(self, payload: dict, timeout: float) -> dict:
+        from hypergraphdb_tpu.sub.wire import subscribe_payload
+
+        return subscribe_payload(self._sub_manager(), payload)
+
+    def poll(self, params: dict, timeout: float) -> dict:
+        from hypergraphdb_tpu.sub.wire import poll_payload
+
+        return poll_payload(self._sub_manager(), params)
+
     def health(self):
         if self._health is None:
             return True, {"role": self.role}
@@ -221,12 +250,31 @@ class HTTPBackend:
         self.health_timeout_s = health_timeout_s
 
     def submit(self, payload: dict, timeout: float) -> dict:
-        req = urllib.request.Request(
+        return self._roundtrip(urllib.request.Request(
             self.url + "/submit",
             data=json.dumps(payload).encode("utf-8"),
             headers={"Content-Type": "application/json"},
             method="POST",
-        )
+        ), timeout)
+
+    def subscribe(self, payload: dict, timeout: float) -> dict:
+        return self._roundtrip(urllib.request.Request(
+            self.url + "/subscribe",
+            data=json.dumps(payload).encode("utf-8"),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        ), timeout)
+
+    def poll(self, params: dict, timeout: float) -> dict:
+        qs = urllib.parse.urlencode({
+            k: params[k] for k in ("id", "timeout_s", "max")
+            if params.get(k) is not None
+        })
+        return self._roundtrip(urllib.request.Request(
+            self.url + "/notifications?" + qs, method="GET",
+        ), timeout)
+
+    def _roundtrip(self, req, timeout: float) -> dict:
         try:
             with urllib.request.urlopen(req, timeout=timeout) as r:
                 return json.loads(r.read().decode("utf-8"))
@@ -320,6 +368,11 @@ class FrontDoor:
         #: already in flight places with the snapshot it has instead of
         #: queueing another N-probe sweep behind it
         self._refresh_gate = threading.Lock()
+        #: door sid → subscription mirror: the resume state that makes a
+        #: backend loss survivable (original payload to re-subscribe,
+        #: the match set + replication-anchored seq to diff against)
+        self._subs: dict[str, dict] = {}
+        self._sub_seq = 0
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "FrontDoor":
@@ -538,6 +591,222 @@ class FrontDoor:
             raise
         res["routed_to"] = self.primary.id
         return res
+
+    # -- standing queries ------------------------------------------------------
+    def _backend_by_id(self, bid):
+        for be in self.replicas:
+            if be.id == bid:
+                return be
+        return self.primary if self.primary.id == bid else None
+
+    def subscribe(self, payload: dict,
+                  timeout: Optional[float] = None) -> dict:
+        """Place one standing query (or relay an ``unsubscribe``): the
+        same placement/breaker walk as :meth:`submit`, plus a door-side
+        mirror so the subscription survives losing its backend. The
+        response's ``id`` is the DOOR's (``dsub-<n>``); consumers poll
+        and unsubscribe through the door only."""
+        timeout = timeout if timeout is not None \
+            else self.config.submit_timeout_s
+        if payload.get("what") == "unsubscribe":
+            return self._unsubscribe(payload, timeout)
+        self.metrics.incr("router.sub_subscribes")
+        attempts = 0
+        for be in self._placement(payload):
+            if attempts >= self.config.max_attempts:
+                break
+            if not self.breaker.allow(be.id):
+                continue
+            attempts += 1
+            try:
+                resp = be.subscribe(payload, timeout)
+            except (DeadlineExceeded, *_PERMANENT):
+                self.metrics.incr("router.errors")
+                raise
+            except AdmissionGated:
+                self.metrics.incr("router.lag_rerouted")
+                continue
+            except Exception:  # noqa: BLE001 - transport/timeout/5xx
+                self.breaker.record_failure(be.id)
+                self.metrics.incr("router.rerouted")
+                continue
+            self.breaker.record_success(be.id)
+            return self._adopt(be, payload, resp)
+        try:
+            resp = self.primary.subscribe(payload, timeout)
+        except Exception:
+            self.metrics.incr("router.errors")
+            raise
+        return self._adopt(self.primary, payload, resp)
+
+    @staticmethod
+    def _snapshot(resp: dict) -> dict:
+        """Read one ``subscribed`` envelope into the mirror's fields."""
+        return {
+            "sid": resp.get("id"),
+            "kind": resp.get("kind"),
+            "window": resp.get("window"),
+            "matches": {int(m) for m in resp.get("matches") or ()},
+            "seq": int(resp.get("seq") or 0),
+            "digest": resp.get("digest"),
+        }
+
+    def _adopt(self, be, payload: dict, resp: dict) -> dict:
+        """Mirror one freshly-placed subscription and rewrite its id to
+        the door's namespace."""
+        if resp.get("what") == "subscribed":
+            snap = self._snapshot(resp)
+            with self._lock:
+                self._sub_seq += 1
+                dsid = f"dsub-{self._sub_seq}"
+                self._subs[dsid] = dict(
+                    snap, backend=be.id, payload=dict(payload))
+            self.metrics.incr("router.subscriptions")
+            out = dict(resp)
+            out["id"] = dsid
+            out["routed_to"] = be.id
+            return out
+        return resp  # relay odd shapes verbatim (future envelopes)
+
+    def _unsubscribe(self, payload: dict, timeout: float) -> dict:
+        dsid = payload.get("id")
+        with self._lock:
+            m = self._subs.pop(dsid, None) if isinstance(dsid, str) \
+                else None
+        if m is None:
+            raise Unservable(f"unknown subscription {dsid!r}")
+        be = self._backend_by_id(m["backend"])
+        if be is not None:
+            try:
+                be.subscribe({"what": "unsubscribe", "id": m["sid"]},
+                             timeout)
+            except Exception:  # noqa: BLE001 - best effort: the door's
+                # view is gone either way; an orphaned backend copy
+                # sheds into its bounded queue until resource close
+                self.metrics.incr("router.sub_orphaned")
+        return {"what": "unsubscribed", "id": dsid}
+
+    def poll(self, params: dict,
+             timeout: Optional[float] = None) -> dict:
+        """Long-poll one door subscription. The happy path relays to the
+        owning backend and folds the answered deltas into the mirror;
+        ANY backend failure (transport, 5xx, a restarted replica that
+        lost its subscription state) triggers the resume path instead of
+        surfacing an error: re-subscribe elsewhere, diff, synthesize."""
+        dsid = params.get("id")
+        with self._lock:
+            m = self._subs.get(dsid) if isinstance(dsid, str) else None
+        if m is None:
+            raise Unservable(f"unknown subscription {dsid!r}")
+        try:  # validate the caller's knobs HERE: past this point every
+            # backend refusal is a backend-state problem → failover
+            wait = float(params.get("timeout_s", 0.0) or 0.0)
+            int(params.get("max", 32) or 32)
+        except (TypeError, ValueError) as e:
+            raise Unservable(f"bad poll parameter: {e}") from None
+        self.metrics.incr("router.sub_polls")
+        timeout = timeout if timeout is not None \
+            else wait + self.config.submit_timeout_s
+        be = self._backend_by_id(m["backend"])
+        if be is not None:
+            try:
+                env = be.poll(dict(params, id=m["sid"]), timeout)
+            except DeadlineExceeded:
+                self.metrics.incr("router.errors")
+                raise
+            except Exception:  # noqa: BLE001 - the backend lost it (or
+                # itself): resume, don't error
+                self.breaker.record_failure(be.id)
+                return self._sub_failover(dsid, m, timeout)
+            return self._fold_poll(dsid, m, env)
+        return self._sub_failover(dsid, m, timeout)
+
+    def _fold_poll(self, dsid: str, m: dict, env: dict) -> dict:
+        """Apply one backend poll answer to the mirror and rewrite ids.
+        The mirror replays the consumer contract exactly: deltas chain
+        seq→seq, a resync replaces the set wholesale."""
+        what = env.get("what")
+        if what == "resync":
+            if env.get("id") != m["sid"]:
+                # the backend answered for a DIFFERENT subscription —
+                # never expected; count it rather than corrupt the mirror
+                self.metrics.incr("router.sub_id_mismatches")
+                return dict(env, id=dsid)
+            with self._lock:
+                m["matches"] = {int(x) for x in env.get("matches") or ()}
+                m["seq"] = int(env.get("seq") or 0)
+                m["digest"] = env.get("digest")
+            return dict(env, id=dsid)
+        if what == "notifications":
+            if env.get("id") != m["sid"]:
+                self.metrics.incr("router.sub_id_mismatches")
+                return dict(env, id=dsid, notes=[], more=False)
+            notes = []
+            for note in env.get("notes") or ():
+                if note.get("what") == "notification" \
+                        and note.get("id") == m["sid"]:
+                    added = [int(x) for x in note.get("added") or ()]
+                    removed = [int(x) for x in note.get("removed") or ()]
+                    with self._lock:
+                        if int(note.get("seq_from") or 0) != m["seq"]:
+                            # a broken chain the backend's own resync
+                            # discipline should make impossible — count
+                            # it and re-anchor at the note's far edge
+                            self.metrics.incr("router.sub_chain_gaps")
+                        m["matches"].difference_update(removed)
+                        m["matches"].update(added)
+                        m["seq"] = int(note.get("seq_to") or 0)
+                        m["digest"] = note.get("digest")
+                    notes.append(dict(note, id=dsid))
+                else:
+                    notes.append(note)
+            return {"what": "notifications", "id": dsid, "notes": notes,
+                    "more": bool(env.get("more"))}
+        return dict(env, id=dsid)
+
+    def _sub_failover(self, dsid: str, m: dict, timeout: float) -> dict:
+        """The resume path: the owning backend is gone (or forgot the
+        subscription), so re-place the ORIGINAL payload on another
+        backend and answer the poll with the ONE synthesized delta
+        between the mirror's anchor seq and the adopted snapshot — the
+        consumer sees an ordinary chained notification, never a gap, a
+        loss, or a duplicate."""
+        self.metrics.incr("router.sub_failovers")
+        old = set(m["matches"])
+        old_seq = m["seq"]
+        dead = m["backend"]
+        candidates = [be for be in self._placement(m["payload"])
+                      if be.id != dead]
+        if self.primary.id != dead:
+            candidates.append(self.primary)
+        adopted, snap = None, None
+        for be in candidates:
+            try:
+                r = be.subscribe(m["payload"], timeout)
+            except Exception:  # noqa: BLE001 - keep walking the tier
+                continue
+            if r.get("what") == "subscribed":
+                adopted, snap = be, self._snapshot(r)
+                break
+        if adopted is None:
+            raise TransientFault(
+                f"subscription {dsid} lost backend {dead!r} and no other "
+                "backend could adopt it")
+        new = snap["matches"]
+        with self._lock:
+            m.update(snap, backend=adopted.id)
+            new_seq, digest = m["seq"], m["digest"]
+        added = sorted(new - old)
+        removed = sorted(old - new)
+        notes = []
+        if added or removed or new_seq != old_seq:
+            notes = [{
+                "what": "notification", "id": dsid,
+                "seq_from": old_seq, "seq_to": new_seq,
+                "added": added, "removed": removed, "digest": digest,
+            }]
+        return {"what": "notifications", "id": dsid, "notes": notes,
+                "more": False}
 
     # -- fleet observability ---------------------------------------------------
     def fleet_source(self, node_id: str = "router"):

@@ -814,13 +814,15 @@ class DeviceExecutor:
                         or (req.limit is not None
                             and req.limit > self.config.top_r)
                         or (req.anchor is not None
-                            and (req.anchor < 0 or req.anchor >= n
-                                 or view.new_atoms))):
+                            and (req.anchor < 0 or req.anchor >= n))):
                     # variable-width kinds (rank ties), over-window
-                    # limits, stale/oversized anchors, and anchored
-                    # lanes under fresh ingest (a memtable link incident
-                    # to the anchor is invisible to the BASE incidence
-                    # rows the filter probes) all serve exactly on host
+                    # limits, and anchors outside the base (a memtable
+                    # anchor has no base incidence row to probe) all
+                    # serve exactly on host. Anchored lanes under fresh
+                    # ingest stay on device: the base-row probe can only
+                    # mask fresh links OUT (never falsely in), and the
+                    # collect re-offers the full memtable candidate set
+                    # through the live-incidence host probe.
                     out.host_tickets.append(t)
                     continue
                 lo, hi = req.lo_rank, req.hi_rank
@@ -1064,16 +1066,20 @@ class DeviceExecutor:
         drop_arr = (np.fromiter(drop, dtype=np.int64)
                     if drop else np.empty(0, dtype=np.int64))
         cands = (set(residual) | view.revalued) - view.dead
-        # type-filtered lanes need the FULL memtable candidate set: the
-        # kernel's type filter reads the BASE type_of column, where a
-        # delta-column (memtable) gid is -1 — such atoms are masked out
-        # on device (never falsely in), so the host merge must re-offer
-        # every fresh atom, not just the uncovered residual. Built only
-        # when some lane actually carries a type filter (an untyped
-        # range-heavy batch must not pay O(|memtable|) per collect).
-        cands_typed = (
+        # filtered lanes need the FULL memtable candidate set: the
+        # kernel's type filter reads the BASE type_of column (a
+        # delta-column gid is -1 there) and the anchor filter probes the
+        # BASE incidence row (a memtable link incident to the anchor is
+        # not in it) — such atoms are masked out on device (never
+        # falsely in), so the host merge must re-offer every fresh atom
+        # through the live-graph predicate, not just the uncovered
+        # residual. Built only when some lane actually carries a filter
+        # (an unfiltered range-heavy batch must not pay O(|memtable|)
+        # per collect).
+        cands_full = (
             (set(view.new_atoms) | view.revalued) - view.dead
             if any(t.request.type_handle is not None
+                   or t.request.anchor is not None
                    for _, t in launched.lane_tickets)
             else cands
         )
@@ -1086,7 +1092,9 @@ class DeviceExecutor:
                     first_r[lane][first_r[lane] != SENTINEL],
                     bool(covered[lane]), int(total[lane]), view,
                     drop_arr,
-                    cands_typed if req.type_handle is not None else cands,
+                    cands_full
+                    if (req.type_handle is not None
+                        or req.anchor is not None) else cands,
                 )))
             except Exception as e:  # surface, don't kill the batch
                 out.append((ticket, e))
@@ -1576,6 +1584,13 @@ class ServeRuntime:
         #: device_attempted) — what _finalize needs, incl. the breaker's
         #: success/failure bookkeeping
         self._pending: Optional[tuple] = None
+        #: attached hgsub SubscriptionManager (``attach_subscriptions``):
+        #: the dispatch cycle drives its evaluator rounds, so standing
+        #: queries re-fire on the SAME thread that forms batches — their
+        #: evals coalesce with ad-hoc traffic by bucket key. Set before
+        #: the thread starts; read with getattr-free attribute access on
+        #: every cycle (None = one comparison)
+        self.subscriptions = None
         self._closed = False
         self._close_started = False
         self._draining = False
@@ -1744,9 +1759,32 @@ class ServeRuntime:
         )
 
     # -- dispatch ------------------------------------------------------------
+    def attach_subscriptions(self, manager) -> None:
+        """Wire an hgsub ``SubscriptionManager`` into the dispatch
+        cycle: every ``step``/``pump`` runs one evaluator round before
+        batch formation (dirty standing queries re-enter the admission
+        queue and coalesce with ad-hoc lanes) and one after finalize
+        (completed evals notify within the same wake)."""
+        with self._close_lock:
+            self.subscriptions = manager
+
+    def _pump_subs(self) -> None:
+        m = self.subscriptions
+        if m is None:
+            return
+        try:
+            m.pump()
+        except Exception:  # the evaluator must never stall dispatch
+            import logging
+
+            logging.getLogger("hypergraphdb_tpu.serve").exception(
+                "subscription pump error (continuing)"
+            )
+
     def step(self, drain: bool = False) -> bool:
         """ONE synchronous collect→launch→finalize cycle (manual mode /
         tests). Returns whether a batch was dispatched."""
+        self._pump_subs()
         t_form = self.tracer.clock() if self.tracer.enabled else None
         batch = self.batcher.next_batch(self.clock(), drain=drain)
         if batch is None:
@@ -1755,6 +1793,7 @@ class ServeRuntime:
         if inflight is not None:
             self.stats.record_batch(len(inflight[0]), batch.bucket)
             self._finalize(*inflight)
+            self._pump_subs()
         return True
 
     def pump(self, drain: bool = False) -> bool:
@@ -1762,6 +1801,7 @@ class ServeRuntime:
         finalize the previously launched one — host assembly of batch N+1
         overlaps device execution of batch N. Returns whether a new batch
         was consumed."""
+        self._pump_subs()
         t_form = self.tracer.clock() if self.tracer.enabled else None
         batch = self.batcher.next_batch(self.clock(), drain=drain)
         inflight = None
@@ -1772,6 +1812,7 @@ class ServeRuntime:
         prev = self._take_pending()
         if prev is not None:
             self._finalize(*prev)
+            self._pump_subs()
         with self._close_lock:
             self._pending = inflight
         return batch is not None

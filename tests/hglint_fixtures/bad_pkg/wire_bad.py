@@ -41,6 +41,29 @@ def on_message(content):
     return None
 
 
+# -- HG1102 at two forwarding hops: the handler delegates to a helper
+# that delegates to the decoder; the decoder's hard-read of a key no
+# producer writes must still be charged to the consumer ------------------
+
+
+def pong(link, seq):
+    link.send({"what": "wire-pong", "seq": seq})
+
+
+def on_pong(content):
+    if content.get("what") == "wire-pong":
+        return _relay_pong(content)
+    return None
+
+
+def _relay_pong(payload):
+    return _decode_pong(payload)
+
+
+def _decode_pong(payload):
+    return payload["seq"], payload["ttl"]  # HG1102: never produced
+
+
 # -- HG1103: persisted JSON record with no schema-version stamp ----------
 
 

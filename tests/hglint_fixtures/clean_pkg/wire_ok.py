@@ -47,6 +47,29 @@ def on_message(content):
     return None
 
 
+# -- HG1102 twin at two forwarding hops: the decoder two callees deep
+# reads EVERY produced key, so neither the hard-read check nor the
+# dead-field warning may fire --------------------------------------------
+
+
+def pong(link, seq):
+    link.send({"what": "wireok-pong", "seq": seq, "echo": "e"})
+
+
+def on_pong(content):
+    if content.get("what") == "wireok-pong":
+        return _relay_pong(content)
+    return None
+
+
+def _relay_pong(payload):
+    return _decode_pong(payload)
+
+
+def _decode_pong(payload):
+    return payload["seq"], payload.get("echo")
+
+
 # -- HG1103 twin: stamped writer, version-checked reader -----------------
 
 

@@ -226,6 +226,9 @@ def test_committed_perf_baseline_loads():
 
     rec = load_baseline(os.path.join(REPO, "PERF_BASELINE.json"))
     assert rec["lanes"]
-    assert set(rec["lanes"]) <= set(LANE_KINDS)
+    # sentinel lanes = the serve executor lanes + the standing tier's
+    # "sub" notification lane (fed by SubscriptionManager, seeded from
+    # the c10 record)
+    assert set(rec["lanes"]) <= set(LANE_KINDS) | {"sub"}
     for lane in rec["lanes"].values():
         assert lane.get("p50_s") or lane.get("p99_s")

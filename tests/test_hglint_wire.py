@@ -47,16 +47,20 @@ def test_wire_bad_exact_rule_and_line():
     assert _pins(findings) == [
         ("HG1101", 24),   # 3-unpack of a channel packed with 2-tuples
         ("HG1102", 37),   # hard-read of a key no producer writes
-        ("HG1103", 50),   # persisted record with no schema-version stamp
-        ("HG1104", 68),   # WireRefused missing from the status table
-        ("HG1105", 80),   # metric name absent from DOTTED_NAMES
+        ("HG1102", 54),   # the same drift TWO forwarding hops deep
+        ("HG1103", 73),   # persisted record with no schema-version stamp
+        ("HG1104", 91),   # WireRefused missing from the status table
+        ("HG1105", 103),  # metric name absent from DOTTED_NAMES
     ], "\n".join(f.render() for f in findings)
 
 
-def test_each_rule_fires_exactly_once():
+def test_each_rule_fires_exactly_as_seeded():
     findings = run_lint([str(BAD)], only="HG11")
     rules = sorted(f.rule for f in findings)
-    assert rules == ["HG1101", "HG1102", "HG1103", "HG1104", "HG1105"]
+    # HG1102 is seeded twice: the direct consumer and the two-hop
+    # forwarded one — everything else exactly once
+    assert rules == ["HG1101", "HG1102", "HG1102", "HG1103", "HG1104",
+                     "HG1105"]
     assert all(f.severity == "error" for f in findings)
 
 
@@ -84,11 +88,54 @@ def test_arity_drift_names_channel_and_producer_witness():
 
 def test_envelope_drift_names_kind_and_key():
     findings = run_lint([str(BAD)], only="HG1102")
-    (hit,) = findings
+    hit = next(f for f in findings if "wire-ping" in f.message)
     assert "kind 'wire-ping'" in hit.message
     assert "'deadline'" in hit.message
     assert "KeyError in waiting" in hit.message
     assert "`.get()`" in hit.message                     # the tolerant out
+
+
+def test_two_hop_forwarded_consumer_is_charged_the_read():
+    # the handler delegates to a helper that delegates to the decoder;
+    # the decoder's hard-read of an unproduced key anchors at the
+    # CONSUMER's dispatch branch, not at the decoder
+    findings = run_lint([str(BAD)], only="HG1102")
+    hit = next(f for f in findings if "wire-pong" in f.message)
+    assert hit.scope == "on_pong"
+    assert "'ttl'" in hit.message
+    assert "'seq'" not in hit.message        # the produced key is clean
+
+
+def test_forwarded_walk_is_bounded_at_two_hops(tmp_path):
+    # THREE forwarding hops exceed the budget: the decoder's read is
+    # invisible, so neither the hard-read error nor a dead-field
+    # warning may fire — the walk under-approximates, never guesses
+    mod = tmp_path / "three_hops.py"
+    mod.write_text(textwrap.dedent("""\
+        def ping(link):
+            link.send({"what": "hop3-ping", "seq": 1})
+
+
+        def on_message(content):
+            if content.get("what") == "hop3-ping":
+                return hop_a(content)
+            return None
+
+
+        def hop_a(payload):
+            return hop_b(payload)
+
+
+        def hop_b(payload):
+            return hop_c(payload)
+
+
+        def hop_c(payload):
+            return payload["never_produced"]
+    """))
+    findings = run_lint([str(mod)], only="HG1102")
+    errors = [f for f in findings if f.severity == "error"]
+    assert errors == [], "\n".join(f.render() for f in errors)
 
 
 def test_dead_field_is_a_warning_not_an_error(tmp_path):
@@ -125,7 +172,7 @@ def test_table_drift_names_the_uncovered_type_and_root():
     assert hit.scope == "<module>"                       # fires at the table
     assert "`WireRefused`" in hit.message
     assert "WireErr" in hit.message                      # the family root
-    assert "wire_bad.py:64" in hit.message               # class-def witness
+    assert "wire_bad.py:87" in hit.message               # class-def witness
 
 
 def test_metric_drift_names_registry_and_namespace():
@@ -161,7 +208,7 @@ def test_rule_matches_is_family_aware_for_hg11():
 
 def test_single_rule_scoping():
     findings = run_lint([str(BAD)], only="HG1104")
-    assert _pins(findings) == [("HG1104", 68)]
+    assert _pins(findings) == [("HG1104", 91)]
 
 
 # --------------------------------- HG1105 vs the runtime metric-drift gate
@@ -178,8 +225,10 @@ def test_static_registry_agrees_with_runtime_dotted_names(monkeypatch):
     vocab, prefixes = collect_registries(mods)
 
     from hypergraphdb_tpu.serve import stats
+    from hypergraphdb_tpu.sub import stats as sub_stats
 
-    assert set(vocab) == set(stats.DOTTED_NAMES)
+    assert set(vocab) == set(stats.DOTTED_NAMES) | set(
+        sub_stats.DOTTED_NAMES)
     # the one dynamic family (per-endpoint breaker gauges) is governed
     # by a registered prefix rather than enumerated names
     assert "serve.breaker." in prefixes

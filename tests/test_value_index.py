@@ -244,10 +244,12 @@ def test_typed_lane_sees_fresh_memtable_atoms(graph):
         _host_truth(graph, lo=100, hi=800))
 
 
-def test_anchored_lane_under_fresh_ingest_serves_host_exactly(graph):
+def test_anchored_lane_under_fresh_ingest_stays_on_device(graph):
     """A memtable link incident to the anchor is invisible to the BASE
-    incidence rows the device filter probes — anchored lanes under
-    fresh ingest must come back exact via host."""
+    incidence rows the device filter probes — but the probe only masks
+    candidates OUT, so the lane stays on device and the collect's
+    delta-incidence re-offer (the live-graph ``get_targets`` check)
+    merges the fresh link back in exactly."""
     nodes, links, lt = _int_graph(graph, n=20)
     graph.enable_incremental(background=False, compact_ratio=100.0)
     anchor = nodes[3]
@@ -255,12 +257,42 @@ def test_anchored_lane_under_fresh_ingest_serves_host_exactly(graph):
     rt = _runtime(graph, 64)
     fut = rt.submit_range(lo=100, hi=800, anchor=anchor)
     _drain(rt)
-    rt.close()
     res = fut.result(timeout=0)
     truth = _host_truth(graph, lo=100, hi=800, anchor=anchor)
     assert fresh in truth
-    assert res.served_by == "host"
+    assert res.served_by == "device"
+    assert rt.stats.range_dispatches == 1
     assert res.matches.tolist() == truth
+    rt.close()
+
+
+def test_anchored_lane_under_churn_equals_host_oracle(graph):
+    """Anchored lanes ride the device through the full memtable menu —
+    a fresh incident link in-window, a fresh incident link out-of-window,
+    a fresh NON-incident link in-window, a removed incident link, and a
+    revalued one — and still equal the exact host oracle."""
+    nodes, links, lt = _int_graph(graph, n=20)
+    graph.enable_incremental(background=False, compact_ratio=100.0)
+    anchor = nodes[3]
+    inwin = int(graph.add_link([anchor, nodes[9]], value=350))
+    outwin = int(graph.add_link([anchor, nodes[11]], value=9000))
+    other = int(graph.add_link([nodes[5], nodes[6]], value=360))
+    graph.remove(links[2])          # base link incident to anchor dies
+    graph.replace(links[3], 370)    # base link revalued into the window
+    rt = _runtime(graph, 64)
+    fut = rt.submit_range(lo=100, hi=800, anchor=anchor)
+    f_free = rt.submit_range(lo=100, hi=800)  # anchor-free control lane
+    _drain(rt)
+    res = fut.result(timeout=0)
+    truth = _host_truth(graph, lo=100, hi=800, anchor=anchor)
+    assert inwin in truth and outwin not in truth and other not in truth
+    assert links[2] not in truth
+    assert res.served_by == "device"
+    assert res.matches.tolist() == truth
+    assert res.count == len(truth)
+    free = f_free.result(timeout=0)
+    assert free.matches.tolist() == _host_truth(graph, lo=100, hi=800)
+    rt.close()
 
 
 def test_variable_width_kinds_serve_host_exactly(graph):

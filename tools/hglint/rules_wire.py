@@ -901,12 +901,24 @@ class _WireModel:
                         soft |= s2
         return hard, soft
 
+    #: forwarded-callee walk budget: a consumer may route the envelope
+    #: through up to this many resolvable callees (handler → helper →
+    #: decoder) and its reads still count as the consumer's own
+    _HOP_DEPTH = 2
+
     def _hop_reads(
-        self, call: ast.Call, container: str, sc: _FnScan
+        self, call: ast.Call, container: str, sc: _FnScan,
+        depth: Optional[int] = None,
+        seen: Optional[Set[Tuple[str, str]]] = None,
     ) -> Tuple[Set[str], Set[str]]:
-        """One interprocedural hop: the envelope is forwarded to a
+        """Interprocedural hops: the envelope is forwarded to a
         resolvable callee — that callee's reads on the receiving
-        parameter count as this consumer's reads."""
+        parameter count as this consumer's reads, transitively up to
+        ``_HOP_DEPTH`` forwarding hops (a handler that delegates to a
+        helper which itself delegates to the real decoder stays
+        closed-world). ``seen`` breaks (callee, param) cycles."""
+        if depth is None:
+            depth = self._HOP_DEPTH
         passed = [
             i
             for i, a in enumerate(call.args)
@@ -930,14 +942,31 @@ class _WireModel:
             and isinstance(call.func, ast.Attribute)
             else 0
         )
+        if seen is None:
+            seen = set()
         hard: Set[str] = set()
         soft: Set[str] = set()
         for i in passed:
             pi = offset + i
-            if pi < len(params):
-                h, s = csc.key_reads.get(params[pi], (set(), set()))
-                hard |= h
-                soft |= s
+            if pi >= len(params):
+                continue
+            pname = params[pi]
+            if (callee, pname) in seen:
+                continue  # mutual forwarding must terminate
+            seen.add((callee, pname))
+            h, s = csc.key_reads.get(pname, (set(), set()))
+            hard |= h
+            soft |= s
+            if depth > 1:
+                # the callee may forward the SAME envelope onward —
+                # walk its own calls with one hop less of budget
+                for n in csc.nodes:
+                    if isinstance(n, ast.Call):
+                        h2, s2 = self._hop_reads(
+                            n, pname, csc, depth - 1, seen
+                        )
+                        hard |= h2
+                        soft |= s2
         return hard, soft
 
     def envelope_findings(self) -> List[Finding]:

@@ -1,0 +1,250 @@
+#!/usr/bin/env bash
+# hgsub gate: the standing-query tier — subscription manager unit
+# contracts (envelopes, deltas, backpressure, long-poll, wire
+# decoding), the wire-contract analyzer suite (HG11xx covers the new
+# /subscribe + /notifications envelopes), and the chaos acceptance
+# soak (multi-seed differential equality under concurrent ingest,
+# 1k-subscription coalescing, door resume across a replica kill) —
+# followed by a LIVE smoke: a primary + 2 serving replicas + the front
+# door over real HTTP sockets, one subscription placed through the
+# door, its owning replica KILLED between deltas, and the next
+# long-poll must come back with the synthesized chained resume note —
+# no loss, no duplicates, no error.
+#
+# Sits beside replica.sh (deployment tier), perf.sh (kernels + AOT),
+# and lint.sh/verify.sh: this one gates the streaming tier. No
+# hgverify/concord refresh is needed here by design — standing queries
+# re-fire through the EXISTING bucketed serve lanes (no new jitted
+# entries), which is the point.
+#
+# Usage: tools/sub.sh [extra pytest args]
+#   tools/sub.sh -k shed               # one area, fast local run
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
+    tests/test_sub.py \
+    tests/test_sub_soak.py \
+    tests/test_hglint_wire.py \
+    -q -m 'not slow' -p no:cacheprovider "$@"
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "tools/sub.sh: subscription tests failed (exit $rc)" >&2
+    exit "$rc"
+fi
+
+# -- live smoke: a subscription survives its replica over real HTTP ----------
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - <<'PY'
+import json
+import shutil
+import subprocess
+import time
+import urllib.parse
+import urllib.request
+
+import hypergraphdb_tpu as hg
+from hypergraphdb_tpu.obs.http import runtime_health
+from hypergraphdb_tpu.peer import transfer
+from hypergraphdb_tpu.peer.peer import HyperGraphPeer
+from hypergraphdb_tpu.peer.transport import LoopbackNetwork
+from hypergraphdb_tpu.query import conditions as c
+from hypergraphdb_tpu.replica import (
+    FrontDoor,
+    HTTPBackend,
+    ReplicaConfig,
+    ReplicaNode,
+    RouterConfig,
+    SubmitServer,
+    frontdoor_server,
+    node_server,
+    submit_payload,
+)
+from hypergraphdb_tpu.serve import ServeConfig, ServeRuntime
+from hypergraphdb_tpu.sub.registry import match_digest
+
+
+def serve_cfg():
+    return ServeConfig(max_linger_s=0.001, prewarm_aot=False)
+
+
+net = LoopbackNetwork()
+gp = hg.HyperGraph()
+pp = HyperGraphPeer.loopback(gp, net, identity="primary")
+pp.replication.debounce_s = 0.005
+pp.start()
+hub = int(gp.add("hub"))
+spokes = [int(gp.add(f"s{i}")) for i in range(8)]
+for i in range(4):
+    gp.add_link((hub, spokes[i]), value=f"e{i}")
+
+
+def replica(ident):
+    gr = hg.HyperGraph()
+    node = ReplicaNode(
+        gr, HyperGraphPeer.loopback(gr, net, identity=ident),
+        ReplicaConfig(primary="primary", anti_entropy_interval_s=0.1,
+                      serve=serve_cfg()),
+    )
+    node.start()
+    assert node.wait_converged(timeout=60), f"{ident} never converged"
+    return node
+
+
+n1, n2 = replica("r1"), replica("r2")
+nodes = {"r1": n1, "r2": n2}
+assert pp.replication.flush()
+for n in (n1, n2):
+    deadline = time.monotonic() + 30
+    while transfer.content_digest(gp) != transfer.content_digest(n.graph):
+        assert time.monotonic() < deadline, "replica never caught up"
+        time.sleep(0.02)
+
+
+def resolve(graph, value):
+    hs = [int(h) for h in graph.find_all(c.AtomValue(value))]
+    assert len(hs) == 1
+    return hs[0]
+
+
+# identical replica builds from the same stream => identical handles;
+# the wire payload carries raw replica-local handles
+anchor = resolve(n1.graph, "hub")
+assert anchor == resolve(n2.graph, "hub")
+
+
+def truth(graph):
+    return {int(h) for h in
+            graph.find_all(c.Incident(resolve(graph, "hub")))}
+
+
+# primary serves submits but has NO subscription tier: the failover
+# below must adopt on the surviving replica
+prt = ServeRuntime(gp, serve_cfg())
+s1, s2 = node_server(n1).start(), node_server(n2).start()
+servers = {"r1": s1, "r2": s2}
+sp = SubmitServer(lambda p: submit_payload(prt, p, 30.0),
+                  health=runtime_health(prt)).start()
+fd = FrontDoor(
+    HTTPBackend("primary", sp.url, role="primary"),
+    [HTTPBackend("r1", s1.url), HTTPBackend("r2", s2.url)],
+    RouterConfig(breaker_threshold=2, breaker_cooldown_s=3600.0,
+                 poll_interval_s=0, health_refresh_s=3600.0),
+).start()
+fd.refresh_health()
+fsrv = frontdoor_server(fd).start()
+curl = shutil.which("curl")
+
+
+def http_json(url, body=None):
+    if curl:
+        cmd = [curl, "-fsS", "--max-time", "20"]
+        if body is not None:
+            cmd += ["-H", "Content-Type: application/json", "-d", body]
+        out = subprocess.run(cmd + [url], check=True,
+                             capture_output=True, text=True)
+        return json.loads(out.stdout)
+    req = urllib.request.Request(
+        url, data=None if body is None else body.encode("utf-8"),
+        headers={} if body is None
+        else {"Content-Type": "application/json"},
+        method="GET" if body is None else "POST",
+    )
+    with urllib.request.urlopen(req, timeout=20) as r:
+        assert r.status == 200
+        return json.loads(r.read().decode("utf-8"))
+
+
+def poll(dsid, timeout_s=2):
+    qs = urllib.parse.urlencode(
+        {"id": dsid, "timeout_s": timeout_s, "max": 32})
+    return http_json(fsrv.url + "/notifications?" + qs)
+
+
+try:
+    # place one standing pattern THROUGH the door
+    resp = http_json(fsrv.url + "/subscribe", json.dumps(
+        {"what": "subscribe", "kind": "pattern", "anchors": [anchor],
+         "window": 64}))
+    assert resp["what"] == "subscribed", resp
+    dsid, owner = resp["id"], resp["routed_to"]
+    assert dsid.startswith("dsub-") and owner in ("r1", "r2"), resp
+    matches, seq = set(resp["matches"]), resp["seq"]
+    assert matches == truth(n1.graph)
+
+    def fold_until(want, deadline_s=30):
+        """Long-poll + fold deltas until the set equals ``want``,
+        enforcing chain/no-dup/no-loss/digest on every note."""
+        global seq
+        deadline = time.monotonic() + deadline_s
+        while matches != want:
+            assert time.monotonic() < deadline, \
+                f"fold never reached truth: {sorted(matches)}"
+            env = poll(dsid)
+            assert env["what"] == "notifications", env
+            for n in env["notes"]:
+                assert seq <= n["seq_from"] <= n["seq_to"], n
+                added, removed = set(n["added"]), set(n["removed"])
+                assert added.isdisjoint(matches), "duplicate delivery"
+                assert removed <= matches, "phantom removal"
+                matches.difference_update(removed)
+                matches.update(added)
+                seq = n["seq_to"]
+                assert n["digest"] == match_digest(matches), n
+
+    # delta 1 flows through the owner
+    gp.add_link((hub, spokes[5]), value="live-1")
+    assert pp.replication.flush()
+    fold_until(truth(nodes[owner].graph))
+
+    # KILL the owning replica (server and node, no drain — a death),
+    # then land ingest it will never see
+    survivor = "r2" if owner == "r1" else "r1"
+    servers[owner].stop()
+    nodes[owner].stop(drain=False)
+    gp.add_link((hub, spokes[6]), value="live-2")
+    surv = nodes[survivor]
+    deadline = time.monotonic() + 30
+    while transfer.content_digest(gp) != transfer.content_digest(surv.graph):
+        assert time.monotonic() < deadline, "survivor never caught up"
+        time.sleep(0.02)
+
+    # the poll crosses the kill: the door re-places the subscription on
+    # the survivor and answers with ONE synthesized chained note
+    fold_until(truth(surv.graph))
+    failovers = fd.metrics.counters.get("router.sub_failovers", 0)
+    assert failovers == 1, f"expected 1 failover, saw {failovers}"
+
+    # still live on the survivor after the resume
+    gp.add_link((hub, spokes[7]), value="live-3")
+    assert pp.replication.flush()
+    fold_until(truth(surv.graph))
+
+    print(f"tools/sub.sh smoke: subscription {dsid} through {fsrv.url} "
+          f"survived killing {owner}; resumed on {survivor} with the "
+          f"synthesized chained note ({'curl' if curl else 'urllib'}), "
+          f"{len(matches)} matches, seq {seq}, 0 lost, 0 duplicated")
+finally:
+    fsrv.stop()
+    fd.stop()
+    sp.stop()
+    for srv in servers.values():
+        try:
+            srv.stop()       # idempotent for the already-killed owner
+        except Exception:
+            pass
+    prt.close()
+    for node in nodes.values():
+        try:
+            node.stop(drain=False)
+        except Exception:
+            pass
+    pp.stop()
+    gp.close()
+PY
+smoke_rc=$?
+if [ "$smoke_rc" -ne 0 ]; then
+    echo "tools/sub.sh: live subscription smoke failed (exit $smoke_rc)" >&2
+    exit "$smoke_rc"
+fi
+echo "tools/sub.sh: subscription gate green"
+exit 0
