@@ -2042,6 +2042,250 @@ def bench_c10():
     return out
 
 
+def bench_c11():
+    """c11_join: OPEN-LOOP join serving — Poisson arrivals of anchored
+    triangle ``submit_join`` requests against ``ServeRuntime`` while
+    ingest streams concurrently. Where c7 measures the join EXECUTOR's
+    closed-loop throughput (dispatch as fast as the last batch
+    finishes), c11 measures the join LANE as a service: arrival times
+    come from the offered rate, so the recorded latency percentiles
+    include queueing delay under concurrent write load — the numbers a
+    latency contract (and a cost model) can actually be built on.
+    ``--seed-baseline`` turns this record into the sentinel's and the
+    hgplan planner's ``join`` lane entry, replacing the c7 proxy
+    (per-anchor mean with a 4× p99 heuristic).
+
+    The graph is locality-clustered — every link lands within a small
+    id window of its subject — so anchored triangles genuinely close;
+    anchors are sampled from a bounded co-degree band (c7's honesty
+    rule: the device-servable population, hub monsters route to host in
+    production). A ``base_n`` subset is differentially verified against
+    the exact host join engine (``join/host.host_join``).
+
+    The write side is COMPACTION-PACED: the join lane's exact-at-collect
+    discipline host-routes every batch while a non-trivial dirty
+    memtable is outstanding (a memtable link can mint bindings anywhere
+    in the tuple space — only a compaction swap makes the device base
+    whole again), so the writer requests a compaction after each ingest
+    batch and waits for the swap, the deployment posture a join-heavy
+    service actually runs. The dirty windows still land inside the
+    measured distribution — ``host_fallbacks`` in the record says how
+    much of the load they carried.
+
+    Env knobs: BENCH_C11_ENTITIES / _LINKS (graph scale), _REQUESTS,
+    _OFFERED_QPS, _DEADLINE_S, _WINDOW (link locality), _MAX_DEG
+    (anchor co-degree band), _INGEST_BATCHES / _BATCH_LINKS /
+    _INGEST_GAP_S, _BASELINE_N, _QUEUE, _LINGER_S, _PAD, _TAG."""
+    _bench_entry_env()
+    import threading
+
+    from hypergraphdb_tpu import HyperGraph, join
+    from hypergraphdb_tpu.query import conditions as qc
+    from hypergraphdb_tpu.query.variables import var
+    from hypergraphdb_tpu.serve import DeadlineExceeded, ServeConfig, \
+        ServeRuntime
+
+    _telemetry_begin()
+    n_entities = int(os.environ.get("BENCH_C11_ENTITIES", 100_000))
+    n_links = int(os.environ.get("BENCH_C11_LINKS", 300_000))
+    n_requests = int(os.environ.get("BENCH_C11_REQUESTS", 2048))
+    offered_qps = float(os.environ.get("BENCH_C11_OFFERED_QPS", 200.0))
+    deadline_s = float(os.environ.get("BENCH_C11_DEADLINE_S", 5.0))
+    window = int(os.environ.get("BENCH_C11_WINDOW", 16))
+    max_deg = int(os.environ.get("BENCH_C11_MAX_DEG", 64))
+    stream_batches = int(os.environ.get("BENCH_C11_INGEST_BATCHES", 8))
+    batch_links = int(os.environ.get("BENCH_C11_BATCH_LINKS", 2_000))
+    ingest_gap_s = float(os.environ.get("BENCH_C11_INGEST_GAP_S", 0.2))
+    base_n = min(int(os.environ.get("BENCH_C11_BASELINE_N", 64)),
+                 n_requests)
+
+    g = HyperGraph()
+    r = np.random.default_rng(37)
+    entities = g.bulk_import(values=np.arange(n_entities).tolist())
+    e0 = int(entities[0])
+    # locality-clustered links: objects within `window` ids of their
+    # subject, so two co-neighbours of an anchor are themselves likely
+    # linked — the triangle-closing structure a pure-uniform graph
+    # (expected triangle count ~0 at this density) cannot provide
+    deg = np.zeros(n_entities, dtype=np.int64)
+    for s in range(0, n_links, 100_000):
+        m = min(100_000, n_links - s)
+        subj = r.integers(0, n_entities, size=m)
+        obj = (subj + r.integers(1, window + 1, size=m)) % n_entities
+        g.bulk_import(
+            values=[int(1_000_000 + s + x) for x in range(m)],
+            target_lists=[[e0 + int(a), e0 + int(b)]
+                          for a, b in zip(subj, obj)],
+        )
+        np.add.at(deg, subj, 1)
+        np.add.at(deg, obj, 1)
+    mgr = g.enable_incremental(
+        headroom=1.8, background=True, delta_bucket_min=1 << 14,
+        pack_pad_multiple=int(os.environ.get("BENCH_C11_PAD", 1 << 16)),
+    )
+
+    # anchors: the bounded co-degree band (c7's device-servable rule) —
+    # enough incidence that the triangle does real intersection work,
+    # not so much that one hub row floods every dispatch
+    cand = np.flatnonzero((deg >= 2) & (deg <= max_deg))
+    if not len(cand):
+        raise RuntimeError("c11: no anchor in the co-degree band; "
+                           "raise BENCH_C11_MAX_DEG")
+    anchors = [e0 + int(a)
+               for a in cand[r.integers(0, len(cand), size=n_requests)]]
+
+    def spec(a: int) -> dict:
+        # anchored triangle, the SHAPES["triangle"] idiom: a–y, y–z, z–a
+        return {"y": qc.And(qc.CoIncident(a), qc.CoIncident(var("z"))),
+                "z": qc.CoIncident(a)}
+
+    cfg = ServeConfig(
+        buckets=(16, 64, 256),
+        max_queue=int(os.environ.get("BENCH_C11_QUEUE", 8192)),
+        max_linger_s=float(os.environ.get("BENCH_C11_LINGER_S", 0.002)),
+        top_r=16, prewarm_aot=False,
+    )
+    rt = ServeRuntime(g, cfg)
+
+    # warm every bucket shape off the clock (compile at deploy time)
+    for b in cfg.buckets:
+        warm = [rt.submit_join(spec(anchors[j % n_requests]))
+                for j in range(b)]
+        for f in warm:
+            f.result(timeout=600)
+    rt.stats.reset()
+    ingested = {"done": False, "atoms": 0, "s": 0.0}
+
+    def writer():
+        t0 = time.perf_counter()
+        v = 10_000_000
+        for _ in range(stream_batches):
+            subj = r.integers(0, n_entities, size=batch_links)
+            obj = (subj + r.integers(1, window + 1, size=batch_links)) \
+                % n_entities
+            g.bulk_import(
+                values=[int(v + x) for x in range(batch_links)],
+                target_lists=[[e0 + int(a), e0 + int(b)]
+                              for a, b in zip(subj, obj)],
+            )
+            v += batch_links
+            ingested["atoms"] += batch_links
+            # compaction-paced: swap the device base after every batch
+            # so the join lane's dirty-memtable host window stays
+            # bounded — the ratio-triggered path would leave the whole
+            # run host-served at smoke scale (the +4096-edge floor)
+            mgr._request_compact()
+            mgr.wait_compacted(timeout=120)
+            if ingest_gap_s > 0:
+                time.sleep(ingest_gap_s)
+        ingested["s"] = time.perf_counter() - t0
+        ingested["done"] = True
+
+    wt = threading.Thread(target=writer)
+    wt.start()
+    gaps = r.exponential(1.0 / offered_qps, size=n_requests)
+    futs = []
+    t0 = time.perf_counter()
+    next_t = t0
+    for i in range(n_requests):
+        next_t += gaps[i]
+        pause = next_t - time.perf_counter()
+        if pause > 0:
+            time.sleep(pause)
+        futs.append(rt.submit_join(spec(anchors[i]),
+                                   deadline_s=deadline_s))
+    served = shed = 0
+    counts = []
+    for f in futs:
+        try:
+            res = f.result(timeout=300)
+            counts.append(int(res.count))
+            served += 1
+        except DeadlineExceeded:
+            counts.append(-1)
+            shed += 1
+    wall = time.perf_counter() - t0
+    wt.join()
+    s = rt.stats_snapshot()
+
+    # -- differential verdict: a FRESH post-settle probe batch (the
+    # open-loop counts were recorded mid-ingest; their truth moved under
+    # them, so equality there would be luck, not a check). The lane's
+    # exact binding COUNT (pre-truncation, so this holds whatever top_r
+    # sliced) vs the host join engine, both on the settled graph.
+    probe_futs = [(a, rt.submit_join(spec(a))) for a in anchors[:base_n]]
+    diff_equal = True
+    diffs = []
+    checked = 0
+    for a, f in probe_futs:
+        res = f.result(timeout=300)
+        truth = join.host_join(g, join.extract_pattern(g, spec(a)))
+        if res.count != len(truth):
+            diff_equal = False
+            if len(diffs) < 5:
+                diffs.append([int(a), int(res.count), len(truth)])
+        checked += 1
+
+    # -- host baseline: the same anchored triangle answered by the exact
+    # host join engine (what a caller paid without the serving tier)
+    def host_window():
+        t0 = time.perf_counter()
+        for i in range(base_n):
+            join.host_join(g, join.extract_pattern(g, spec(anchors[i])))
+        return base_n / (time.perf_counter() - t0)
+
+    host_qps = best_of(host_window, n=2)
+    rt.close(drain=True, timeout=120)
+    telemetry = _telemetry_dump(
+        "c11", registries=[rt.stats.registry, g.metrics.registry]
+    )
+    g.close()
+    served_qps = served / wall if wall else 0.0
+    out = {
+        "entities": n_entities,
+        "links": n_links,
+        "requests": n_requests,
+        "offered_qps": round(offered_qps, 1),
+        "served_qps": round(served_qps, 1),
+        "served": served,
+        "shed_deadline": shed,
+        "deadline_s": deadline_s,
+        "host_join_qps": round(host_qps, 1),
+        "device_vs_host": (
+            round(served_qps / host_qps, 2) if host_qps else None
+        ),
+        "batches": s["batches"],
+        "device_dispatches": s["device_dispatches"],
+        "batch_occupancy": (
+            round(s["batch_occupancy"], 3)
+            if s["batch_occupancy"] is not None else None
+        ),
+        "latency_ms_p50": (
+            round(s["latency_ms"]["p50"], 2)
+            if s["latency_ms"]["p50"] is not None else None
+        ),
+        "latency_ms_p99": (
+            round(s["latency_ms"]["p99"], 2)
+            if s["latency_ms"]["p99"] is not None else None
+        ),
+        "host_fallbacks": s["host_fallbacks"],
+        "concurrent_ingest_atoms_per_sec": round(
+            ingested["atoms"] / ingested["s"], 1
+        ) if ingested["s"] else None,
+        "bindings_total": int(sum(x for x in counts if x > 0)),
+        "differential_probes": checked,
+        "differential_equal": diff_equal,
+        "backend": _backend_name(),
+    }
+    if diffs:
+        out["differential_diff"] = diffs
+    if telemetry:
+        out["tracing"] = telemetry["sampling"]
+        out["telemetry"] = telemetry
+    out["recorded_to"] = _record_bench("c11_join", out)
+    return out
+
+
 # ------------------------------------------------------------- bench records
 
 #: committed envelope schema for every ``BENCH_C*_<tag>.json`` record.
@@ -2061,6 +2305,7 @@ BENCH_RECORDED = {
     "c8_sharded": ("BENCH_C8_TAG", "BENCH_C8"),
     "c9_value_index": ("BENCH_C9_TAG", "BENCH_C9"),
     "c10_pattern": ("BENCH_C10_TAG", "BENCH_C10"),
+    "c11_join": ("BENCH_C11_TAG", "BENCH_C11"),
 }
 
 
@@ -2432,6 +2677,11 @@ def _config_c10() -> dict:
     return _with_telemetry("c10", bench_c10)
 
 
+def _config_c11() -> dict:
+    _bench_entry_env()
+    return _with_telemetry("c11", bench_c11)
+
+
 def _run_isolated(name: str) -> dict:
     """Run one config in a FRESH python subprocess.
 
@@ -2496,6 +2746,7 @@ def main() -> None:
         c8 = _run_isolated("c8")
         c9 = _run_isolated("c9")
         c10 = _run_isolated("c10")
+        c11 = _run_isolated("c11")
         graph = c4.pop("_graph")
     else:  # legacy in-process path (BENCH_ISOLATE=0): order still matters
         # c6's cold-start probe BEFORE any config initializes the device
@@ -2517,6 +2768,7 @@ def main() -> None:
         c8 = _with_telemetry("c8", bench_c8)
         c9 = _with_telemetry("c9", bench_c9)
         c10 = _with_telemetry("c10", bench_c10)
+        c11 = _with_telemetry("c11", bench_c11)
         graph = {
             "n_atoms": info["n_atoms"],
             "total_arity": info["total_arity"],
@@ -2537,6 +2789,7 @@ def main() -> None:
             "c8_sharded": c8,
             "c9_value_index": c9,
             "c10_pattern": c10,
+            "c11_join": c11,
         },
         "graph": graph,
     }))

@@ -481,6 +481,31 @@ class FleetCollector:
             "nodes_reporting": len(nodes),
         }
 
+    def fleet_plan(self) -> dict:
+        """The door's ``/fleet/plan`` body: every node's hgplan
+        correction state (the ``plan`` section ``obs.http.runtime_health``
+        embeds in ``/healthz``) merged into one view — per-node
+        summaries, fleet totals of active corrections and sentinel-guard
+        vetoes, and how many nodes report a planner at all (same
+        absent-not-healthy discipline as ``fleet_perf``)."""
+        nodes: dict = {}
+        corrections = 0
+        vetoes = 0
+        for node_id, scrape in sorted(self.node_scrapes().items()):
+            p = (scrape.health or {}).get("plan")
+            if not isinstance(p, dict):
+                continue
+            nodes[node_id] = p
+            corrections += int(p.get("corrections_active") or 0)
+            vetoes += int(p.get("guard_vetoes") or 0)
+        return {
+            "role": "fleet",
+            "nodes": nodes,
+            "corrections_active": corrections,
+            "guard_vetoes": vetoes,
+            "nodes_reporting": len(nodes),
+        }
+
     # -- reading: assembled traces -------------------------------------------
     def fleet_traces(self) -> list:
         """Summaries of every assembled trace id, most recent last:

@@ -85,6 +85,16 @@ def runtime_health(rt) -> HealthProbe:
                 payload["perf"] = perf.health_summary()
             except Exception:  # noqa: BLE001 - health must not 500 on it
                 payload.setdefault("degraded", []).append("perf")
+        planner = getattr(rt, "planner", None)
+        if planner is not None:
+            # the hgplan planner's correction state (active per-shape
+            # corrections, guard vetoes) — what FleetCollector.fleet_plan
+            # merges. Same discipline as perf: pure read, degraded-not-
+            # down, never flips the verdict.
+            try:
+                payload["plan"] = planner.health_summary()
+            except Exception:  # noqa: BLE001 - health must not 500 on it
+                payload.setdefault("degraded", []).append("plan")
         healthy = (payload["accepting"]
                    and all(v != "open" for v in states.values()))
         return healthy, payload

@@ -42,7 +42,7 @@ from typing import Optional
 
 import numpy as np
 
-from hypergraphdb_tpu.utils.ordered_bytes import rank64
+from hypergraphdb_tpu.utils.ordered_bytes import rank64, rank_ambiguous
 
 #: sentinel for padded entries in id arrays
 PAD = np.int32(-1)
@@ -130,6 +130,21 @@ class CSRSnapshot:
     arity: np.ndarray
     value_rank: np.ndarray
     value_kind: np.ndarray = field(default_factory=lambda: np.empty(0, np.uint8))
+    #: (N+1,) uint64 — SECOND rank word (key payload bytes 8..16), the
+    #: hgindex tie-break for variable-width kinds; empty on snapshots
+    #: packed before the column existed (consumers treat empty as
+    #: "no tie-break: var-width columns stay host-served"). HOST-side
+    #: only — DeviceSnapshot's pytree is unchanged; the device twin
+    #: rides each ValueIndexColumn's rank2 words instead.
+    value_rank2: np.ndarray = field(
+        default_factory=lambda: np.empty(0, np.uint64))
+    #: (N+1,) bool — True where the atom's 128-bit rank pair is NOT a
+    #: faithful stand-in for its full key (payload >16 bytes, or NUL in
+    #: the first 16 — ``utils/ordered_bytes.rank_ambiguous``). Only
+    #: consulted for variable-width kinds; fixed-width exactness is a
+    #: property of the KIND, not the atom.
+    value_ambig: np.ndarray = field(
+        default_factory=lambda: np.empty(0, bool))
     by_type: dict[int, np.ndarray] = field(default_factory=dict)
     n_edges_inc: int = 0    # real (unpadded) incidence entries
     n_edges_tgt: int = 0    # real (unpadded) target entries
@@ -142,6 +157,8 @@ class CSRSnapshot:
         tgt_flat: np.ndarray,     # (E,) int — ordered targets per link
         value_rank: Optional[np.ndarray] = None,  # (N,) uint64 payload ranks
         value_kind: Optional[np.ndarray] = None,  # (N,) uint8 kind bytes
+        value_rank2: Optional[np.ndarray] = None,  # (N,) uint64 tie-break word
+        value_ambig: Optional[np.ndarray] = None,  # (N,) bool rank ambiguity
         version: int = 0,
         pad_multiple: int = 128,
     ) -> "CSRSnapshot":
@@ -165,6 +182,23 @@ class CSRSnapshot:
         kind_col = np.zeros(N + 1, dtype=np.uint8)
         if value_kind is not None:
             kind_col[:N] = value_kind
+        rank2_col = np.zeros(N + 1, dtype=np.uint64)
+        if value_rank2 is not None:
+            rank2_col[:N] = value_rank2
+        ambig_col = np.zeros(N + 1, dtype=bool)
+        if value_ambig is not None:
+            ambig_col[:N] = value_ambig
+        elif value_kind is not None and value_rank2 is None:
+            # rank-only callers (the bulk bench path) carry no keys to
+            # derive the tie-break from: variable-width atoms must stay
+            # rank-ambiguous (→ host-served windows), preserving the
+            # pre-tie-break behavior instead of guessing exactness
+            from hypergraphdb_tpu.storage.value_index import FIXED_WIDTH_KINDS
+
+            fixed = np.isin(
+                kind_col[:N],
+                np.frombuffer(bytes(FIXED_WIDTH_KINDS), dtype=np.uint8))
+            ambig_col[:N] = (kind_col[:N] != 0) & ~fixed
         off = np.zeros(N + 2, dtype=np.int32)
         off[1 : N + 1] = np.asarray(tgt_offsets[1:], dtype=np.int32)
         off[N + 1] = off[N]
@@ -190,6 +224,8 @@ class CSRSnapshot:
             arity=arity,
             value_rank=rank_col,
             value_kind=kind_col,
+            value_rank2=rank2_col,
+            value_ambig=ambig_col,
             by_type=_group_by_type(type_col[:N]),
             n_edges_inc=e_inc,
             n_edges_tgt=e_tgt,
@@ -253,6 +289,8 @@ class CSRSnapshot:
         arity = np.zeros(N + 1, dtype=np.int32)
         value_rank = np.zeros(N + 1, dtype=np.uint64)
         value_kind = np.zeros(N + 1, dtype=np.uint8)
+        value_rank2 = np.zeros(N + 1, dtype=np.uint64)
+        value_ambig = np.zeros(N + 1, dtype=bool)
 
         # fully vectorized record decode (the 10M-atom scale path — no
         # per-atom Python): record layout is (type, value, flags, *targets),
@@ -301,10 +339,23 @@ class CSRSnapshot:
         # The kind byte is stripped into its own column so the 8 rank bytes
         # all carry payload — exact (tie-free) for fixed-width kinds.
         if tables["value_items"] is not None:
+            # lazy import keeps ops/ free of module-level storage deps
+            from hypergraphdb_tpu.storage.value_index import FIXED_WIDTH_KINDS
+
             for key, hs in tables["value_items"]:
                 sel = hs[hs <= N]
-                value_rank[sel] = rank64(key[1:])
+                payload = key[1:]
+                value_rank[sel] = rank64(payload)
                 value_kind[sel] = key[0] if key else 0
+                # the hgindex tie-break pair: second word + ambiguity bit
+                # (payload beyond 16 bytes, or NUL among the first 16 —
+                # there zero-padding stops being a faithful order/identity
+                # map and the window must host-serve). Fixed-width kinds
+                # are NEVER ambiguous: their 8-byte payload fits the first
+                # rank word entirely, NUL bytes and all.
+                value_rank2[sel] = rank64(payload[8:16])
+                if key and key[0] not in FIXED_WIDTH_KINDS:
+                    value_ambig[sel] = rank_ambiguous(payload)
 
         # pad edge arrays to lane multiples; padded entries point at the
         # dummy row N (whose frontier/visited value is always False)
@@ -332,6 +383,8 @@ class CSRSnapshot:
             arity=arity,
             value_rank=value_rank,
             value_kind=value_kind,
+            value_rank2=value_rank2,
+            value_ambig=value_ambig,
             by_type=by_type,
             n_edges_inc=e_inc,
             n_edges_tgt=e_tgt,

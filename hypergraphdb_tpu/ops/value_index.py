@@ -27,8 +27,14 @@ by lane index):
   lanes whose whole window fit the gather pad (counts exact under
   filters); an uncovered+filtered lane is re-served exactly on host.
 
-Rank-word convention throughout: 64-bit ranks ride as two uint32 words
-compared lexicographically hi-then-lo (``ops/snapshot.DeviceSnapshot``).
+Rank-word convention throughout: the 128-bit rank pair (payload bytes
+0..8 and 8..16 — ``utils/ordered_bytes.rank128``) rides as FOUR uint32
+words compared lexicographically hi→lo→hi2→lo2 (the two-word
+``ops/snapshot.DeviceSnapshot`` convention, extended for the hgindex
+tie-break: rank-tied variable-width windows stay exact on device while
+every consulted column is ``device_exact``). Fixed-width kinds carry
+zero second words — the 4-word compare degenerates to the old 2-word
+one bit-for-bit.
 """
 
 from __future__ import annotations
@@ -45,17 +51,20 @@ from hypergraphdb_tpu.ops.setops import SENTINEL, segment_member_mask
 _U32_MAX = jnp.uint32(0xFFFFFFFF)
 
 
-def _searchsorted2(col_hi: jax.Array, col_lo: jax.Array, n_real: jax.Array,
+def _searchsorted4(col_hi: jax.Array, col_lo: jax.Array,
+                   col_hi2: jax.Array, col_lo2: jax.Array,
+                   n_real: jax.Array,
                    q_hi: jax.Array, q_lo: jax.Array,
+                   q_hi2: jax.Array, q_lo2: jax.Array,
                    right: jax.Array) -> jax.Array:
-    """Branchless per-lane binary search of (hi, lo) rank-word queries
-    over one sorted 2-word column, bounded by the column's REAL length
-    (pad entries are never probed). ``right`` selects the insertion side
-    per lane: False = leftmost position (ties insert before), True =
-    rightmost (ties insert after) — how inclusive/exclusive bounds become
-    pure data instead of program variants. 32 rounds bound any
-    int32-indexed column (the ``setops.segment_member_mask``
-    discipline)."""
+    """Branchless per-lane binary search of 4-rank-word queries (the
+    128-bit pair, hi→lo→hi2→lo2 lexicographic) over one sorted 4-word
+    column, bounded by the column's REAL length (pad entries are never
+    probed). ``right`` selects the insertion side per lane: False =
+    leftmost position (ties insert before), True = rightmost (ties
+    insert after) — how inclusive/exclusive bounds become pure data
+    instead of program variants. 32 rounds bound any int32-indexed
+    column (the ``setops.segment_member_mask`` discipline)."""
     m_max = col_hi.shape[0] - 1
     lo = jnp.zeros(q_hi.shape, dtype=jnp.int32)
     hi = jnp.broadcast_to(n_real.astype(jnp.int32), q_hi.shape)
@@ -67,8 +76,13 @@ def _searchsorted2(col_hi: jax.Array, col_lo: jax.Array, n_real: jax.Array,
         m = jnp.minimum(mid, m_max)
         vh = col_hi[m]
         vl = col_lo[m]
-        less = (vh < q_hi) | ((vh == q_hi) & (vl < q_lo))
-        eq = (vh == q_hi) & (vl == q_lo)
+        vh2 = col_hi2[m]
+        vl2 = col_lo2[m]
+        eq1 = (vh == q_hi) & (vl == q_lo)
+        less = ((vh < q_hi) | ((vh == q_hi) & (vl < q_lo))
+                | (eq1 & ((vh2 < q_hi2)
+                          | ((vh2 == q_hi2) & (vl2 < q_lo2)))))
+        eq = eq1 & (vh2 == q_hi2) & (vl2 == q_lo2)
         go_right = less | (right & eq)
         lo = jnp.where(active & go_right, mid + 1, lo)
         hi = jnp.where(active & ~go_right, mid, hi)
@@ -81,9 +95,13 @@ def _searchsorted2(col_hi: jax.Array, col_lo: jax.Array, n_real: jax.Array,
 @hgverify.entry(
     shapes=lambda: (hgverify.sds((64,), "uint32"),
                     hgverify.sds((64,), "uint32"),
+                    hgverify.sds((64,), "uint32"),
+                    hgverify.sds((64,), "uint32"),
                     hgverify.sds((), "int32"),
                     hgverify.sds((8,), "uint32"), hgverify.sds((8,), "uint32"),
+                    hgverify.sds((8,), "uint32"), hgverify.sds((8,), "uint32"),
                     hgverify.sds((8,), "bool"),
+                    hgverify.sds((8,), "uint32"), hgverify.sds((8,), "uint32"),
                     hgverify.sds((8,), "uint32"), hgverify.sds((8,), "uint32"),
                     hgverify.sds((8,), "bool")),
 )
@@ -91,12 +109,18 @@ def _searchsorted2(col_hi: jax.Array, col_lo: jax.Array, n_real: jax.Array,
 def range_probe_batch(
     col_hi: jax.Array,    # (M,) uint32 — sorted column, rank high words
     col_lo: jax.Array,    # (M,) uint32 — rank low words
+    col_hi2: jax.Array,   # (M,) uint32 — SECOND rank word, high half
+    col_lo2: jax.Array,   # (M,) uint32 — second rank word, low half
     n_real: jax.Array,    # scalar int32 — real (unpadded) entries
     lo_hi: jax.Array,     # (K,) uint32 — per-lane lower-bound rank words
     lo_lo: jax.Array,
+    lo_hi2: jax.Array,    # (K,) uint32 — lower-bound second rank words
+    lo_lo2: jax.Array,
     lo_right: jax.Array,  # (K,) bool — True = exclusive lower (gt)
     hi_hi: jax.Array,     # (K,) uint32 — per-lane upper-bound rank words
     hi_lo: jax.Array,
+    hi_hi2: jax.Array,    # (K,) uint32 — upper-bound second rank words
+    hi_lo2: jax.Array,
     hi_right: jax.Array,  # (K,) bool — True = inclusive upper (lte)
 ) -> tuple[jax.Array, jax.Array]:
     """K range windows over ONE sorted column in a single launch:
@@ -104,17 +128,22 @@ def range_probe_batch(
     ``hi_idx >= lo_idx`` — the exact unfiltered per-lane count is their
     difference, and the pair addresses the gather the ordered kernel (or
     a counting caller, which downloads 2·K int32 and nothing else)
-    performs. Pad lanes: pass equal bounds (empty window)."""
-    lo_idx = _searchsorted2(col_hi, col_lo, n_real, lo_hi, lo_lo, lo_right)
-    hi_idx = _searchsorted2(col_hi, col_lo, n_real, hi_hi, hi_lo, hi_right)
+    performs. Fixed-width kinds pass all-zero second words on bounds and
+    column — the 4-word search then reproduces the old 2-word one
+    exactly. Pad lanes: pass equal bounds (empty window)."""
+    lo_idx = _searchsorted4(col_hi, col_lo, col_hi2, col_lo2, n_real,
+                            lo_hi, lo_lo, lo_hi2, lo_lo2, lo_right)
+    hi_idx = _searchsorted4(col_hi, col_lo, col_hi2, col_lo2, n_real,
+                            hi_hi, hi_lo, hi_hi2, hi_lo2, hi_right)
     return lo_idx, jnp.maximum(hi_idx, lo_idx)
 
 
-def _window_gather(col_hi, col_lo, col_gid, lo_idx, hi_idx, desc, win_pad):
+def _window_gather(col_hi, col_lo, col_hi2, col_lo2, col_gid,
+                   lo_idx, hi_idx, desc, win_pad):
     """Gather up to ``win_pad`` entries per lane off each window's
     RELEVANT end (start for ascending lanes, end for descending) —
-    whichever end the top-k lives at. Returns (kh, kl, gid, valid)
-    of shape (K, win_pad)."""
+    whichever end the top-k lives at. Returns (kh, kl, kh2, kl2, gid,
+    valid) of shape (K, win_pad)."""
     m_max = col_hi.shape[0] - 1
     width = hi_idx - lo_idx
     take = jnp.minimum(width, win_pad)
@@ -123,19 +152,24 @@ def _window_gather(col_hi, col_lo, col_gid, lo_idx, hi_idx, desc, win_pad):
     idx = start[:, None] + lane_ix[None, :]
     valid = lane_ix[None, :] < take[:, None]
     idx = jnp.minimum(jnp.where(valid, idx, 0), m_max)
-    return col_hi[idx], col_lo[idx], col_gid[idx], valid
+    return (col_hi[idx], col_lo[idx], col_hi2[idx], col_lo2[idx],
+            col_gid[idx], valid)
 
 
 @hgverify.entry(
     shapes=lambda: (
         (hgverify.sds((64,), "uint32"), hgverify.sds((64,), "uint32"),
+         hgverify.sds((64,), "uint32"), hgverify.sds((64,), "uint32"),
          hgverify.sds((64,), "int32"), hgverify.sds((), "int32"),
+         hgverify.sds((32,), "uint32"), hgverify.sds((32,), "uint32"),
          hgverify.sds((32,), "uint32"), hgverify.sds((32,), "uint32"),
          hgverify.sds((32,), "int32"), hgverify.sds((), "int32"),
          hgverify.sds((33,), "int32"),
          hgverify.sds((33,), "int32"), hgverify.sds((64,), "int32"),
          hgverify.sds((8,), "uint32"), hgverify.sds((8,), "uint32"),
+         hgverify.sds((8,), "uint32"), hgverify.sds((8,), "uint32"),
          hgverify.sds((8,), "bool"),
+         hgverify.sds((8,), "uint32"), hgverify.sds((8,), "uint32"),
          hgverify.sds((8,), "uint32"), hgverify.sds((8,), "uint32"),
          hgverify.sds((8,), "bool"),
          hgverify.sds((8,), "int32"), hgverify.sds((8,), "int32"),
@@ -148,10 +182,14 @@ def _window_gather(col_hi, col_lo, col_gid, lo_idx, hi_idx, desc, win_pad):
 def ordered_topk_batch(
     col_hi: jax.Array,    # base column (storage/value_index layout)
     col_lo: jax.Array,
+    col_hi2: jax.Array,   # base column second rank words
+    col_lo2: jax.Array,
     col_gid: jax.Array,
     n_base: jax.Array,    # scalar int32
     d_hi: jax.Array,      # delta column (same layout, may be all-pad)
     d_lo: jax.Array,
+    d_hi2: jax.Array,     # delta column second rank words
+    d_lo2: jax.Array,
     d_gid: jax.Array,
     n_delta: jax.Array,   # scalar int32
     type_of: jax.Array,   # (N+1,) int32 — per-atom type handles
@@ -159,9 +197,13 @@ def ordered_topk_batch(
     inc_links: jax.Array,    # (E,) int32
     lo_hi: jax.Array,     # per-lane bounds, range_probe_batch conventions
     lo_lo: jax.Array,
+    lo_hi2: jax.Array,    # lower-bound second rank words
+    lo_lo2: jax.Array,
     lo_right: jax.Array,
     hi_hi: jax.Array,
     hi_lo: jax.Array,
+    hi_hi2: jax.Array,    # upper-bound second rank words
+    hi_lo2: jax.Array,
     hi_right: jax.Array,
     type_vec: jax.Array,  # (K,) int32 — per-lane type handle, <0 = any
     anchor_vec: jax.Array,  # (K,) int32 — per-lane incident anchor, <0 = none
@@ -192,21 +234,23 @@ def ordered_topk_batch(
     if win_pad < top_r:
         raise ValueError(f"win_pad {win_pad} < top_r {top_r}: the merged "
                          "prefix could miss global top-k entries")
-    lo_b, hi_b = range_probe_batch(col_hi, col_lo, n_base,
-                                   lo_hi, lo_lo, lo_right,
-                                   hi_hi, hi_lo, hi_right)
-    lo_d, hi_d = range_probe_batch(d_hi, d_lo, n_delta,
-                                   lo_hi, lo_lo, lo_right,
-                                   hi_hi, hi_lo, hi_right)
+    lo_b, hi_b = range_probe_batch(col_hi, col_lo, col_hi2, col_lo2, n_base,
+                                   lo_hi, lo_lo, lo_hi2, lo_lo2, lo_right,
+                                   hi_hi, hi_lo, hi_hi2, hi_lo2, hi_right)
+    lo_d, hi_d = range_probe_batch(d_hi, d_lo, d_hi2, d_lo2, n_delta,
+                                   lo_hi, lo_lo, lo_hi2, lo_lo2, lo_right,
+                                   hi_hi, hi_lo, hi_hi2, hi_lo2, hi_right)
     window_total = (hi_b - lo_b) + (hi_d - lo_d)
     covered = ((hi_b - lo_b) <= win_pad) & ((hi_d - lo_d) <= win_pad)
 
-    bh, bl, bg, bv = _window_gather(col_hi, col_lo, col_gid,
-                                    lo_b, hi_b, desc, win_pad)
-    dh, dl, dg, dv = _window_gather(d_hi, d_lo, d_gid,
-                                    lo_d, hi_d, desc, win_pad)
+    bh, bl, bh2, bl2, bg, bv = _window_gather(
+        col_hi, col_lo, col_hi2, col_lo2, col_gid, lo_b, hi_b, desc, win_pad)
+    dh, dl, dh2, dl2, dg, dv = _window_gather(
+        d_hi, d_lo, d_hi2, d_lo2, d_gid, lo_d, hi_d, desc, win_pad)
     kh = jnp.concatenate([bh, dh], axis=1)
     kl = jnp.concatenate([bl, dl], axis=1)
+    kh2 = jnp.concatenate([bh2, dh2], axis=1)
+    kl2 = jnp.concatenate([bl2, dl2], axis=1)
     gid = jnp.concatenate([bg, dg], axis=1)
     valid = jnp.concatenate([bv, dv], axis=1)
 
@@ -232,8 +276,13 @@ def ordered_topk_batch(
     flip = desc[:, None]
     kh = jnp.where(flip, ~kh, kh)
     kl = jnp.where(flip, ~kl, kl)
+    kh2 = jnp.where(flip, ~kh2, kh2)
+    kl2 = jnp.where(flip, ~kl2, kl2)
     kh = jnp.where(valid, kh, _U32_MAX)
     kl = jnp.where(valid, kl, _U32_MAX)
+    kh2 = jnp.where(valid, kh2, _U32_MAX)
+    kl2 = jnp.where(valid, kl2, _U32_MAX)
     gid = jnp.where(valid, gid, SENTINEL)
-    _, _, sorted_gid = jax.lax.sort((kh, kl, gid), num_keys=3, dimension=1)
+    _, _, _, _, sorted_gid = jax.lax.sort(
+        (kh, kl, kh2, kl2, gid), num_keys=5, dimension=1)
     return counts, sorted_gid[:, :top_r], covered, window_total

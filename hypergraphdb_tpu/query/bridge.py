@@ -85,21 +85,28 @@ def to_range_request(graph, lo=None, hi=None, *, lo_op: str = "gte",
                      limit: Optional[int] = None) -> RangeRequest:
     """Build a :class:`RangeRequest` from VALUES (at least one bound):
     the typesystem derives the indexed dimension (the value kind byte)
-    and the 64-bit rank bounds; mixed-kind bounds are Unservable (ranks
-    of different kinds are incomparable once the kind prefix is
-    stripped). Variable-width kinds (str/bytes) produce ``exact=False``
-    requests — admitted, batched, and served on the exact host lane."""
+    and the 128-bit rank-pair bounds; mixed-kind bounds are Unservable
+    (ranks of different kinds are incomparable once the kind prefix is
+    stripped). Variable-width kinds (str/bytes) produce ``exact=True``
+    when every bound key is CLEAN (≤16 payload bytes, NUL-free — the
+    zero-padded rank pair then orders the bound exactly against any
+    column entry); ambiguous bounds produce ``exact=False`` requests —
+    admitted, batched, and served on the exact host lane."""
     from hypergraphdb_tpu.storage.value_index import FIXED_WIDTH_KINDS
-    from hypergraphdb_tpu.utils.ordered_bytes import rank64
+    from hypergraphdb_tpu.utils.ordered_bytes import rank128, rank_ambiguous
 
     if lo is None and hi is None:
         raise Unservable("a range request needs at least one bound "
                          "(an unbounded scan has no batchable window)")
     lo_rank = hi_rank = None
+    lo_rank2 = hi_rank2 = 0
     dim = None
+    bounds_clean = True
     if lo is not None:
         key = _value_key(graph, lo)
-        dim, lo_rank = key[0], rank64(key[1:])
+        dim = key[0]
+        lo_rank, lo_rank2 = rank128(key[1:])
+        bounds_clean = bounds_clean and not rank_ambiguous(key[1:])
     if hi is not None:
         key = _value_key(graph, hi)
         if dim is not None and key[0] != dim:
@@ -107,14 +114,17 @@ def to_range_request(graph, lo=None, hi=None, *, lo_op: str = "gte",
                 f"mixed-kind range bounds ({lo!r}, {hi!r}): ranks of "
                 "different value kinds are incomparable"
             )
-        dim, hi_rank = key[0], rank64(key[1:])
+        dim = key[0]
+        hi_rank, hi_rank2 = rank128(key[1:])
+        bounds_clean = bounds_clean and not rank_ambiguous(key[1:])
     return RangeRequest(
         dim=int(dim), lo_rank=lo_rank, hi_rank=hi_rank,
-        lo_op=lo_op, hi_op=hi_op, values=(lo, hi),
+        lo_op=lo_op, hi_op=hi_op,
+        lo_rank2=lo_rank2, hi_rank2=hi_rank2, values=(lo, hi),
         type_handle=None if type_handle is None else int(type_handle),
         anchor=None if anchor is None else int(anchor),
         desc=bool(desc), limit=limit,
-        exact=int(dim) in FIXED_WIDTH_KINDS,
+        exact=int(dim) in FIXED_WIDTH_KINDS or bounds_clean,
     )
 
 

@@ -42,7 +42,8 @@ front door and load balancers need it ON the submit port.
 front door wears them): ``GET /fleet/metrics`` (per-node-labelled
 merged exposition), ``/fleet/healthz`` (worst-of + per-node detail),
 ``/fleet/slo`` (error-budget burn state), ``/fleet/perf`` (per-node
-perf-sentinel verdicts + violation map), and ``/fleet/traces/<tid>``
+perf-sentinel verdicts + violation map), ``/fleet/plan`` (per-node
+hgplan correction state), and ``/fleet/traces/<tid>``
 (one cross-process span tree stitched from every node's half) ride the
 same port as ``/submit``, so the fleet is observed through the URL
 callers already use. ``POST /submit {"explain": true}`` adds the
@@ -165,6 +166,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._respond(200, fleet.slo.snapshot())
         elif path == "/fleet/perf":
             self._respond(200, fleet.fleet_perf())
+        elif path == "/fleet/plan":
+            self._respond(200, fleet.fleet_plan())
         elif path == "/fleet/traces":
             self._respond(200, {"traces": fleet.fleet_traces()})
         elif path.startswith("/fleet/traces/"):
@@ -244,7 +247,7 @@ class SubmitServer:
         self.subscribe_fn = subscribe_fn
         self.poll_fn = poll_fn
         #: optional hgobs FleetCollector: serves /fleet/metrics,
-        #: /fleet/healthz, /fleet/slo, /fleet/perf,
+        #: /fleet/healthz, /fleet/slo, /fleet/perf, /fleet/plan,
         #: /fleet/traces[/<tid>] ON this
         #: port — the front door wears it so the fleet is operated
         #: through the same URL callers submit to

@@ -419,12 +419,16 @@ class DeviceExecutor:
             # second shape-keyed program, warmed as THE range program)
             inc_off, inc_links = _dummy_inc_csr()
         args = (
-            bcol.rank_hi, bcol.rank_lo, bcol.gids, jnp.int32(bcol.n),
-            dcol.rank_hi, dcol.rank_lo, dcol.gids, jnp.int32(dcol.n),
+            bcol.rank_hi, bcol.rank_lo, bcol.rank2_hi, bcol.rank2_lo,
+            bcol.gids, jnp.int32(bcol.n),
+            dcol.rank_hi, dcol.rank_lo, dcol.rank2_hi, dcol.rank2_lo,
+            dcol.gids, jnp.int32(dcol.n),
             type_of_device(view.base), inc_off, inc_links,
             jnp.asarray(bounds["lo_hi"]), jnp.asarray(bounds["lo_lo"]),
+            jnp.asarray(bounds["lo_hi2"]), jnp.asarray(bounds["lo_lo2"]),
             jnp.asarray(bounds["lo_right"]),
             jnp.asarray(bounds["hi_hi"]), jnp.asarray(bounds["hi_lo"]),
+            jnp.asarray(bounds["hi_hi2"]), jnp.asarray(bounds["hi_lo2"]),
             jnp.asarray(bounds["hi_right"]),
             jnp.asarray(bounds["type_vec"]), jnp.asarray(bounds["anchor"]),
             jnp.asarray(bounds["desc"]),
@@ -629,12 +633,15 @@ class DeviceExecutor:
                     warm += self.aot.warm(
                         "ops.value_index.ordered_topk_batch",
                         ordered_topk_batch,
-                        (bcol.rank_hi, bcol.rank_lo, bcol.gids,
+                        (bcol.rank_hi, bcol.rank_lo,
+                         bcol.rank2_hi, bcol.rank2_lo, bcol.gids,
                          jnp.int32(bcol.n),
                          empty_delta.rank_hi, empty_delta.rank_lo,
+                         empty_delta.rank2_hi, empty_delta.rank2_lo,
                          empty_delta.gids, jnp.int32(0),
                          type_of_device(view.base), inc_off, inc_links,
-                         zu, zu, zb, zu, zu, zb, neg, neg, zb),
+                         zu, zu, zu, zu, zb, zu, zu, zu, zu, zb,
+                         neg, neg, zb),
                         {"win_pad": self._range_win_pad(),
                          "top_r": self.config.top_r},
                     )
@@ -787,6 +794,7 @@ class DeviceExecutor:
                     )
         elif kind == "range":
             from hypergraphdb_tpu.storage.value_index import (
+                FIXED_WIDTH_KINDS,
                 value_index_column,
             )
 
@@ -799,14 +807,31 @@ class DeviceExecutor:
                 # an empty window, well-defined garbage by construction
                 "lo_hi": np.zeros(K, np.uint32),
                 "lo_lo": np.zeros(K, np.uint32),
+                "lo_hi2": np.zeros(K, np.uint32),
+                "lo_lo2": np.zeros(K, np.uint32),
                 "lo_right": np.zeros(K, bool),
                 "hi_hi": np.zeros(K, np.uint32),
                 "hi_lo": np.zeros(K, np.uint32),
+                "hi_hi2": np.zeros(K, np.uint32),
+                "hi_lo2": np.zeros(K, np.uint32),
                 "hi_right": np.zeros(K, bool),
                 "type_vec": np.full(K, -1, np.int32),
                 "anchor": np.full(K, -1, np.int32),
                 "desc": np.zeros(K, bool),
             }
+            # columns build lazily: a variable-width batch must consult
+            # their device_exact verdicts BEFORE routing lanes, but an
+            # all-host batch (every bound ambiguous) must not pay the
+            # build/upload at all
+            cols = []
+
+            def _cols():
+                if not cols:
+                    cols.append(value_index_column(view.base, dim))
+                    cols.append(self.mgr.value_delta(
+                        view, dim, self.config.max_lag_edges))
+                return cols
+
             lane = 0
             for t in batch.tickets:
                 req = t.request
@@ -814,29 +839,41 @@ class DeviceExecutor:
                         or (req.limit is not None
                             and req.limit > self.config.top_r)
                         or (req.anchor is not None
-                            and (req.anchor < 0 or req.anchor >= n))):
-                    # variable-width kinds (rank ties), over-window
-                    # limits, and anchors outside the base (a memtable
-                    # anchor has no base incidence row to probe) all
-                    # serve exactly on host. Anchored lanes under fresh
-                    # ingest stay on device: the base-row probe can only
-                    # mask fresh links OUT (never falsely in), and the
-                    # collect re-offers the full memtable candidate set
-                    # through the live-incidence host probe.
+                            and (req.anchor < 0 or req.anchor >= n))
+                        or (dim not in FIXED_WIDTH_KINDS
+                            and not all(c.device_exact for c in _cols()))):
+                    # ambiguous variable-width bounds (ties past the
+                    # 128-bit rank pair), columns holding any ambiguous
+                    # key, over-window limits, and anchors outside the
+                    # base (a memtable anchor has no base incidence row
+                    # to probe) all serve exactly on host. Anchored lanes
+                    # under fresh ingest stay on device: the base-row
+                    # probe can only mask fresh links OUT (never falsely
+                    # in), and the collect re-offers the full memtable
+                    # candidate set through the live-incidence host
+                    # probe.
                     out.host_tickets.append(t)
                     continue
                 lo, hi = req.lo_rank, req.hi_rank
                 if lo is not None:
                     bounds["lo_hi"][lane] = np.uint32(lo >> 32)
                     bounds["lo_lo"][lane] = np.uint32(lo & 0xFFFFFFFF)
+                    bounds["lo_hi2"][lane] = np.uint32(req.lo_rank2 >> 32)
+                    bounds["lo_lo2"][lane] = np.uint32(
+                        req.lo_rank2 & 0xFFFFFFFF)
                     bounds["lo_right"][lane] = req.lo_op == "gt"
                 if hi is not None:
                     bounds["hi_hi"][lane] = np.uint32(hi >> 32)
                     bounds["hi_lo"][lane] = np.uint32(hi & 0xFFFFFFFF)
+                    bounds["hi_hi2"][lane] = np.uint32(req.hi_rank2 >> 32)
+                    bounds["hi_lo2"][lane] = np.uint32(
+                        req.hi_rank2 & 0xFFFFFFFF)
                     bounds["hi_right"][lane] = req.hi_op == "lte"
                 else:
                     bounds["hi_hi"][lane] = U32
                     bounds["hi_lo"][lane] = U32
+                    bounds["hi_hi2"][lane] = U32
+                    bounds["hi_lo2"][lane] = U32
                     bounds["hi_right"][lane] = True
                 if req.type_handle is not None:
                     bounds["type_vec"][lane] = int(req.type_handle)
@@ -846,9 +883,7 @@ class DeviceExecutor:
                 out.lane_tickets.append((lane, t))
                 lane += 1
             if out.lane_tickets:
-                bcol = value_index_column(view.base, dim)
-                dcol = self.mgr.value_delta(view, dim,
-                                            self.config.max_lag_edges)
+                bcol, dcol = _cols()
                 out.range_covered = dcol.covered
                 self.stats.record_range_dispatch()
                 with self._dispatch_cm("range", batch.bucket, dim):
@@ -1591,6 +1626,10 @@ class ServeRuntime:
         #: the thread starts; read with getattr-free attribute access on
         #: every cycle (None = one comparison)
         self.subscriptions = None
+        #: attached hgplan ``QueryPlanner`` (``attach_planner``): the
+        #: cost-based chooser behind ``submit_planned``. None = the
+        #: planned entry point is simply unavailable
+        self.planner = None
         self._closed = False
         self._close_started = False
         self._draining = False
@@ -1756,6 +1795,139 @@ class ServeRuntime:
             to_request(self.graph, condition,
                        default_max_hops=self.config.default_max_hops),
             deadline_s, priority,
+        )
+
+    # -- planned submission (hgplan) -----------------------------------------
+    def attach_planner(self, planner) -> None:
+        """Wire an hgplan ``QueryPlanner`` into this runtime: the
+        planner's telemetry binds to THIS runtime's ``ServeStats``
+        (``plan.*`` metrics ride the serving registry) and — unless the
+        planner already carries one — its sentinel guard binds to this
+        runtime's perf sentinel (a learned correction may never steer
+        the argmin onto a lane currently listed in the sentinel's
+        ``violating`` set). ``submit_planned`` is refused until this is
+        called."""
+        with self._close_lock:
+            planner.stats = self.stats
+            if planner.lane_degraded is None and self.perf is not None:
+                perf = self.perf
+
+                def _lane_degraded(kind: str) -> bool:
+                    try:
+                        return kind in perf.health_summary().get(
+                            "violating", ())
+                    except Exception:
+                        return False  # a perf fault must not veto plans
+
+                planner.lane_degraded = _lane_degraded
+            self.planner = planner
+
+    def submit_planned(self, condition, deadline_s: Optional[float] = None,
+                       priority: int = 0, explain: bool = False,
+                       force_shape: Optional[str] = None) -> Future:
+        """Admit a query CONDITION through the attached cost-based
+        planner: enumerate the candidate lane strategies, dispatch the
+        cheapest (``force_shape`` overrides — the differential suite's
+        hook), host-filter the residual clauses, and resolve to a
+        ``plan.PlannedResult`` whose ``plan`` dict carries
+        ``est_rows`` / ``actual_rows`` / the chosen shape. With
+        ``explain=True`` the future's ``.explain`` record grows the same
+        ``plan`` sub-dict beside the lane attribution (the host shape
+        synthesizes a minimal record — no lane, no trace).
+
+        Exactness contract matches ``graph.find_all(condition)``: a
+        truncated lane window is re-served brute-force on the host, so
+        the planner can be WRONG about cost but never about results."""
+        planner = self.planner
+        if planner is None:
+            raise Unservable(
+                "no planner attached: build a plan.QueryPlanner and "
+                "attach_planner() it before submit_planned"
+            )
+        choice = planner.plan(condition, force_shape=force_shape)
+        if choice.request is None:
+            return self._planned_host(planner, choice, explain)
+        inner = self.submit(choice.request, deadline_s, priority, explain)
+        outer: Future = Future()
+
+        def _done(f: Future) -> None:
+            try:
+                res = f.result()
+            except Exception as e:
+                outer.set_exception(e)
+                return
+            try:
+                out = self._finish_planned(planner, choice, res)
+                if explain:
+                    ex = dict(getattr(f, "explain", None) or {})
+                    ex["plan"] = out.plan
+                    outer.explain = ex
+            except Exception as e:  # residual/feedback fault → caller
+                outer.set_exception(e)
+                return
+            outer.set_result(out)
+
+        inner.add_done_callback(_done)
+        return outer
+
+    def _planned_host(self, planner, choice, explain: bool) -> Future:
+        """The host shape: no lane, no queue — the exact scan the
+        brute-force oracle defines, executed inline on the caller."""
+        matches = tuple(sorted(
+            int(h) for h in self.graph.find_all(choice.condition)))
+        planner.observe(choice, len(matches))
+        plan_rec = choice.explain()
+        plan_rec["actual_rows"] = len(matches)
+        from hypergraphdb_tpu.plan.planner import PlannedResult
+
+        res = PlannedResult(
+            kind="planned", count=len(matches), matches=matches,
+            truncated=False, epoch=choice.epoch, lane_kind="host",
+            served_by="host", plan=plan_rec,
+        )
+        fut: Future = Future()
+        if explain:
+            fut.explain = {"lane": {"kind": "host", "path": "host"},
+                           "plan": plan_rec}
+        fut.set_result(res)
+        return fut
+
+    def _finish_planned(self, planner, choice, res):
+        """Turn one lane result into the planned answer: close the
+        feedback loop on the PRE-residual row count, then either apply
+        the residual filter or — when the lane window truncated — fall
+        back to the exact host scan (truncation-honest results have an
+        exact ``count`` but only a prefix of ``matches``; filtering a
+        prefix would silently drop rows)."""
+        actual = int(res.count)
+        planner.observe(choice, actual)
+        plan_rec = choice.explain()
+        plan_rec["actual_rows"] = actual
+        truncated = bool(res.truncated)
+        if truncated:
+            matches = tuple(sorted(
+                int(h) for h in self.graph.find_all(choice.condition)))
+            served_by = "host"
+        else:
+            if getattr(res, "kind", None) == "join":
+                # single-variable condition join: project the "x" column
+                # and dedupe — distinct=False keeps one row per
+                # WITNESSING binding (auxiliary link vars), not per atom
+                col = res.vars.index("x") if "x" in res.vars else 0
+                rows = {int(t[col]) for t in res.tuples}
+            else:
+                rows = {int(h) for h in res.matches}
+            g = self.graph
+            matches = tuple(sorted(
+                h for h in rows
+                if all(cl.satisfies(g, h) for cl in choice.residual)))
+            served_by = res.served_by
+        from hypergraphdb_tpu.plan.planner import PlannedResult
+
+        return PlannedResult(
+            kind="planned", count=len(matches), matches=matches,
+            truncated=False, epoch=getattr(res, "epoch", choice.epoch),
+            lane_kind=res.kind, served_by=served_by, plan=plan_rec,
         )
 
     # -- dispatch ------------------------------------------------------------

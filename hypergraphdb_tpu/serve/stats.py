@@ -75,7 +75,33 @@ LANE_NAMES = tuple(
 #: evaluates ``DOTTED_NAMES`` (and any ``*_PREFIX`` constant) by AST and
 #: flags literal metric sites outside the registry — renaming either
 #: constant silently drops that coverage.
-DOTTED_NAMES = LANE_NAMES + (
+#: every plan SHAPE the hgplan planner can choose (``plan/planner.py``'s
+#: candidate vocabulary: the four lanes' strategies plus the exact host
+#: scan). Spelled here — not imported — because the dependency edge
+#: runs plan → serve; the planner differential suite holds the two
+#: vocabularies against each other instead.
+PLAN_SHAPES = ("range_first", "pattern", "join", "bfs", "host")
+
+#: every FIXED ``plan.*`` name (the hgplan planner's telemetry, recorded
+#: through this façade so planned traffic shares the serving registry,
+#: the drift gate, and the HG1105 vocabulary). Eager like the lane
+#: family: per-shape choice counters cover all of :data:`PLAN_SHAPES`
+#: from construction. NOTE: appended into :data:`DOTTED_NAMES` as one
+#: expression — the HG1105 AST evaluator resolves a registry from its
+#: single binding; re-assignment would make it self-referential and
+#: silently drop governance of BOTH namespaces.
+PLAN_NAMES = tuple(f"plan.choice.{shape}" for shape in PLAN_SHAPES) + (
+    "plan.requests",
+    "plan.est_rows",
+    "plan.actual_rows",
+    "plan.cost_seconds",
+    "plan.abs_rel_error",
+    "plan.feedback_updates",
+    "plan.feedback_clamped",
+    "plan.guard_vetoes",
+)
+
+DOTTED_NAMES = LANE_NAMES + PLAN_NAMES + (
     "serve.join.hub_dispatches",
     "serve.join.partial_corrections",
     "serve.submitted",
@@ -159,12 +185,30 @@ class ServeStats:
             (kind, path): r.counter(f"serve.lane.{kind}.{path}")
             for kind in LANE_KINDS for path in LANE_PATHS
         }
+        # the hgplan planner's telemetry, eager over PLAN_SHAPES (same
+        # drift-gate contract as the lane family)
+        self._plan_choices = {
+            shape: r.counter(f"plan.choice.{shape}") for shape in PLAN_SHAPES
+        }
+        self._plan_requests = r.counter("plan.requests")
+        self._plan_est_rows = r.histogram("plan.est_rows")
+        self._plan_actual_rows = r.histogram("plan.actual_rows")
+        self._plan_cost = r.histogram("plan.cost_seconds")
+        self._plan_abs_rel_error = r.histogram("plan.abs_rel_error")
+        self._plan_fb_updates = r.counter("plan.feedback_updates")
+        self._plan_fb_clamped = r.counter("plan.feedback_clamped")
+        self._plan_guard_vetoes = r.counter("plan.guard_vetoes")
         # per-batch-key breaker family, lazily registered on a key's
         # first transition (label -> instrument; _key_instruments makes
         # reset() cover them too)
         self._key_states: dict = {}
         self._key_trips: dict = {}
-        self._own = tuple(self._lanes.values()) + (
+        self._own = tuple(self._lanes.values()) + tuple(
+            self._plan_choices.values()) + (
+            self._plan_requests, self._plan_est_rows, self._plan_actual_rows,
+            self._plan_cost, self._plan_abs_rel_error, self._plan_fb_updates,
+            self._plan_fb_clamped, self._plan_guard_vetoes,
+        ) + (
             self._submitted, self._completed, self._shed, self._rejected,
             self._gated, self._cancelled, self._errors, self._host_fallbacks,
             self._batches, self._device_dispatches,
@@ -258,6 +302,50 @@ class ServeStats:
         host."""
         with self._lock:
             self._join_partial.inc()
+
+    # -- hgplan telemetry ----------------------------------------------------
+    def record_plan_request(self, shape: str, est_rows: float,
+                            cost_s: float) -> None:
+        """One planner verdict: which shape won, what it estimated, what
+        the costing priced it at. Unknown shapes (a planner this façade
+        predates) drop like unknown lanes — never raise on a serve
+        thread."""
+        with self._lock:
+            self._plan_requests.inc()
+            c = self._plan_choices.get(shape)
+            if c is not None:
+                c.inc()
+            self._plan_est_rows.observe(float(est_rows))
+            self._plan_cost.observe(float(cost_s))
+
+    def record_plan_actual(self, est_rows: float, actual_rows: float) -> None:
+        """The execution side of one planned request: the actual row
+        count and the |est − actual| / max(actual, 1) relative error the
+        feedback digest learns from."""
+        with self._lock:
+            self._plan_actual_rows.observe(float(actual_rows))
+            err = abs(float(est_rows) - float(actual_rows))
+            self._plan_abs_rel_error.observe(err / max(float(actual_rows),
+                                                       1.0))
+
+    def record_plan_feedback_update(self, clamped: bool = False) -> None:
+        """One ratio admitted into the drift digest (``clamped`` when
+        the stored ratio hit the digest's clamp bounds)."""
+        with self._lock:
+            self._plan_fb_updates.inc()
+            if clamped:
+                self._plan_fb_clamped.inc()
+
+    def record_plan_guard_veto(self) -> None:
+        """The sentinel guard kept the uncorrected plan because the
+        learned correction would have steered onto a lane currently
+        breaching its perf baseline."""
+        with self._lock:
+            self._plan_guard_vetoes.inc()
+
+    def plan_choice_counts(self) -> dict:
+        """{shape: chosen count} over the planner's vocabulary."""
+        return {shape: c.value for shape, c in self._plan_choices.items()}
 
     def record_breaker_trip(self) -> None:
         with self._lock:
@@ -416,6 +504,18 @@ class ServeStats:
     @property
     def join_partial_corrections(self) -> int:
         return self._join_partial.value
+
+    @property
+    def plan_requests(self) -> int:
+        return self._plan_requests.value
+
+    @property
+    def plan_guard_vetoes(self) -> int:
+        return self._plan_guard_vetoes.value
+
+    @property
+    def plan_feedback_updates(self) -> int:
+        return self._plan_fb_updates.value
 
     @property
     def host_fallbacks(self) -> int:

@@ -156,13 +156,20 @@ class RangeRequest:
     ``dim`` is the value kind byte (the indexed DIMENSION — requests of
     one dimension share a sorted device column and a batch); ``lo_rank``
     / ``hi_rank`` are 64-bit order-preserving payload ranks
-    (``utils/ordered_bytes.rank64``), ``None`` = open bound. ``lo_op`` ∈
-    {"gt", "gte"}, ``hi_op`` ∈ {"lt", "lte"}. ``exact`` records whether
-    the kind is fixed-width (rank order == value order, tie-free): lanes
-    with ``exact=False`` (str/bytes) are served on the exact host path —
-    honest scoping, the device window cannot see rank ties. ``values``
-    keeps the ORIGINAL (lo, hi) python values so host execution and
-    memtable correction compare real keys, never coarse ranks.
+    (``utils/ordered_bytes.rank64``), ``None`` = open bound;
+    ``lo_rank2`` / ``hi_rank2`` the matching SECOND rank words (payload
+    bytes 8..16, ``rank128`` — 0 for fixed-width kinds and short keys).
+    ``lo_op`` ∈ {"gt", "gte"}, ``hi_op`` ∈ {"lt", "lte"}. ``exact``
+    records whether the 128-bit rank pair decides the request exactly:
+    True for fixed-width kinds (rank order == value order, tie-free) and
+    for variable-width bounds that are CLEAN (≤16 payload bytes, no NUL
+    among them); lanes with ``exact=False`` are served on the exact host
+    path — honest scoping, the device window cannot see ties past the
+    pair. Even an ``exact`` variable-width request falls back to host
+    when a consulted column is not ``device_exact`` (the runtime checks
+    at dispatch). ``values`` keeps the ORIGINAL (lo, hi) python values
+    so host execution and memtable correction compare real keys, never
+    coarse ranks.
 
     Build via ``query.bridge.to_range_request`` (which derives the
     dimension and ranks through the typesystem) rather than by hand."""
@@ -172,6 +179,8 @@ class RangeRequest:
     hi_rank: Optional[int]
     lo_op: str = "gte"
     hi_op: str = "lte"
+    lo_rank2: int = 0
+    hi_rank2: int = 0
     values: tuple = (None, None)
     type_handle: Optional[int] = None
     anchor: Optional[int] = None

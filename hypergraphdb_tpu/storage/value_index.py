@@ -22,9 +22,13 @@ compensate at collect time — exact at any lag.
 
 Rank semantics: ``value_rank`` is the order-preserving 64-bit payload
 rank of ``ops/snapshot.py``. For fixed-width kinds the rank order IS the
-value order (tie-free); variable-width kinds (str/bytes) tie on rank
-equality, so the serve lane routes them to the exact host path instead
-of shipping maybe-wrong windows.
+value order (tie-free). Variable-width kinds (str/bytes) carry a SECOND
+rank word (key payload bytes 8..16, ``utils/ordered_bytes.rank128``) so
+rank-tied windows stay exact on device up to 16 payload bytes; columns
+holding any AMBIGUOUS key (payload >16 bytes, or NUL among the first 16
+— zero-padding stops being order/identity-faithful there) clear
+``device_exact`` and the serve lane routes those requests to the exact
+host path instead of shipping maybe-wrong windows.
 """
 
 from __future__ import annotations
@@ -49,54 +53,78 @@ RANK_PAD = np.uint32(0xFFFFFFFF)
 class ValueIndexColumn:
     """One indexed dimension's sorted column pair, device-resident.
 
-    ``rank_hi``/``rank_lo`` are the 64-bit ranks split into uint32 words
-    (compare lexicographically hi-then-lo — the
+    ``rank_hi``/``rank_lo`` are the first 64-bit rank word split into
+    uint32 words (compare lexicographically hi-then-lo — the
     ``ops/snapshot.DeviceSnapshot`` convention; jnp would truncate
-    uint64), ``gids`` the owning atom ids; all three sorted ascending by
-    ``(rank, gid)`` and padded to a power-of-two bucket with
-    ``RANK_PAD``/``GID_PAD``. ``n`` is the real (unpadded) entry count;
-    kernels bound their binary searches by it, so pad entries are never
-    probed. ``covered`` is meaningful for DELTA columns only: how many
-    leading entries of the memtable's ``new_atoms`` list the column
-    accounts for (the residual past it is host-corrected at collect)."""
+    uint64), ``rank2_hi``/``rank2_lo`` the SECOND rank word (payload
+    bytes 8..16) split the same way, ``gids`` the owning atom ids; all
+    five sorted ascending by ``(rank, rank2, gid)`` and padded to a
+    power-of-two bucket with ``RANK_PAD``/``GID_PAD``. ``n`` is the real
+    (unpadded) entry count; kernels bound their binary searches by it,
+    so pad entries are never probed. ``covered`` is meaningful for DELTA
+    columns only: how many leading entries of the memtable's
+    ``new_atoms`` list the column accounts for (the residual past it is
+    host-corrected at collect). ``device_exact`` asserts the 128-bit
+    rank pair totally orders AND identifies every entry — always True
+    for fixed-width kinds, True for variable-width only when no entry's
+    key is ambiguous; the serve lane may ship device windows for a
+    variable-width request only when every consulted column says so."""
 
     kind: int             # value kind byte this column indexes
     n: int                # real entries
     rank_hi: object       # (M,) uint32 jax array
     rank_lo: object       # (M,) uint32
     gids: object          # (M,) int32
+    rank2_hi: object = None  # (M,) uint32 — second rank word, high half
+    rank2_lo: object = None  # (M,) uint32 — second rank word, low half
     epoch: int = -1       # compaction epoch (delta columns)
     covered: int = 0      # new_atoms prefix length scanned (delta columns)
+    device_exact: bool = False  # 128-bit rank pair is order+identity-exact
 
 
 def _sorted_device_column(kind: int, ranks: np.ndarray, gids: np.ndarray,
                           epoch: int = -1, covered: int = 0,
-                          minimum: int = 128) -> ValueIndexColumn:
-    """Sort host ``(rank uint64, gid)`` pairs, split rank words, pad to a
-    bucket, and upload. The ONE constructor both the base and delta
-    builders go through, so the two can never disagree on layout. The
-    bucket rule is ``ops/setops._bucket`` — the same rule that sizes the
-    kernels' gather pads (deferred import, like jnp: every caller is
+                          minimum: int = 128,
+                          ranks2: np.ndarray = None,
+                          exact: bool = None) -> ValueIndexColumn:
+    """Sort host ``(rank uint64, rank2 uint64, gid)`` triples, split rank
+    words, pad to a bucket, and upload. The ONE constructor both the base
+    and delta builders go through, so the two can never disagree on
+    layout. ``ranks2`` defaults to zeros (fixed-width kinds carry no
+    second word); ``exact`` defaults to the kind's fixed-width verdict.
+    The bucket rule is ``ops/setops._bucket`` — the same rule that sizes
+    the kernels' gather pads (deferred import, like jnp: every caller is
     already on a device path)."""
     import jax.numpy as jnp
 
     from hypergraphdb_tpu.ops.setops import _bucket
 
-    order = np.lexsort((gids, ranks))
+    if ranks2 is None:
+        ranks2 = np.zeros(len(ranks), dtype=np.uint64)
+    if exact is None:
+        exact = int(kind) in FIXED_WIDTH_KINDS
+    order = np.lexsort((gids, ranks2, ranks))
     ranks = ranks[order]
+    ranks2 = ranks2[order]
     gids = gids[order].astype(np.int32)
     n = len(gids)
     m = _bucket(max(n, 1), minimum=minimum)
     hi = np.full(m, RANK_PAD, dtype=np.uint32)
     lo = np.full(m, RANK_PAD, dtype=np.uint32)
+    hi2 = np.full(m, RANK_PAD, dtype=np.uint32)
+    lo2 = np.full(m, RANK_PAD, dtype=np.uint32)
     gp = np.full(m, GID_PAD, dtype=np.int32)
     hi[:n] = (ranks >> np.uint64(32)).astype(np.uint32)
     lo[:n] = (ranks & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    hi2[:n] = (ranks2 >> np.uint64(32)).astype(np.uint32)
+    lo2[:n] = (ranks2 & np.uint64(0xFFFFFFFF)).astype(np.uint32)
     gp[:n] = gids
     return ValueIndexColumn(
         kind=int(kind), n=n,
         rank_hi=jnp.asarray(hi), rank_lo=jnp.asarray(lo),
-        gids=jnp.asarray(gp), epoch=epoch, covered=covered,
+        gids=jnp.asarray(gp),
+        rank2_hi=jnp.asarray(hi2), rank2_lo=jnp.asarray(lo2),
+        epoch=epoch, covered=covered, device_exact=bool(exact),
     )
 
 
@@ -117,8 +145,21 @@ def value_index_column(snap, kind: int) -> ValueIndexColumn:
     sel = np.flatnonzero(
         (snap.value_kind[:N] == np.uint8(kind)) & (snap.type_of[:N] >= 0)
     )
+    rank2 = getattr(snap, "value_rank2", None)
+    ambig = getattr(snap, "value_ambig", None)
+    if rank2 is not None and len(rank2) >= N:
+        ranks2 = rank2[sel].astype(np.uint64)
+        exact = (kind in FIXED_WIDTH_KINDS
+                 or (ambig is not None and len(ambig) >= N
+                     and not bool(np.any(ambig[sel]))))
+    else:
+        # pre-tie-break snapshot (no second rank word): variable-width
+        # kinds cannot certify device exactness
+        ranks2 = None
+        exact = kind in FIXED_WIDTH_KINDS
     col = _sorted_device_column(
-        kind, snap.value_rank[sel].astype(np.uint64), sel
+        kind, snap.value_rank[sel].astype(np.uint64), sel,
+        ranks2=ranks2, exact=exact,
     )
     cache[kind] = col
     return col
@@ -179,19 +220,29 @@ def build_delta_column(graph, new_atoms, kind: int,
     length — atoms of other kinds, dead atoms, and keyless values are
     accounted as scanned (they can contribute nothing), so the collect
     residual is exactly ``new_atoms[covered:]``."""
-    from hypergraphdb_tpu.utils.ordered_bytes import rank64
+    from hypergraphdb_tpu.utils.ordered_bytes import rank128, rank_ambiguous
 
     ranks: list[int] = []
+    ranks2: list[int] = []
     gids: list[int] = []
     kb = bytes([int(kind)])
+    fixed = int(kind) in FIXED_WIDTH_KINDS
+    exact = True
     for h in new_atoms:
         key = value_key_of(graph, int(h))
         if key is not None and key[:1] == kb:
-            ranks.append(rank64(key[1:]))
+            payload = key[1:]
+            r1, r2 = rank128(payload)
+            ranks.append(r1)
+            ranks2.append(r2)
             gids.append(int(h))
+            if not fixed and rank_ambiguous(payload):
+                exact = False
     return _sorted_device_column(
         int(kind),
         np.asarray(ranks, dtype=np.uint64),
         np.asarray(gids, dtype=np.int64),
         epoch=epoch, covered=len(new_atoms), minimum=32,
+        ranks2=np.asarray(ranks2, dtype=np.uint64),
+        exact=fixed or exact,
     )

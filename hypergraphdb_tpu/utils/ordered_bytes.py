@@ -81,3 +81,29 @@ def rank64(key: bytes) -> int:
     """
     b = key[:8].ljust(8, b"\x00")
     return struct.unpack(">Q", b)[0]
+
+
+def rank128(key: bytes) -> tuple[int, int]:
+    """First 16 bytes of a key as TWO big-endian unsigned rank words —
+    ``(rank64(key[:8]), rank64(key[8:16]))``, compared lexicographically.
+
+    The hgindex tie-break pair: zero-padding is order-preserving over
+    NUL-free payloads, and INJECTIVE for payloads that fit the 16 bytes
+    entirely — for those columns rank order IS key order and the device
+    window needs no host tie service (``storage/value_index``'s
+    ``device_exact`` contract). Keys sharing their first 16 bytes still
+    tie; ``rank_ambiguous`` names exactly when.
+    """
+    return rank64(key), rank64(key[8:16])
+
+
+def rank_ambiguous(payload: bytes) -> bool:
+    """True when ``payload``'s 128-bit rank pair is NOT a faithful stand-
+    in for the full key: longer than 16 bytes (the pair is a proper
+    prefix) or containing NUL among the first 16 (zero-padding collides
+    with a real ``\\x00`` byte, breaking injectivity AND strict order
+    against bounds that are its prefix). Fixed-width encodings (int /
+    float / bool / timestamp payloads, exactly 8 NUL-admitting bytes)
+    never consult this — their single rank word is already exact by
+    construction."""
+    return len(payload) > 16 or b"\x00" in payload[:16]

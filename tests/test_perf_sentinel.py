@@ -128,6 +128,31 @@ def test_seed_baseline_from_bench_records(tmp_path):
     assert load_baseline(out)["source"] == rec["source"]
     # pattern has no bench record: not seeded, so never gated
     assert "pattern" not in rec["lanes"]
+    # the c7 throughput proxy says so in its note
+    assert "proxy" in rec["lanes"]["join"]["note"]
+
+
+def test_seed_baseline_c11_beats_the_c7_join_proxy(tmp_path):
+    """Both join sources present: the c11 open-loop percentiles win the
+    ``join`` lane over c7's closed-loop throughput proxy, whatever the
+    records' relative ages."""
+    (tmp_path / "BENCH_C7_smoke.json").write_text(json.dumps({
+        "schema_version": 1, "tag": "smoke", "backend": "cpu",
+        "recorded_unix": 999,
+        "c7_pattern_join": {"triangle": {"device_anchors_per_sec": 50.0}},
+    }))
+    (tmp_path / "BENCH_C11_smoke.json").write_text(json.dumps({
+        "schema_version": 2, "tag": "smoke", "backend": "cpu",
+        "recorded_unix": 1,
+        "c11_join": {"latency_ms_p50": 40.0, "latency_ms_p99": 90.0,
+                     "served_qps": 77.0},
+    }))
+    rec = seed_baseline(str(tmp_path))
+    join = rec["lanes"]["join"]
+    assert join["p50_s"] == pytest.approx(0.04)
+    assert join["p99_s"] == pytest.approx(0.09)
+    assert join["qps"] == 77.0
+    assert "open-loop" in join["note"]
 
 
 # ---------------------------------------------------------------- windows

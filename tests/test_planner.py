@@ -1,0 +1,205 @@
+"""hgplan planner differential suite: every candidate plan, same answer.
+
+The planner's core safety claim is that plan choice can only change COST,
+never RESULTS: for every condition in a seeded corpus, every enumerable
+candidate shape (forced via ``submit_planned(force_shape=...)``) must
+return exactly ``graph.find_all``'s match set — device lanes, host
+residual filters, truncation fallbacks and all. Runs the real
+DeviceExecutor under ``JAX_PLATFORMS=cpu`` with manual stepping.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from hypergraphdb_tpu.plan import PlanFeedback, QueryPlanner
+from hypergraphdb_tpu.query import conditions as c
+from hypergraphdb_tpu.serve import ServeConfig, ServeRuntime
+from hypergraphdb_tpu.serve.types import Unservable
+
+
+def _runtime(g, **kw):
+    kw.setdefault("top_r", 256)
+    cfg = ServeConfig(buckets=(64,), manual=True, max_linger_s=0.0, **kw)
+    rt = ServeRuntime(g, cfg)
+    rt.attach_planner(QueryPlanner(g))
+    return rt
+
+
+def _drain(rt):
+    while rt.step(drain=True):
+        pass
+
+
+def _skewed_graph(g, rng, n=40):
+    """The planner's home turf: a hub node soaking most links, typed
+    links with int values, a couple of sparse satellites — so different
+    clauses of one conjunction have wildly different cardinalities."""
+    nodes = [int(g.add(i)) for i in range(n)]
+    hub = nodes[0]
+    links = []
+    for i in range(3 * n):
+        other = nodes[1 + int(rng.integers(n - 1))]
+        links.append(int(g.add_link([hub, other], value=100 + i)))
+    # sparse corner: one atom with exactly two incident links
+    rare = nodes[-1]
+    links.append(int(g.add_link([rare, nodes[1]], value=500)))
+    links.append(int(g.add_link([rare, nodes[2]], value=501)))
+    return nodes, links, hub, rare
+
+
+def _corpus(g, nodes, links, hub, rare):
+    lt = int(g.get_type_handle_of(links[0]))
+    return [
+        c.And(c.AtomValue(105, "gte"), c.AtomValue(130, "lte")),
+        c.And(c.AtomValue(105, "gte"), c.AtomValue(130, "lte"),
+              c.AtomType(lt)),
+        c.And(c.AtomValue(100, "gte"), c.AtomValue(520, "lte"),
+              c.Incident(rare)),
+        c.And(c.Incident(hub), c.AtomType(lt)),
+        c.And(c.Incident(rare), c.Incident(nodes[1])),
+        c.And(c.CoIncident(rare)),
+        c.And(c.CoIncident(rare), c.AtomValue(0, "gte")),
+        c.And(c.BFS(rare, 2), c.AtomType(lt)),
+        c.AtomValue(110, "eq"),
+    ]
+
+
+def test_every_candidate_shape_is_result_identical(graph, rng):
+    nodes, links, hub, rare = _skewed_graph(graph, rng)
+    rt = _runtime(graph)
+    conds = _corpus(graph, nodes, links, hub, rare)
+    futs = []
+    for cond in conds:
+        truth = sorted(int(h) for h in graph.find_all(cond))
+        shapes = rt.planner.shapes_for(cond)
+        assert "host" in shapes  # the oracle shape is always enumerable
+        for shape in shapes:
+            futs.append((cond, shape, truth,
+                         rt.submit_planned(cond, force_shape=shape)))
+    _drain(rt)
+    rt.close()
+    for cond, shape, truth, fut in futs:
+        res = fut.result(timeout=0)
+        assert list(res.matches) == truth, (cond, shape)
+        assert res.count == len(truth)
+        assert not res.truncated
+        assert res.plan["shape"] == shape
+
+
+def test_planner_default_choice_matches_oracle(graph, rng):
+    """The unforced (cheapest) choice is just as exact — and the plan
+    record carries est/actual for the feedback loop."""
+    nodes, links, hub, rare = _skewed_graph(graph, rng)
+    rt = _runtime(graph)
+    conds = _corpus(graph, nodes, links, hub, rare)
+    futs = [(cond, sorted(int(h) for h in graph.find_all(cond)),
+             rt.submit_planned(cond, explain=True)) for cond in conds]
+    _drain(rt)
+    rt.close()
+    for cond, truth, fut in futs:
+        res = fut.result(timeout=0)
+        assert list(res.matches) == truth, cond
+        assert "est_rows" in res.plan and "actual_rows" in res.plan
+        assert res.plan["actual_rows"] >= 0
+        ex = getattr(fut, "explain", None)
+        assert ex is not None and ex["plan"]["shape"] == res.plan["shape"]
+    assert rt.stats.plan_requests == len(conds)
+    assert sum(rt.stats.plan_choice_counts().values()) == len(conds)
+
+
+def test_planner_prefers_cheap_anchor_on_skewed_graph(graph, rng):
+    """On the skewed graph, a conjunction anchored at BOTH the hub and
+    the rare atom must plan through the rare end: the chosen candidate's
+    estimate reflects the sparse anchor, not the hub."""
+    nodes, links, hub, rare = _skewed_graph(graph, rng)
+    rt = _runtime(graph)
+    cond = c.And(c.Incident(hub), c.Incident(rare))
+    choice = rt.planner.plan(cond)
+    est = rt.planner.estimator
+    assert choice.est_rows <= est.degree(rare)
+    assert choice.est_rows < est.degree(hub)
+    fut = rt.submit_planned(cond)
+    _drain(rt)
+    rt.close()
+    truth = sorted(int(h) for h in graph.find_all(cond))
+    assert list(fut.result(timeout=0).matches) == truth
+
+
+def test_truncated_lane_windows_reserve_exactly(graph, rng):
+    """A range window wider than the lane's top-k truncates on device;
+    the planned result must re-serve brute-force and stay exact."""
+    nodes, links, hub, rare = _skewed_graph(graph, rng)
+    rt = _runtime(graph, top_r=4)
+    cond = c.And(c.AtomValue(100, "gte"), c.AtomValue(400, "lte"))
+    truth = sorted(int(h) for h in graph.find_all(cond))
+    assert len(truth) > 4
+    fut = rt.submit_planned(cond, force_shape="range_first")
+    _drain(rt)
+    rt.close()
+    res = fut.result(timeout=0)
+    assert list(res.matches) == truth
+    assert res.served_by == "host"  # truncation fallback
+    assert not res.truncated
+
+
+def test_submit_planned_requires_attached_planner(graph):
+    rt = ServeRuntime(graph, ServeConfig(buckets=(64,), manual=True,
+                                         max_linger_s=0.0))
+    with pytest.raises(Unservable):
+        rt.submit_planned(c.AtomValue(1, "eq"))
+    rt.close()
+
+
+def test_planner_priors_read_the_committed_baseline(graph, tmp_path,
+                                                    monkeypatch):
+    """``from_committed_baseline`` prices lanes from the SAME record
+    ``bench.py --seed-baseline`` writes (``HG_PERF_BASELINE`` points at
+    it), and degrades to the default prior table when the file is
+    missing — never fails."""
+    import json
+
+    from hypergraphdb_tpu.plan.planner import DEFAULT_LANE_PRIOR_S
+
+    path = tmp_path / "PERF_BASELINE.json"
+    path.write_text(json.dumps({
+        "schema_version": 1,
+        "lanes": {"join": {"p50_s": 0.123, "qps": 10.0},
+                  "range": {"p50_s": 0.004}},
+    }))
+    monkeypatch.setenv("HG_PERF_BASELINE", str(path))
+    p = QueryPlanner.from_committed_baseline(graph)
+    assert p._priors["join"] == 0.123
+    assert p._priors["range"] == 0.004
+    assert p._priors["pattern"] == DEFAULT_LANE_PRIOR_S["pattern"]
+
+    monkeypatch.setenv("HG_PERF_BASELINE", str(tmp_path / "absent.json"))
+    p2 = QueryPlanner.from_committed_baseline(graph)
+    assert p2._priors == DEFAULT_LANE_PRIOR_S
+
+
+def test_force_shape_rejects_non_candidates(graph):
+    graph.add(1)
+    rt = _runtime(graph)
+    with pytest.raises(ValueError):
+        rt.planner.plan(c.AtomValue(1, "eq"), force_shape="bfs")
+    rt.close()
+
+
+def test_plan_metrics_reach_the_registry(graph, rng):
+    """plan.* instruments move with planned traffic and ride the same
+    governed registry the drift gate audits."""
+    nodes, links, hub, rare = _skewed_graph(graph, rng)
+    rt = _runtime(graph)
+    futs = [rt.submit_planned(cond)
+            for cond in _corpus(graph, nodes, links, hub, rare)]
+    _drain(rt)
+    for f in futs:
+        f.result(timeout=0)
+    names = rt.stats.registry.names()
+    for name in ("plan.requests", "plan.est_rows", "plan.actual_rows",
+                 "plan.abs_rel_error", "plan.guard_vetoes"):
+        assert name in names
+    assert rt.stats.plan_requests == len(futs)
+    rt.close()

@@ -295,20 +295,55 @@ def test_anchored_lane_under_churn_equals_host_oracle(graph):
     rt.close()
 
 
-def test_variable_width_kinds_serve_host_exactly(graph):
-    """str values (rank ties possible) take the exact host lane — the
-    request is admitted and answered, never device-approximated."""
-    for s in ("apple", "banana", "cherry", "date"):
+def test_clean_variable_width_windows_serve_on_device(graph):
+    """str values with CLEAN keys (≤16 payload bytes, NUL-free) ride the
+    device lane through the 128-bit rank pair — including rank ties in
+    the first word ('alphabetical' vs 'alphabetic': identical first 8
+    payload bytes) — and return exactly the host scan."""
+    words = ("apple", "alphabetic", "alphabetical", "banana", "blueberry",
+             "cherry", "cherrystone", "date")
+    for s in words:
         graph.add(s)
     rt = _runtime(graph, 64)
-    fut = rt.submit_range(lo="b", hi="cz")
+    fut = rt.submit_range(lo="alphabetical", hi="cherry")
     _drain(rt)
     rt.close()
     res = fut.result(timeout=0)
-    truth = _host_truth(graph, lo="b", hi="cz")
+    truth = _host_truth(graph, lo="alphabetical", hi="cherry")
+    assert "alphabetic" not in [graph.get(h) for h in res.matches.tolist()]
+    assert res.served_by == "device"
+    assert res.matches.tolist() == truth
+    assert rt.stats.range_dispatches == 1
+
+
+def test_ambiguous_variable_width_kinds_serve_host_exactly(graph):
+    """Ambiguity past the rank pair falls back to the exact host lane:
+    an AMBIGUOUS BOUND (>16 payload bytes) makes the request inexact,
+    and an ambiguous COLUMN ENTRY clears device_exact so even clean
+    bounds host-serve. Both answered exactly, never approximated."""
+    for s in ("apple", "banana", "cherry", "date"):
+        graph.add(s)
+    rt = _runtime(graph, 64)
+    fut = rt.submit_range(lo="b", hi="an unambiguously long upper bound")
+    _drain(rt)
+    rt.close()
+    res = fut.result(timeout=0)
+    truth = _host_truth(graph, lo="b", hi="an unambiguously long upper bound")
     assert res.served_by == "host"
     assert res.matches.tolist() == truth
     assert rt.stats.range_dispatches == 0  # nothing device-dispatched
+
+    g2 = type(graph)()
+    g2.add("a long string past the sixteen-byte rank pair")
+    g2.add("brief")
+    rt2 = _runtime(g2, 64)
+    fut2 = rt2.submit_range(lo="a", hi="z")  # clean bounds, dirty column
+    _drain(rt2)
+    rt2.close()
+    res2 = fut2.result(timeout=0)
+    assert res2.served_by == "host"
+    assert res2.matches.tolist() == _host_truth(g2, lo="a", hi="z")
+    assert rt2.stats.range_dispatches == 0
 
 
 def test_batch_key_separates_dimensions(graph):
@@ -385,9 +420,9 @@ def test_range_request_validation():
 
 
 def test_range_probe_batch_matches_numpy_searchsorted():
-    """Kernel-level differential: the 2-word branchless binary search ==
-    np.searchsorted over the recombined 64-bit ranks, both sides, at
-    duplicate values and both column ends."""
+    """Kernel-level differential: the 4-word branchless binary search ==
+    np.searchsorted over the recombined 128-bit rank pairs, both sides,
+    at duplicate values, first-word ties, and both column ends."""
     import jax.numpy as jnp
 
     from hypergraphdb_tpu.ops.value_index import range_probe_batch
@@ -395,25 +430,46 @@ def test_range_probe_batch_matches_numpy_searchsorted():
     r = np.random.default_rng(9)
     ranks = np.sort(r.integers(0, 1 << 40, size=100).astype(np.uint64))
     ranks[10:15] = ranks[10]  # duplicates
+    ranks2 = r.integers(0, 1 << 40, size=100).astype(np.uint64)
+    ranks2[10:15] = np.sort(ranks2[10:15])  # tie band stays sorted
+    ranks2[12] = ranks2[11]  # a full 128-bit duplicate inside the band
+    order = np.lexsort((ranks2, ranks))
+    ranks, ranks2 = ranks[order], ranks2[order]
     hi = (ranks >> np.uint64(32)).astype(np.uint32)
     lo = (ranks & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    hi2 = (ranks2 >> np.uint64(32)).astype(np.uint32)
+    lo2 = (ranks2 & np.uint64(0xFFFFFFFF)).astype(np.uint32)
     pad = np.full(28, 0xFFFFFFFF, dtype=np.uint32)
     col_hi = np.concatenate([hi, pad])
     col_lo = np.concatenate([lo, pad])
-    q = np.concatenate([
-        ranks[[0, 10, 12, 50, 99]], np.asarray([0, 1 << 63], np.uint64)
-    ])
+    col_hi2 = np.concatenate([hi2, pad])
+    col_lo2 = np.concatenate([lo2, pad])
+    qi = [0, 10, 12, 50, 99]
+    q = np.concatenate([ranks[qi], np.asarray([0, 1 << 63], np.uint64)])
+    q2 = np.concatenate([ranks2[qi], np.asarray([0, 0], np.uint64)])
+    # the reference search runs over the pair as python ints (numpy has
+    # no native 128-bit ordering)
+    pairs = [(int(a), int(b)) for a, b in zip(ranks, ranks2)]
     for right in (False, True):
         lo_idx, hi_idx = range_probe_batch(
-            jnp.asarray(col_hi), jnp.asarray(col_lo), jnp.int32(100),
+            jnp.asarray(col_hi), jnp.asarray(col_lo),
+            jnp.asarray(col_hi2), jnp.asarray(col_lo2), jnp.int32(100),
             jnp.asarray((q >> np.uint64(32)).astype(np.uint32)),
             jnp.asarray((q & np.uint64(0xFFFFFFFF)).astype(np.uint32)),
+            jnp.asarray((q2 >> np.uint64(32)).astype(np.uint32)),
+            jnp.asarray((q2 & np.uint64(0xFFFFFFFF)).astype(np.uint32)),
             jnp.asarray(np.full(len(q), right)),
             jnp.asarray((q >> np.uint64(32)).astype(np.uint32)),
             jnp.asarray((q & np.uint64(0xFFFFFFFF)).astype(np.uint32)),
+            jnp.asarray((q2 >> np.uint64(32)).astype(np.uint32)),
+            jnp.asarray((q2 & np.uint64(0xFFFFFFFF)).astype(np.uint32)),
             jnp.asarray(np.full(len(q), right)),
         )
-        want = np.searchsorted(ranks, q, side="right" if right else "left")
+        import bisect
+
+        probe = list(zip((int(v) for v in q), (int(v) for v in q2)))
+        fn = bisect.bisect_right if right else bisect.bisect_left
+        want = np.asarray([fn(pairs, p) for p in probe], dtype=np.int32)
         np.testing.assert_array_equal(np.asarray(lo_idx), want)
         np.testing.assert_array_equal(np.asarray(hi_idx), want)
 

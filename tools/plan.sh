@@ -1,0 +1,165 @@
+#!/usr/bin/env bash
+# hgplan gate: the cost-based-planner suite — the cardinality-estimator
+# oracle suite (exact-flagged estimates EQUAL brute-force counts;
+# model estimates stay inside bounded relative error on uniform AND
+# hub-heavy families), the planner differential suite (every enumerable
+# candidate shape forced through submit_planned returns exactly
+# graph.find_all's match set), the feedback-loop suite (the drift
+# digest demonstrably shrinks median est-vs-actual error on a replayed
+# trace, is LRU/clamp-bounded, and the sentinel guard vetoes a
+# correction that steers onto a degraded lane), then a LIVE smoke on a
+# seeded skewed graph: the planner must pick the sparse anchor, the
+# EXPLAIN record must carry plan.est_rows / plan.actual_rows, and the
+# planned path must run >= 2x faster than the worst candidate lane
+# (forced via force_shape, timed on the same runtime).
+#
+# Sits beside lint.sh (AST hazards), verify.sh (jaxpr ground truth),
+# join.sh (the join engine the planner prices), perf.sh (the sentinel
+# whose violating set the guard veto reads), and obs.sh: this one
+# gates the planning subsystem.
+#
+# Usage: tools/plan.sh [extra pytest args]
+#   tools/plan.sh -k feedback          # one area, fast local run
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
+    tests/test_plan_stats.py \
+    tests/test_planner.py \
+    tests/test_plan_feedback.py \
+    -q -m 'not slow' -p no:cacheprovider "$@"
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "tools/plan.sh: plan suites failed (exit $rc)" >&2
+    exit "$rc"
+fi
+
+# -- live smoke: skewed graph, cheap anchor chosen, planned path beats
+#    the worst candidate lane by >= 2x, EXPLAIN carries est/actual ------------
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - <<'PY'
+import json
+import time
+
+import numpy as np
+
+from hypergraphdb_tpu import HyperGraph, obs
+from hypergraphdb_tpu.obs.perf import default_baseline_path, load_baseline
+from hypergraphdb_tpu.plan import QueryPlanner
+from hypergraphdb_tpu.query import conditions as c
+from hypergraphdb_tpu.serve import ServeConfig, ServeRuntime
+
+obs.enable()  # EXPLAIN records need the tracer
+g = HyperGraph()
+r = np.random.default_rng(11)
+n = 4000
+nodes = [int(h) for h in g.bulk_import(values=np.arange(n).tolist())]
+hub, rare = nodes[0], nodes[-1]
+g.bulk_import(
+    values=[int(100_000 + i) for i in range(3 * n)],
+    target_lists=[[hub, nodes[1 + int(r.integers(n - 2))]]
+                  for _ in range(3 * n)],
+)
+g.add_link([rare, nodes[1]], value=500)
+g.add_link([rare, nodes[2]], value=501)
+
+rt = ServeRuntime(g, ServeConfig(buckets=(64,), manual=True,
+                                 max_linger_s=0.0, top_r=256))
+# DEFAULT priors for the timing assertion — the committed baselines are
+# coarse CPU-smoke anchors; pricing a wall-clock gate from them would
+# couple this smoke to whatever hardware last recorded a bench
+rt.attach_planner(QueryPlanner(g))
+
+# ... but the baseline-coupling contract is still checked live: a
+# planner built from the committed record must price the join lane at
+# the SAME p50 bench.py --seed-baseline wrote there (the c11 open-loop
+# record after PR 20)
+pb = load_baseline(default_baseline_path())
+pl = QueryPlanner.from_committed_baseline(g)
+assert pl._priors["join"] == pb["lanes"]["join"]["p50_s"], (
+    pl._priors["join"], pb["lanes"]["join"])
+baseline_join = {"p50_s": pb["lanes"]["join"]["p50_s"],
+                 "note": pb["lanes"]["join"].get("note")}
+
+
+def drain():
+    while rt.step(drain=True):
+        pass
+
+
+def timed(cond, shape=None, reps=3):
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fut = rt.submit_planned(cond, force_shape=shape, explain=True)
+        drain()
+        fut.result(timeout=0)
+        best = min(best, time.perf_counter() - t0)
+    return best, fut
+
+
+# -- choice: a conjunction anchored at BOTH ends of the skew must plan
+#    through the sparse anchor, not the hub --------------------------------
+cond_anchor = c.And(c.Incident(rare), c.Incident(hub))
+truth_anchor = sorted(int(h) for h in g.find_all(cond_anchor))
+choice = rt.planner.plan(cond_anchor)
+est = rt.planner.estimator
+assert choice.est_rows <= est.degree(rare), (
+    f"planner did not anchor at the sparse end: est_rows="
+    f"{choice.est_rows} > degree(rare)={est.degree(rare)}")
+assert choice.est_rows < est.degree(hub)
+fut = rt.submit_planned(cond_anchor)
+drain()
+assert list(fut.result(timeout=0).matches) == truth_anchor
+
+# -- cost: a narrow value window AND the hub's co-incidence. The exact
+#    window estimate (a handful of rows) routes the planner to the
+#    range lane; the join candidate must expand the hub's 3n-wide
+#    co-row — the expensive plan the cost model exists to avoid -----------
+cond = c.And(c.CoIncident(hub), c.AtomValue(10, "gte"),
+             c.AtomValue(20, "lte"))
+truth = sorted(int(h) for h in g.find_all(cond))
+assert truth, "smoke graph produced an empty window"
+
+shapes = rt.planner.shapes_for(cond)
+assert "join" in shapes, shapes
+for shape in shapes:          # compile/warm every lane off the clock
+    fut = rt.submit_planned(cond, force_shape=shape)
+    drain()
+    assert list(fut.result(timeout=0).matches) == truth, shape
+
+lane_s = {shape: timed(cond, shape)[0] for shape in shapes}
+planned_s, fut = timed(cond)
+res = fut.result(timeout=0)
+assert list(res.matches) == truth
+for key in ("est_rows", "actual_rows", "shape", "cost"):
+    assert key in res.plan, (key, res.plan)
+ex = fut.explain
+assert ex["plan"]["shape"] == res.plan["shape"]
+worst_shape = max(lane_s, key=lane_s.get)
+speedup = lane_s[worst_shape] / planned_s
+assert speedup >= 2.0, (
+    f"planned path only {speedup:.2f}x faster than worst candidate "
+    f"{worst_shape} ({lane_s[worst_shape]*1e3:.2f}ms vs "
+    f"{planned_s*1e3:.2f}ms)")
+rt.close()
+g.close()
+print("tools/plan.sh smoke:", json.dumps({
+    "chosen": res.plan["shape"],
+    "est_rows": res.plan["est_rows"],
+    "actual_rows": res.plan["actual_rows"],
+    "planned_ms": round(planned_s * 1e3, 2),
+    "worst_candidate": worst_shape,
+    "worst_ms": round(lane_s[worst_shape] * 1e3, 2),
+    "speedup_vs_worst": round(speedup, 1),
+    "candidates_ms": {k: round(v * 1e3, 2)
+                      for k, v in sorted(lane_s.items())},
+    "baseline_join_prior": baseline_join,
+}))
+PY
+smoke_rc=$?
+if [ "$smoke_rc" -ne 0 ]; then
+    echo "tools/plan.sh: live planner smoke failed (exit $smoke_rc)" >&2
+    exit "$smoke_rc"
+fi
+echo "tools/plan.sh: plan gate green"
+exit 0
